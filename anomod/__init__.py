@@ -1,0 +1,21 @@
+"""anomod — TPU-native anomaly-detection & root-cause-analysis framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the AnoMod
+reference dataset + toolchain (EvoTestOps/AnoMod): typed loaders for the five
+synchronized modalities (logs, metrics, traces, API responses, code coverage)
+of the SocialNetwork (SN) and Train-Ticket (TT) testbeds, the chaos fault
+taxonomy, service-dependency-graph construction from spans, streaming-sketch
+featurization (t-digest / HyperLogLog), anomaly detection and GNN root-cause
+localization — with a ``backend={cpu, jax-tpu}`` switch and pod-sharded replay.
+
+Reference behavior contracts are cited per-module as
+``/root/reference/<path>:<line>``.
+"""
+
+__version__ = "0.1.0"
+
+from anomod import config as config
+from anomod import schemas as schemas
+from anomod import labels as labels
+
+__all__ = ["config", "schemas", "labels", "__version__"]

@@ -1,0 +1,57 @@
+"""Non-interactive CLI — the counterpart of the reference's numbered menus
+(automated_multimodal_collection.sh:845-888, run_all_experiments.sh:601-638)
+as flags instead of prompts.
+
+Subcommands grow with the framework; `list` and `synth` are available from
+day one so every experiment the reference menus offer is addressable by name.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="anomod",
+        description="TPU-native anomaly-detection & RCA framework (AnoMod capabilities)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_list = sub.add_parser("list", help="list experiments + fault labels")
+    p_list.add_argument("--testbed", choices=["SN", "TT"], default=None)
+
+    p_synth = sub.add_parser("synth", help="generate a synthetic experiment summary")
+    p_synth.add_argument("experiment")
+    p_synth.add_argument("--traces", type=int, default=100)
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "list":
+        from anomod import labels
+        rows = labels.ALL_LABELS if args.testbed is None else \
+            labels.labels_for_testbed(args.testbed)
+        for l in rows:
+            print(f"{l.testbed}  {l.experiment:40s} {l.anomaly_level:12s} "
+                  f"{l.anomaly_type:28s} {l.target_service}")
+        return 0
+
+    if args.cmd == "synth":
+        from anomod import synth
+        exp = synth.generate_experiment(args.experiment, n_traces=args.traces)
+        print(json.dumps({
+            "experiment": exp.name, "testbed": exp.testbed,
+            "spans": exp.spans.n_spans, "traces": exp.spans.n_traces,
+            "services": exp.spans.n_services,
+            "metric_samples": exp.metrics.n_samples,
+            "log_lines": exp.logs.n_lines,
+            "api_records": exp.api.n_records,
+        }))
+        return 0
+
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Configuration: env-var contract + dataclass config.
+
+Mirrors the reference's ``${VAR:-default}`` env contract style
+(SN_collection-scripts/README.md:38-53, collect_all_data.sh:37-54) but as a
+typed, non-interactive config object.  Placeholder values of the form
+``{SOMETHING}`` are treated as unset, matching the reference's anonymization
+placeholder policy (``ensure_path_var``, collect_all_data.sh:37-44).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+from typing import Optional
+
+
+def _env(name: str, default: str) -> str:
+    """Read an env var; reference-style ``{PLACEHOLDER}`` values count as unset."""
+    val = os.environ.get(name, "").strip()
+    if not val or (val.startswith("{") and val.endswith("}")):
+        return default
+    return val
+
+
+# Default data roots: the reference checkout mounted read-only, and this repo.
+_DEFAULT_REFERENCE_ROOT = "/root/reference"
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Global framework configuration.
+
+    Attributes mirror the reference env contract where one exists:
+      - ``data_root``     ~ DATA_ARCHIVE_ROOT (collect_all_data.sh:207-211)
+      - ``sn_data``/``tt_data`` ~ the shipped SN_data/ and TT_data/ trees
+      - ``backend``       ~ the BASELINE.json {cpu, jax-tpu} switch
+    """
+
+    data_root: Path = dataclasses.field(
+        default_factory=lambda: Path(_env("ANOMOD_DATA_ROOT", _DEFAULT_REFERENCE_ROOT)))
+    backend: str = dataclasses.field(
+        default_factory=lambda: _env("ANOMOD_BACKEND", "cpu"))  # "cpu" | "jax" | "jax-tpu"
+    synth_on_lfs: bool = dataclasses.field(
+        default_factory=lambda: _env("ANOMOD_SYNTH_ON_LFS", "1") not in ("0", "false"))
+    # init_social_graph.py:149 seeds with 1
+    seed: int = dataclasses.field(default_factory=lambda: int(_env("ANOMOD_SEED", "1")))
+    cache_dir: Optional[Path] = None
+
+    @property
+    def sn_data(self) -> Path:
+        return self.data_root / "SN_data"
+
+    @property
+    def tt_data(self) -> Path:
+        return self.data_root / "TT_data"
+
+    def with_backend(self, backend: str) -> "Config":
+        return dataclasses.replace(self, backend=backend)
+
+
+_DEFAULT: Optional[Config] = None
+
+
+def get_config() -> Config:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = Config()
+    return _DEFAULT
+
+
+def set_config(cfg: Config) -> None:
+    global _DEFAULT
+    _DEFAULT = cfg

@@ -1,0 +1,5 @@
+"""Typed loaders for the seven reference artifact families (SN+TT × modalities)."""
+
+from anomod.io.lfs import is_lfs_pointer, read_text_or_none
+
+__all__ = ["is_lfs_pointer", "read_text_or_none"]

@@ -1,0 +1,730 @@
+"""Deterministic synthetic data generator — schema-identical to the reference.
+
+Most SN_data/TT_data payloads in the reference checkout are git-LFS pointer
+stubs (SURVEY.md §2.3), so this generator is the stand-in corpus: seeded like
+the reference's graph seeder (``random.seed(1)``, init_social_graph.py:149),
+it emits data matching each modality's schema contract exactly:
+
+  - TT traces: SkyWalking collector JSON (trace_collector.py:552-584 metadata
+    + traces[{summary, spans[to_dict contract :86-123]}]).
+  - SN traces: Jaeger API JSON (data[{traceID, processes, spans}]) as consumed
+    by jaeger_to_csv.py:20-74, plus the flattened 13-column CSV.
+  - Metrics: SN per-query CSVs (timestamp,value,metric,<labels> —
+    fetch_prometheus_metrics.py:57-66) and the TT long CSV
+    (metric_name,timestamp,datetime,value,<labels> — metric_collector.py:431-443).
+  - Logs: per-service line streams + summary counts (collect_log.sh:101-137).
+  - API responses: JSONL records (enhanced_openapi_monitor.py:155-169).
+  - Coverage: per-(service,file) line counters (gcov / JaCoCo LINE,
+    coverage_summary.py:97-125).
+
+Fault labels condition the generated distributions so detectors and RCA have
+ground-truth signal: latency inflation for performance/database faults, error
+injection for service/code faults, matching the reference's sanity thresholds
+(SN_collection-scripts/README.md:106: CPU fault ⇒ >90% system CPU).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from anomod import labels as labels_mod
+from anomod.labels import FaultLabel
+from anomod.schemas import (
+    KIND_ENTRY, KIND_EXIT, KIND_LOCAL, KIND_NAMES,
+    LOG_ERROR, LOG_INFO, LOG_OTHER, LOG_WARN,
+    ApiBatch, CoverageBatch, Experiment, FileCoverage, LogBatch, LogSummary,
+    MetricBatch, SpanBatch, coverage_batch_from_files,
+)
+
+# ---------------------------------------------------------------------------
+# Service topologies.
+# SN: the 12 core services of DeathStarBench SocialNetwork
+# (collect_log.sh:31-44); edges reflect the compose/read call paths
+# (mixed-workload.lua:111-125 drives home-timeline/user-timeline/compose).
+# ---------------------------------------------------------------------------
+
+SN_SERVICES: Tuple[str, ...] = (
+    "nginx-web-server", "compose-post-service", "post-storage-service",
+    "user-service", "user-mention-service", "unique-id-service",
+    "media-service", "social-graph-service", "user-timeline-service",
+    "url-shorten-service", "home-timeline-service", "text-service",
+)
+
+SN_EDGES: Tuple[Tuple[str, str], ...] = (
+    ("nginx-web-server", "compose-post-service"),
+    ("nginx-web-server", "home-timeline-service"),
+    ("nginx-web-server", "user-timeline-service"),
+    ("nginx-web-server", "user-service"),
+    ("nginx-web-server", "social-graph-service"),
+    ("compose-post-service", "unique-id-service"),
+    ("compose-post-service", "user-service"),
+    ("compose-post-service", "media-service"),
+    ("compose-post-service", "text-service"),
+    ("compose-post-service", "post-storage-service"),
+    ("compose-post-service", "user-timeline-service"),
+    ("compose-post-service", "home-timeline-service"),
+    ("text-service", "url-shorten-service"),
+    ("text-service", "user-mention-service"),
+    ("home-timeline-service", "post-storage-service"),
+    ("home-timeline-service", "social-graph-service"),
+    ("user-timeline-service", "post-storage-service"),
+)
+
+# TT: the Train-Ticket ts-* services observed in TT_data pod logs
+# (TT_data/log_data/<exp>/ listing) and gen-mysql-secret.sh:2; edges follow
+# the booking flow exercised by test_all_services.py:127-196.
+TT_SERVICES: Tuple[str, ...] = (
+    "ts-gateway-service", "ts-auth-service", "ts-user-service", "ts-verification-code-service",
+    "ts-travel-service", "ts-travel2-service", "ts-travel-plan-service", "ts-route-plan-service",
+    "ts-route-service", "ts-train-service", "ts-station-service", "ts-basic-service",
+    "ts-seat-service", "ts-config-service", "ts-price-service", "ts-ticketinfo-service",
+    "ts-preserve-service", "ts-preserve-other-service", "ts-security-service",
+    "ts-contacts-service", "ts-assurance-service", "ts-food-service",
+    "ts-station-food-service", "ts-train-food-service", "ts-food-delivery-service",
+    "ts-consign-service", "ts-consign-price-service", "ts-order-service",
+    "ts-order-other-service", "ts-inside-payment-service", "ts-payment-service",
+    "ts-cancel-service", "ts-execute-service", "ts-rebook-service", "ts-delivery-service",
+    "ts-notification-service", "ts-news-service", "ts-voucher-service",
+    "ts-wait-order-service", "ts-admin-order-service", "ts-admin-route-service",
+    "ts-admin-travel-service", "ts-admin-user-service", "ts-admin-basic-info-service",
+    "ts-avatar-service",
+)
+
+TT_EDGES: Tuple[Tuple[str, str], ...] = (
+    ("ts-gateway-service", "ts-auth-service"),
+    ("ts-gateway-service", "ts-user-service"),
+    ("ts-gateway-service", "ts-travel-service"),
+    ("ts-gateway-service", "ts-travel2-service"),
+    ("ts-gateway-service", "ts-travel-plan-service"),
+    ("ts-gateway-service", "ts-preserve-service"),
+    ("ts-gateway-service", "ts-preserve-other-service"),
+    ("ts-gateway-service", "ts-order-service"),
+    ("ts-gateway-service", "ts-order-other-service"),
+    ("ts-gateway-service", "ts-cancel-service"),
+    ("ts-gateway-service", "ts-execute-service"),
+    ("ts-gateway-service", "ts-rebook-service"),
+    ("ts-gateway-service", "ts-consign-service"),
+    ("ts-gateway-service", "ts-food-service"),
+    ("ts-gateway-service", "ts-contacts-service"),
+    ("ts-gateway-service", "ts-admin-order-service"),
+    ("ts-gateway-service", "ts-admin-route-service"),
+    ("ts-gateway-service", "ts-admin-travel-service"),
+    ("ts-gateway-service", "ts-admin-user-service"),
+    ("ts-gateway-service", "ts-admin-basic-info-service"),
+    ("ts-auth-service", "ts-verification-code-service"),
+    ("ts-user-service", "ts-auth-service"),
+    ("ts-user-service", "ts-avatar-service"),
+    ("ts-travel-service", "ts-basic-service"),
+    ("ts-travel-service", "ts-train-service"),
+    ("ts-travel-service", "ts-route-service"),
+    ("ts-travel-service", "ts-seat-service"),
+    ("ts-travel-service", "ts-ticketinfo-service"),
+    ("ts-travel2-service", "ts-basic-service"),
+    ("ts-travel2-service", "ts-route-service"),
+    ("ts-travel-plan-service", "ts-route-plan-service"),
+    ("ts-travel-plan-service", "ts-travel-service"),
+    ("ts-route-plan-service", "ts-route-service"),
+    ("ts-route-plan-service", "ts-travel-service"),
+    ("ts-basic-service", "ts-station-service"),
+    ("ts-basic-service", "ts-train-service"),
+    ("ts-basic-service", "ts-route-service"),
+    ("ts-basic-service", "ts-price-service"),
+    ("ts-ticketinfo-service", "ts-basic-service"),
+    ("ts-seat-service", "ts-config-service"),
+    ("ts-seat-service", "ts-order-service"),
+    ("ts-preserve-service", "ts-seat-service"),
+    ("ts-preserve-service", "ts-security-service"),
+    ("ts-preserve-service", "ts-contacts-service"),
+    ("ts-preserve-service", "ts-assurance-service"),
+    ("ts-preserve-service", "ts-food-service"),
+    ("ts-preserve-service", "ts-consign-service"),
+    ("ts-preserve-service", "ts-order-service"),
+    ("ts-preserve-service", "ts-user-service"),
+    ("ts-preserve-service", "ts-travel-service"),
+    ("ts-preserve-service", "ts-station-service"),
+    ("ts-preserve-other-service", "ts-seat-service"),
+    ("ts-preserve-other-service", "ts-security-service"),
+    ("ts-preserve-other-service", "ts-order-other-service"),
+    ("ts-security-service", "ts-order-service"),
+    ("ts-security-service", "ts-order-other-service"),
+    ("ts-food-service", "ts-station-food-service"),
+    ("ts-food-service", "ts-train-food-service"),
+    ("ts-food-service", "ts-food-delivery-service"),
+    ("ts-consign-service", "ts-consign-price-service"),
+    ("ts-consign-service", "ts-order-service"),
+    ("ts-order-service", "ts-station-service"),
+    ("ts-inside-payment-service", "ts-order-service"),
+    ("ts-inside-payment-service", "ts-payment-service"),
+    ("ts-cancel-service", "ts-order-service"),
+    ("ts-cancel-service", "ts-order-other-service"),
+    ("ts-cancel-service", "ts-inside-payment-service"),
+    ("ts-cancel-service", "ts-notification-service"),
+    ("ts-execute-service", "ts-order-service"),
+    ("ts-rebook-service", "ts-travel-service"),
+    ("ts-rebook-service", "ts-order-service"),
+    ("ts-rebook-service", "ts-seat-service"),
+    ("ts-rebook-service", "ts-inside-payment-service"),
+    ("ts-delivery-service", "ts-food-service"),
+    ("ts-wait-order-service", "ts-order-service"),
+    ("ts-admin-order-service", "ts-order-service"),
+    ("ts-admin-order-service", "ts-order-other-service"),
+    ("ts-admin-route-service", "ts-route-service"),
+    ("ts-admin-travel-service", "ts-travel-service"),
+    ("ts-admin-user-service", "ts-user-service"),
+    ("ts-admin-basic-info-service", "ts-basic-service"),
+)
+
+SN_API_ENDPOINTS: Tuple[str, ...] = tuple(
+    f"http://localhost:8080/wrk2-api/{p}" for p in (
+        "user/register", "user/follow", "user/unfollow", "user/login",
+        "post/compose", "home-timeline/read", "user-timeline/read",
+        "user/profile", "media/upload", "text/upload", "url/shorten",
+        "user-mention/upload",
+    )
+)  # enhanced_openapi_monitor.py:36-49
+
+
+def _seed_for(name: str, salt: int = 0) -> int:
+    h = hashlib.sha256(f"{name}:{salt}".encode()).digest()
+    return int.from_bytes(h[:8], "little") % (2**63)
+
+
+def _topology(testbed: str):
+    if testbed == "SN":
+        return SN_SERVICES, SN_EDGES, "nginx-web-server"
+    return TT_SERVICES, TT_EDGES, "ts-gateway-service"
+
+
+# ---------------------------------------------------------------------------
+# Trace templates: deterministic random walks over the topology.  Each
+# template is a list of (service_idx, parent_pos, kind) triples; traces are
+# instantiated per-template in vectorized batches.
+# ---------------------------------------------------------------------------
+
+def build_templates(testbed: str, n_templates: int = 24, max_depth: int = 5,
+                    seed: int = 1) -> List[List[Tuple[int, int, int]]]:
+    services, edges, root = _topology(testbed)
+    svc_idx = {s: i for i, s in enumerate(services)}
+    children: Dict[int, List[int]] = {}
+    for a, b in edges:
+        children.setdefault(svc_idx[a], []).append(svc_idx[b])
+    rng = np.random.default_rng(seed)
+    templates = []
+    for _ in range(n_templates):
+        tpl: List[Tuple[int, int, int]] = [(svc_idx[root], -1, KIND_ENTRY)]
+        frontier = [(svc_idx[root], 0, 0)]  # (svc, pos in tpl, depth)
+        while frontier:
+            svc, pos, depth = frontier.pop()
+            kids = children.get(svc, [])
+            if not kids or depth >= max_depth:
+                continue
+            n_kids = int(rng.integers(1, min(len(kids), 3) + 1))
+            picked = rng.choice(len(kids), size=n_kids, replace=False)
+            for k in picked:
+                child_svc = kids[int(k)]
+                # Exit span on caller, Entry span on callee (SkyWalking style).
+                tpl.append((svc, pos, KIND_EXIT))
+                exit_pos = len(tpl) - 1
+                tpl.append((child_svc, exit_pos, KIND_ENTRY))
+                entry_pos = len(tpl) - 1
+                frontier.append((child_svc, entry_pos, depth + 1))
+        templates.append(tpl)
+    return templates
+
+
+# Per-(level,type) effect multipliers applied to the target service.
+def _fault_effects(label: FaultLabel) -> Tuple[float, float]:
+    """Return (latency_multiplier, error_probability) for the culprit service."""
+    if not label.is_anomaly:
+        return 1.0, 0.002
+    lvl, typ = label.anomaly_level, label.anomaly_type
+    if lvl == "performance":
+        return {"cpu_contention": 6.0, "disk_io_stress": 4.0,
+                "network_loss": 8.0}.get(typ, 5.0), 0.02
+    if lvl == "service":
+        return ({"kill_service_instance": 2.0, "http_abort": 1.5,
+                 "dns_failure": 3.0}.get(typ, 2.0),
+                {"http_abort": 0.7, "kill_service_instance": 0.5,
+                 "dns_failure": 0.6}.get(typ, 0.5))
+    if lvl == "database":
+        return {"transaction_timeout": 20.0, "connection_pool_exhaustion": 12.0,
+                "cache_limit": 5.0}.get(typ, 8.0), 0.10
+    # code-level: immediate failure responses / exceptions
+    return 1.2, 0.6
+
+
+def generate_spans(label: FaultLabel, n_traces: int = 200,
+                   seed: Optional[int] = None,
+                   base_time_us: int = 1_762_180_000_000_000) -> SpanBatch:
+    """Generate a fault-conditioned SpanBatch for one experiment."""
+    services, _, _ = _topology(label.testbed)
+    if n_traces <= 0:
+        from anomod.schemas import empty_span_batch
+        return empty_span_batch()._replace(services=tuple(services))
+    if seed is None:
+        seed = _seed_for(label.experiment)
+    templates = build_templates(label.testbed, seed=seed & 0xFFFF | 1)
+    rng = np.random.default_rng(seed)
+
+    lat_mult, err_p = _fault_effects(label)
+    target = label.target_service
+    target_idx = services.index(target) if target in services else -1
+    # SN host-level performance faults hit every service.
+    host_level = label.is_anomaly and target_idx < 0
+
+    tpl_ids = rng.integers(0, len(templates), size=n_traces)
+    # Per-service baseline latency (ms, lognormal median), deterministic per testbed.
+    svc_rng = np.random.default_rng(_seed_for(label.testbed, 7))
+    base_ms = svc_rng.uniform(2.0, 30.0, size=len(services))
+
+    cols = {k: [] for k in ("trace", "parent", "service", "endpoint",
+                            "start_us", "duration_us", "is_error", "status", "kind")}
+    endpoints: Dict[str, int] = {}
+    offset = 0
+    # Traces span the full 1800 s experiment; the fault is active in the middle
+    # third [600, 1200) s — the same anomaly window generate_metrics and
+    # generate_api use, so the five modalities stay time-synchronized.
+    trace_start = base_time_us + np.sort(rng.integers(0, 1_800_000_000, size=n_traces))
+    trace_in_window = ((trace_start - base_time_us >= 600_000_000)
+                       & (trace_start - base_time_us < 1_200_000_000))
+
+    for t_id in range(len(templates)):
+        mask = tpl_ids == t_id
+        m = int(mask.sum())
+        if m == 0:
+            continue
+        tpl = templates[t_id]
+        L = len(tpl)
+        svc = np.array([s for s, _, _ in tpl], np.int32)
+        par_local = np.array([p for _, p, _ in tpl], np.int32)
+        kind = np.array([k for _, _, k in tpl], np.int8)
+        ep_names = [f"{services[s]}/{'entry' if k == KIND_ENTRY else 'exit'}/{i % 4}"
+                    for i, (s, _, k) in enumerate(tpl)]
+        ep_ids = np.array([endpoints.setdefault(e, len(endpoints)) for e in ep_names],
+                          np.int32)
+
+        # durations: lognormal around per-service base, inflated on the
+        # culprit service only while the trace falls in the anomaly window
+        tw = trace_in_window[mask]  # (m,)
+        culprit = (np.full(L, True) if host_level
+                   else (svc == target_idx))  # (L,)
+        active = label.is_anomaly & (tw[:, None] & culprit[None, :])  # (m, L)
+        mult = np.where(active, lat_mult, 1.0)
+        dur_ms = rng.lognormal(mean=np.log(base_ms[svc][None, :] * mult),
+                               sigma=0.4, size=(m, L))
+        err_prob = np.where(active, err_p, 0.005 if label.is_anomaly else 0.002)
+        errors = rng.random((m, L)) < err_prob
+        # Entry spans of parents of failed spans also error (propagation).
+        prop = errors.copy()
+        for i in range(L - 1, 0, -1):
+            p = par_local[i]
+            if p >= 0:
+                prop[:, p] |= prop[:, i] & (rng.random(m) < 0.6)
+
+        start = (trace_start[mask][:, None]
+                 + np.cumsum(rng.integers(50, 2000, size=(m, L)), axis=1))
+        dur_us = (dur_ms * 1000.0).astype(np.int64)
+        status = np.where(prop, 500, 200).astype(np.int16)
+
+        glob_idx = offset + np.arange(m * L, dtype=np.int64).reshape(m, L)
+        parent = np.where(par_local[None, :] >= 0,
+                          glob_idx[:, np.clip(par_local, 0, None)],
+                          -1).astype(np.int32)
+        trace_idx = np.repeat(np.flatnonzero(mask).astype(np.int32), L)
+
+        cols["trace"].append(trace_idx)
+        cols["parent"].append(parent.reshape(-1))
+        cols["service"].append(np.tile(svc, m))
+        cols["endpoint"].append(np.tile(ep_ids, m))
+        cols["start_us"].append(start.astype(np.int64).reshape(-1))
+        cols["duration_us"].append(dur_us.reshape(-1))
+        cols["is_error"].append(prop.reshape(-1))
+        cols["status"].append(status.reshape(-1))
+        cols["kind"].append(np.tile(kind, m))
+        offset += m * L
+
+    trace_ids = tuple(f"{label.experiment}.{i:08x}" for i in range(n_traces))
+    batch = SpanBatch(
+        trace=np.concatenate(cols["trace"]),
+        parent=np.concatenate(cols["parent"]),
+        service=np.concatenate(cols["service"]),
+        endpoint=np.concatenate(cols["endpoint"]),
+        start_us=np.concatenate(cols["start_us"]),
+        duration_us=np.concatenate(cols["duration_us"]),
+        is_error=np.concatenate(cols["is_error"]),
+        status=np.concatenate(cols["status"]),
+        kind=np.concatenate(cols["kind"]),
+        services=tuple(services),
+        endpoints=tuple(endpoints),
+        trace_ids=trace_ids,
+    )
+    # Sort spans by start time (stable), preserving parent links via permutation.
+    order = np.argsort(batch.start_us, kind="stable").astype(np.int32)
+    inv = np.empty_like(order)
+    inv[order] = np.arange(order.shape[0], dtype=np.int32)
+    parent_sorted = batch.parent[order]
+    parent_sorted = np.where(parent_sorted >= 0, inv[np.clip(parent_sorted, 0, None)], -1)
+    batch = batch._replace(
+        trace=batch.trace[order], parent=parent_sorted.astype(np.int32),
+        service=batch.service[order], endpoint=batch.endpoint[order],
+        start_us=batch.start_us[order], duration_us=batch.duration_us[order],
+        is_error=batch.is_error[order], status=batch.status[order],
+        kind=batch.kind[order],
+    )
+    return batch.validate()
+
+
+# ---------------------------------------------------------------------------
+# JSON emitters matching the raw reference artifacts (used for loader tests
+# and for materializing a synthetic dataset tree).
+# ---------------------------------------------------------------------------
+
+def spans_to_skywalking_json(batch: SpanBatch, experiment: str) -> dict:
+    """Emit the TT SkyWalking collector JSON (trace_collector.py:552-584)."""
+    traces: List[dict] = []
+    by_trace: Dict[int, List[int]] = {}
+    for i in range(batch.n_spans):
+        by_trace.setdefault(int(batch.trace[i]), []).append(i)
+    for t, rows in by_trace.items():
+        # segment per service within the trace (simplified: one segment/service)
+        pos = {row: j for j, row in enumerate(rows)}
+        seg_of_svc: Dict[int, str] = {}
+        node_ids = {}
+        for i in rows:
+            svc = int(batch.service[i])
+            seg = seg_of_svc.setdefault(svc, f"seg-{batch.trace_ids[t]}-{svc}")
+            node_ids[i] = f"{seg}:{pos[i]}"
+        spans = []
+        roots = []
+        for i in rows:
+            svc = int(batch.service[i])
+            seg = seg_of_svc[svc]
+            par = int(batch.parent[i])
+            parent_node = node_ids.get(par) if par >= 0 else None
+            same_segment = par >= 0 and int(batch.service[par]) == svc
+            start_ms = int(batch.start_us[i] // 1000)
+            end_ms = int((batch.start_us[i] + batch.duration_us[i]) // 1000)
+            refs = []
+            if par >= 0 and not same_segment:
+                par_svc = int(batch.service[par])
+                refs.append({
+                    "traceId": batch.trace_ids[t],
+                    "parentSegmentId": seg_of_svc[par_svc],
+                    "parentSpanId": pos[par],
+                    "type": "CROSS_PROCESS",
+                })
+            if par < 0:
+                roots.append(node_ids[i])
+            spans.append({
+                "node_id": node_ids[i],
+                "trace_id": batch.trace_ids[t],
+                "segment_id": seg,
+                "span_id": pos[i],
+                "parent_span_id": pos[par] if same_segment else -1,
+                "parent_node_id": parent_node,
+                "depth": 0,
+                "children_node_ids": [],
+                "service_code": batch.services[svc],
+                "service_instance": f"{batch.services[svc]}-instance",
+                "start_timestamp_ms": start_ms,
+                "end_timestamp_ms": end_ms,
+                "duration_ms": max(0, end_ms - start_ms),
+                "endpoint_name": batch.endpoints[int(batch.endpoint[i])],
+                "type": KIND_NAMES[int(batch.kind[i])] if int(batch.kind[i]) < 3 else "Local",
+                "peer": None,
+                "component": "SpringMVC",
+                "layer": "Http",
+                "is_error": bool(batch.is_error[i]),
+                "tags": [{"key": "http.status_code", "value": str(int(batch.status[i]))}],
+                "tags_map": {"http.status_code": str(int(batch.status[i]))},
+                "logs": [],
+                "refs": refs,
+            })
+        svcs = sorted({s["service_code"] for s in spans})
+        traces.append({
+            "summary": {"trace_ids": [batch.trace_ids[t]],
+                        "duration": max(s["duration_ms"] for s in spans),
+                        "is_error": any(s["is_error"] for s in spans)},
+            "trace_id": batch.trace_ids[t],
+            "span_count": len(spans),
+            "services_involved": svcs,
+            "root_span_node_ids": roots,
+            "spans": spans,
+        })
+    return {
+        "metadata": {
+            "experiment": experiment,
+            "collection_hours": 24,
+            "trace_count": len(traces),
+            "span_count": batch.n_spans,
+            "services": sorted(set(batch.services)),
+            "generator": "anomod.synth",
+        },
+        "traces": traces,
+    }
+
+
+_KIND_TO_JAEGER = {KIND_ENTRY: "server", KIND_EXIT: "client", KIND_LOCAL: "internal"}
+
+
+def spans_to_jaeger_json(batch: SpanBatch) -> dict:
+    """Emit Jaeger API JSON (consumed by jaeger_to_csv.py:20-74)."""
+    data = []
+    by_trace: Dict[int, List[int]] = {}
+    for i in range(batch.n_spans):
+        by_trace.setdefault(int(batch.trace[i]), []).append(i)
+    for t, rows in by_trace.items():
+        processes = {f"p{int(batch.service[i])}":
+                     {"serviceName": batch.services[int(batch.service[i])]}
+                     for i in rows}
+        spans = []
+        for i in rows:
+            refs = []
+            par = int(batch.parent[i])
+            if par >= 0:
+                refs.append({"refType": "CHILD_OF",
+                             "traceID": batch.trace_ids[t],
+                             "spanID": f"s{par:08x}"})
+            spans.append({
+                "traceID": batch.trace_ids[t],
+                "spanID": f"s{i:08x}",
+                "processID": f"p{int(batch.service[i])}",
+                "operationName": batch.endpoints[int(batch.endpoint[i])],
+                "startTime": int(batch.start_us[i]),
+                "duration": int(batch.duration_us[i]),
+                "references": refs,
+                "tags": [
+                    {"key": "http.status_code", "value": int(batch.status[i])},
+                    {"key": "span.kind",
+                     "value": _KIND_TO_JAEGER[int(batch.kind[i])]},
+                    {"key": "component", "value": "thrift"},
+                ] + ([{"key": "error", "value": True}]
+                     if bool(batch.is_error[i]) else []),
+                "logs": [],
+            })
+        data.append({"traceID": batch.trace_ids[t],
+                     "processes": processes, "spans": spans})
+    return {"data": data}
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+SN_METRIC_FILES: Tuple[str, ...] = (
+    # observed in SN_data/metric_data/<exp>/ and collect_metric.sh:24-125
+    "system_cpu_usage", "system_memory_usage_percent", "system_load1",
+    "system_disk_usage_percent", "system_disk_io_time",
+    "system_disk_read_bytes", "system_disk_write_bytes",
+    "system_network_receive_bytes", "system_network_transmit_bytes",
+    "system_network_errors", "socialnet_container_cpu",
+    "socialnet_container_memory", "socialnet_container_network_receive",
+    "socialnet_container_network_transmit", "jaeger_spans_rate",
+    "redis_memory_used",
+)
+
+TT_METRIC_NAMES: Tuple[str, ...] = (
+    # subset of the catalog at metric_collector.py:37-104
+    "node_cpu_seconds_total", "node_memory_MemAvailable_bytes",
+    "container_cpu_usage_seconds_total", "container_memory_working_set_bytes",
+    "container_network_receive_bytes_total", "container_network_transmit_bytes_total",
+    "kube_pod_status_phase", "kube_pod_container_status_restarts_total",
+    "node_disk_io_time_seconds_total", "node_load1",
+    "mysql_global_status_threads_connected", "http_server_requests_seconds_count",
+)
+
+
+def generate_metrics(label: FaultLabel, duration_s: int = 1800, step_s: int = 15,
+                     seed: Optional[int] = None,
+                     base_time_s: float = 1.7621800e9) -> MetricBatch:
+    """Fault-conditioned metric samples at the reference's 15 s step
+    (collect_metric.sh:4-5)."""
+    if seed is None:
+        seed = _seed_for(label.experiment, 2)
+    rng = np.random.default_rng(seed)
+    services, _, _ = _topology(label.testbed)
+    names = SN_METRIC_FILES if label.testbed == "SN" else TT_METRIC_NAMES
+    t = np.arange(0, duration_s, step_s, dtype=np.float64) + base_time_s
+    nt = t.shape[0]
+    lat_mult, err_p = _fault_effects(label)
+
+    metric_col, series_col, t_col, v_col = [], [], [], []
+    series_keys: List[str] = []
+    series_service: List[int] = []
+
+    def add_series(m_idx: int, key: str, svc: int, values: np.ndarray):
+        s_idx = len(series_keys)
+        series_keys.append(key)
+        series_service.append(svc)
+        metric_col.append(np.full(nt, m_idx, np.int32))
+        series_col.append(np.full(nt, s_idx, np.int32))
+        t_col.append(t)
+        v_col.append(values)
+
+    # anomaly window: middle third of the experiment
+    in_window = (t - t[0] >= duration_s / 3) & (t - t[0] < 2 * duration_s / 3)
+    for m_idx, name in enumerate(names):
+        if "cpu" in name and ("system" in name or "node" in name):
+            base = rng.uniform(15, 35) + rng.normal(0, 3, nt)
+            if label.is_anomaly and label.anomaly_type == "cpu_contention":
+                base = np.where(in_window, rng.uniform(91, 99, nt), base)
+            add_series(m_idx, 'instance="host"', -1, np.clip(base, 0, 100))
+        elif "container" in name or "http_server" in name:
+            # first 12 services + always the fault target (so per-service
+            # fault signal survives the truncation on the ~45-service TT list)
+            svc_set = list(range(min(len(services), 12)))
+            if (label.target_service in services
+                    and services.index(label.target_service) not in svc_set):
+                svc_set.append(services.index(label.target_service))
+            for s in svc_set:
+                scale = rng.uniform(0.5, 2.0)
+                base = np.abs(rng.normal(10 * scale, 2, nt))
+                if (label.is_anomaly and label.target_service
+                        and services[s] == label.target_service):
+                    base = np.where(in_window, base * lat_mult, base)
+                key = (f'name="{services[s]}"' if label.testbed == "SN"
+                       else f'pod="{services[s]}-0",service="{services[s]}"')
+                add_series(m_idx, key, s, base)
+        elif name == "redis_memory_used":
+            base = rng.uniform(4e7, 6e7) + rng.normal(0, 1e6, nt)
+            if label.is_anomaly and label.anomaly_type == "cache_limit":
+                base = np.where(in_window, base * 0.3, base)  # README.md:106 plateau drop
+            add_series(m_idx, 'instance="redis"', -1, base)
+        else:
+            base = np.abs(rng.normal(rng.uniform(1, 100), 5, nt))
+            if label.is_anomaly and label.anomaly_level == "performance":
+                if ("disk" in name and "disk" in label.anomaly_type) or \
+                   ("network" in name and "network" in label.anomaly_type):
+                    base = np.where(in_window, base * lat_mult, base)
+            add_series(m_idx, 'instance="host"', -1, base)
+
+    svc_names = tuple(services)
+    return MetricBatch(
+        metric=np.concatenate(metric_col),
+        series=np.concatenate(series_col),
+        t_s=np.concatenate(t_col),
+        value=np.concatenate(v_col),
+        metric_names=tuple(names),
+        series_keys=tuple(series_keys),
+        series_service=np.array(series_service, np.int32),
+        services=svc_names,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Logs, API responses, coverage
+# ---------------------------------------------------------------------------
+
+def generate_logs(label: FaultLabel, lines_per_service: int = 400,
+                  seed: Optional[int] = None,
+                  base_time_s: float = 1.7621800e9) -> Tuple[LogBatch, List[LogSummary]]:
+    if seed is None:
+        seed = _seed_for(label.experiment, 3)
+    rng = np.random.default_rng(seed)
+    services, _, _ = _topology(label.testbed)
+    svc_col, t_col, lvl_col = [], [], []
+    summaries = []
+    host_level = label.is_anomaly and label.target_service not in services
+    for s, svc in enumerate(services):
+        n = int(lines_per_service * rng.uniform(0.5, 2.0))
+        tt = base_time_s + np.sort(rng.uniform(0, 1800, n))
+        culprit = label.is_anomaly and (host_level or label.target_service == svc)
+        # elevated error rate only inside the shared anomaly window [600,1200)s
+        in_window = (tt - base_time_s >= 600) & (tt - base_time_s < 1200)
+        p_err = np.where(culprit & in_window, 0.35 if not host_level else 0.12, 0.01)
+        r = rng.random(n)
+        lvl = np.where(r < p_err, LOG_ERROR,
+                       np.where(r < p_err + 0.05, LOG_WARN, LOG_INFO)).astype(np.int8)
+        svc_col.append(np.full(n, s, np.int32))
+        t_col.append(tt)
+        lvl_col.append(lvl)
+        summaries.append(LogSummary(
+            service=svc, n_lines=n,
+            n_error=int((lvl == LOG_ERROR).sum()),
+            n_warn=int((lvl == LOG_WARN).sum()),
+            n_info=int((lvl == LOG_INFO).sum()),
+            size_bytes=n * 120))
+    return LogBatch(
+        service=np.concatenate(svc_col), t_s=np.concatenate(t_col),
+        level=np.concatenate(lvl_col), services=tuple(services),
+    ), summaries
+
+
+def generate_api(label: FaultLabel, n_records: int = 600,
+                 seed: Optional[int] = None,
+                 base_time_s: float = 1.7621800e9) -> ApiBatch:
+    if seed is None:
+        seed = _seed_for(label.experiment, 4)
+    rng = np.random.default_rng(seed)
+    if label.testbed == "SN":
+        eps = SN_API_ENDPOINTS
+    else:
+        eps = tuple(f"/api/v1/{s.replace('ts-', '').replace('-service', '')}service"
+                    for s in TT_SERVICES[:20])
+    lat_mult, err_p = _fault_effects(label)
+    ep = rng.integers(0, len(eps), n_records).astype(np.int32)
+    t = base_time_s + np.sort(rng.uniform(0, 1800, n_records))
+    lat = rng.lognormal(np.log(40.0), 0.5, n_records).astype(np.float32)
+    status = np.full(n_records, 200, np.int16)
+    if label.is_anomaly:
+        affected = rng.random(n_records) < min(err_p + 0.05, 0.6)
+        in_window = (t - t[0] >= 600) & (t - t[0] < 1200)
+        affected &= in_window
+        lat = np.where(affected, lat * lat_mult, lat).astype(np.float32)
+        status = np.where(affected & (rng.random(n_records) < err_p), 500, status)
+    clen = rng.integers(64, 4096, n_records).astype(np.int32)
+    return ApiBatch(endpoint=ep, t_s=t, status=status.astype(np.int16),
+                    latency_ms=lat, content_length=clen, endpoints=eps)
+
+
+def generate_coverage(label: FaultLabel, files_per_service: int = 6,
+                      seed: Optional[int] = None) -> CoverageBatch:
+    if seed is None:
+        seed = _seed_for(label.experiment, 5)
+    rng = np.random.default_rng(seed)
+    services, _, _ = _topology(label.testbed)
+    files: List[FileCoverage] = []
+    for svc in services:
+        for i in range(files_per_service):
+            total = int(rng.integers(50, 800))
+            ratio = rng.uniform(0.3, 0.7)
+            if label.is_anomaly and label.target_service == svc:
+                # injected faults shift executed paths on the culprit
+                ratio = max(0.05, ratio - 0.15)
+            ext = "cpp" if label.testbed == "SN" else "java"
+            files.append(FileCoverage(
+                service=svc, path=f"src/{svc}/file_{i}.{ext}",
+                lines_total=total, lines_covered=int(total * ratio)))
+    return coverage_batch_from_files(files)
+
+
+def generate_experiment(label_or_name, n_traces: int = 200,
+                        seed: Optional[int] = None) -> Experiment:
+    """Generate a full five-modality experiment bundle."""
+    if isinstance(label_or_name, str):
+        label = labels_mod.label_for(label_or_name)
+        if label is None:
+            raise KeyError(f"unknown experiment: {label_or_name}")
+    else:
+        label = label_or_name
+    logs, summaries = generate_logs(label, seed=seed)
+    return Experiment(
+        name=label.experiment, testbed=label.testbed,
+        spans=generate_spans(label, n_traces=n_traces, seed=seed),
+        metrics=generate_metrics(label, seed=seed),
+        logs=logs, log_summaries=summaries,
+        api=generate_api(label, seed=seed),
+        coverage=generate_coverage(label, seed=seed),
+        synthetic=True,
+    )
+
+
+def generate_corpus(testbed: str, n_traces: int = 200) -> List[Experiment]:
+    """All 13 experiments (12 faults + normal) for one testbed — the synthetic
+    mirror of the shipped SN_data/TT_data trees."""
+    return [generate_experiment(l, n_traces=n_traces)
+            for l in labels_mod.labels_for_testbed(testbed)]

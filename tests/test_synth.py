@@ -1,0 +1,159 @@
+"""Synthetic generator: determinism, schema validity, fault conditioning."""
+
+import numpy as np
+import pytest
+
+from anomod import labels, synth
+from anomod.schemas import KIND_ENTRY, concat_span_batches
+
+
+def test_labels_cover_both_testbeds():
+    assert len(labels.SN_LABELS) == 13
+    assert len(labels.TT_LABELS) == 13
+    assert sum(l.is_anomaly for l in labels.SN_LABELS) == 12
+    assert sum(l.is_anomaly for l in labels.TT_LABELS) == 12
+    # every anomaly level appears 3x per testbed
+    for tb in ("SN", "TT"):
+        lv = [l.anomaly_level for l in labels.anomalous_labels(tb)]
+        for level in ("performance", "service", "database", "code"):
+            assert lv.count(level) == 3, (tb, level)
+
+
+def test_canonical_experiment_names():
+    assert labels.canonical_experiment(
+        "Lv_P_CPU_preserve_20251103T140939Z_em") == "Lv_P_CPU_preserve"
+    assert labels.canonical_experiment(
+        "Perf_CPU_Contention_20251103_222601_traces_2025-11-03_22-46-44"
+    ) == "Perf_CPU_Contention"
+    assert labels.label_for(
+        "Normal_Baseline_20251103_220228_metrics_2025-11-03_22-22-55"
+    ).anomaly_level == "normal"
+
+
+def test_spans_deterministic():
+    l = labels.label_for("Lv_P_CPU_preserve")
+    a = synth.generate_spans(l, n_traces=50)
+    b = synth.generate_spans(l, n_traces=50)
+    np.testing.assert_array_equal(a.start_us, b.start_us)
+    np.testing.assert_array_equal(a.parent, b.parent)
+    assert a.services == b.services
+
+
+def test_spans_valid_structure():
+    for name in ("Normal_case", "Lv_S_HTTPABORT_preserve", "Normal_Baseline",
+                 "Svc_Kill_Media"):
+        l = labels.label_for(name)
+        b = synth.generate_spans(l, n_traces=30).validate()
+        assert b.n_spans > 30
+        # parents precede or equal structure: parent service differs or same
+        roots = (b.parent == -1)
+        assert roots.sum() == 30  # one root per trace
+        # every non-root's parent belongs to the same trace
+        nz = ~roots
+        assert (b.trace[nz] == b.trace[b.parent[nz]]).all()
+        # start times sorted
+        assert (np.diff(b.start_us) >= 0).all()
+
+
+def _window_mask(batch):
+    # fault effects live in the shared anomaly window [600, 1200)s
+    base = batch.start_us.min()
+    rel = batch.start_us - base
+    return (rel >= 600_000_000) & (rel < 1_200_000_000)
+
+
+def test_fault_conditioning_latency():
+    norm = synth.generate_spans(labels.label_for("Normal_case"), n_traces=120)
+    cpu = synth.generate_spans(labels.label_for("Lv_P_CPU_preserve"), n_traces=120)
+    tgt = cpu.services.index("ts-preserve-service")
+    m_norm = norm.duration_us[norm.service == tgt].mean()
+    w = _window_mask(cpu)
+    m_cpu = cpu.duration_us[(cpu.service == tgt) & w].mean()
+    m_cpu_out = cpu.duration_us[(cpu.service == tgt) & ~w].mean()
+    assert m_cpu > 3 * m_norm
+    assert m_cpu > 3 * m_cpu_out  # effect confined to the window
+
+
+def test_fault_conditioning_errors():
+    ab = synth.generate_spans(labels.label_for("Lv_S_HTTPABORT_preserve"),
+                              n_traces=120)
+    tgt = ab.services.index("ts-preserve-service")
+    w = _window_mask(ab)
+    err_rate = ab.is_error[(ab.service == tgt) & w].mean()
+    other = ab.is_error[(ab.service != tgt) & w].mean()
+    assert err_rate > 0.4
+    assert err_rate > 3 * other
+
+
+def test_fault_signal_survives_tt_metric_truncation():
+    # target services beyond the first-12 truncation still get series
+    m = synth.generate_metrics(labels.label_for("Lv_D_TRANSACTION_timeout"))
+    tgt = m.services.index("ts-order-service")
+    assert (m.series_service == tgt).any()
+
+
+def test_host_level_fault_has_log_signal():
+    cpu, _ = synth.generate_logs(labels.label_for("Perf_CPU_Contention"))
+    norm, _ = synth.generate_logs(labels.label_for("Normal_Baseline"))
+    from anomod.schemas import LOG_ERROR
+    assert (cpu.level == LOG_ERROR).mean() > 3 * (norm.level == LOG_ERROR).mean()
+
+
+def test_metrics_cpu_fault_sanity():
+    # reference sanity check: CPU fault drives system cpu >90%
+    # (SN_collection-scripts/README.md:106)
+    m = synth.generate_metrics(labels.label_for("Perf_CPU_Contention"))
+    cpu_idx = m.metric_names.index("system_cpu_usage")
+    vals = m.value[m.metric == cpu_idx]
+    assert vals.max() > 90
+    norm = synth.generate_metrics(labels.label_for("Normal_Baseline"))
+    nvals = norm.value[norm.metric == cpu_idx]
+    assert nvals.max() < 90
+
+
+def test_full_experiment_bundle():
+    exp = synth.generate_experiment("Lv_C_exception_injection", n_traces=20)
+    assert exp.testbed == "TT"
+    assert exp.spans.n_spans > 0
+    assert exp.metrics.n_samples > 0
+    assert exp.logs.n_lines > 0
+    assert exp.api.n_records == 600
+    assert exp.coverage.service_ratio().shape[0] == len(synth.TT_SERVICES)
+    assert len(exp.log_summaries) == len(synth.TT_SERVICES)
+
+
+def test_concat_batches():
+    a = synth.generate_spans(labels.label_for("Normal_case"), n_traces=10)
+    b = synth.generate_spans(labels.label_for("Lv_D_cachelimit"), n_traces=10)
+    c = concat_span_batches([a, b])
+    assert c.n_spans == a.n_spans + b.n_spans
+    assert c.n_traces == 20
+    # parent indices remain within-trace
+    nz = c.parent >= 0
+    assert (c.trace[nz] == c.trace[c.parent[nz]]).all()
+
+
+def test_skywalking_json_roundtrip_schema():
+    l = labels.label_for("Lv_P_CPU_preserve")
+    b = synth.generate_spans(l, n_traces=5)
+    doc = synth.spans_to_skywalking_json(b, l.experiment)
+    assert doc["metadata"]["span_count"] == b.n_spans
+    assert len(doc["traces"]) == 5
+    sp = doc["traces"][0]["spans"][0]
+    for key in ("node_id", "trace_id", "segment_id", "span_id", "parent_span_id",
+                "service_code", "start_timestamp_ms", "end_timestamp_ms",
+                "duration_ms", "endpoint_name", "type", "is_error", "refs"):
+        assert key in sp
+
+
+def test_jaeger_json_schema():
+    l = labels.label_for("Normal_Baseline")
+    b = synth.generate_spans(l, n_traces=5)
+    doc = synth.spans_to_jaeger_json(b)
+    assert len(doc["data"]) == 5
+    tr = doc["data"][0]
+    assert "processes" in tr and "spans" in tr
+    sp = tr["spans"][0]
+    for key in ("traceID", "spanID", "processID", "operationName",
+                "startTime", "duration", "references", "tags"):
+        assert key in sp
