@@ -1,0 +1,83 @@
+"""API-response JSONL loader → ApiBatch.
+
+Record contract (enhanced_openapi_monitor.py:155-169): one JSON object per
+line with ``timestamp`` (ISO), ``endpoint``, ``method``, ``status_code``,
+``latency_ms``, ``content_length``, ...  SN layout:
+``<exp>/openapi_responses.jsonl``; TT layout: ``<exp>/<YYYYMMDD>/api_responses.jsonl``.
+"""
+
+from __future__ import annotations
+
+import json
+from datetime import datetime
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from anomod.io.lfs import is_lfs_pointer
+from anomod.schemas import ApiBatch
+
+
+def _ts(s) -> float:
+    if isinstance(s, (int, float)):
+        return float(s)
+    try:
+        return datetime.fromisoformat(str(s)).timestamp()
+    except ValueError:
+        return 0.0
+
+
+def load_api_jsonl(path: Path) -> Optional[ApiBatch]:
+    path = Path(path)
+    if not path.is_file() or is_lfs_pointer(path):
+        return None
+    endpoints: Dict[str, int] = {}
+    ep_c: List[int] = []
+    t_c: List[float] = []
+    st_c: List[int] = []
+    lat_c: List[float] = []
+    cl_c: List[int] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            ep_c.append(endpoints.setdefault(str(rec.get("endpoint", "")), len(endpoints)))
+            t_c.append(_ts(rec.get("timestamp", 0)))
+            st_c.append(int(rec.get("status_code", 0) or 0))
+            lat_c.append(float(rec.get("latency_ms", 0) or 0))
+            cl_c.append(int(rec.get("content_length", 0) or 0))
+    if not ep_c:
+        return None
+    return ApiBatch(
+        endpoint=np.array(ep_c, np.int32), t_s=np.array(t_c, np.float64),
+        status=np.array(st_c, np.int16), latency_ms=np.array(lat_c, np.float32),
+        content_length=np.array(cl_c, np.int32), endpoints=tuple(endpoints))
+
+
+def find_api_artifact(exp_dir: Path) -> Optional[Path]:
+    exp_dir = Path(exp_dir)
+    p = exp_dir / "openapi_responses.jsonl"           # SN
+    if p.is_file():
+        return p
+    cands = sorted(exp_dir.glob("*/api_responses.jsonl"))  # TT date subdir
+    return cands[-1] if cands else None
+
+
+def write_api_jsonl(batch: ApiBatch, path: Path) -> None:
+    """Materialize an ApiBatch in the reference JSONL shape."""
+    with open(path, "w") as f:
+        for i in range(batch.n_records):
+            f.write(json.dumps({
+                "timestamp": datetime.fromtimestamp(float(batch.t_s[i])).isoformat(),
+                "endpoint": batch.endpoints[int(batch.endpoint[i])],
+                "method": "GET",
+                "status_code": int(batch.status[i]),
+                "latency_ms": round(float(batch.latency_ms[i]), 2),
+                "content_length": int(batch.content_length[i]),
+            }) + "\n")

@@ -1,0 +1,146 @@
+"""Dataset discovery + experiment loading with synthetic fallback.
+
+Archive layout (SURVEY.md §2.3 / L7):
+  SN_data/{log,metric,trace,coverage}_data + api_responses, experiment dirs
+  named ``<Exp>_<YYYYMMDD_HHMMSS>_<modality>_<...>`` (collect_all_data.sh:207-211).
+  TT_data/{log,metric,trace,api_responses,coverage_data,coverage_report}
+  with dirs named ``<Lv_*|Normal_case>_<ISO8601>_em`` (T-Dataset/README.md:9-17).
+
+Every payload that is a git-LFS pointer stub falls back to the deterministic
+synthetic generator (config.synth_on_lfs), keeping the full 2x13-experiment
+corpus loadable from the shipped checkout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from anomod import labels as labels_mod
+from anomod import synth
+from anomod.config import Config, get_config
+from anomod.io import api as api_io
+from anomod.io import coverage as cov_io
+from anomod.io import logs as logs_io
+from anomod.io import metrics as met_io
+from anomod.io import sn_traces, tt_traces
+from anomod.schemas import Experiment
+
+_SN_MODALITY_DIRS = {
+    "traces": "trace_data", "metrics": "metric_data", "logs": "log_data",
+    "api": "api_responses", "coverage": "coverage_data",
+}
+_TT_MODALITY_DIRS = {
+    "traces": "trace_data", "metrics": "metric_data", "logs": "log_data",
+    "api": "api_responses", "coverage": "coverage_report",
+}
+
+
+@dataclasses.dataclass
+class ExperimentDirs:
+    name: str                      # canonical experiment name
+    testbed: str
+    dirs: Dict[str, Path]          # modality -> experiment dir
+
+
+def discover(testbed: str, cfg: Optional[Config] = None) -> List[ExperimentDirs]:
+    """Walk the archive tree, grouping modality dirs by canonical experiment."""
+    cfg = cfg or get_config()
+    root = cfg.sn_data if testbed == "SN" else cfg.tt_data
+    modality_dirs = _SN_MODALITY_DIRS if testbed == "SN" else _TT_MODALITY_DIRS
+    found: Dict[str, ExperimentDirs] = {}
+    for modality, sub in modality_dirs.items():
+        base = root / sub
+        if not base.is_dir():
+            continue
+        for d in sorted(base.iterdir()):
+            if not d.is_dir():
+                continue
+            canon = labels_mod.canonical_experiment(d.name)
+            if labels_mod.label_for(canon) is None:
+                continue
+            ed = found.setdefault(canon, ExperimentDirs(canon, testbed, {}))
+            ed.dirs.setdefault(modality, d)
+    return list(found.values())
+
+
+def load_experiment(name: str, testbed: Optional[str] = None,
+                    cfg: Optional[Config] = None,
+                    modalities: Optional[List[str]] = None,
+                    n_synth_traces: int = 200) -> Experiment:
+    """Load one experiment's modalities; synth-fill anything unavailable."""
+    cfg = cfg or get_config()
+    label = labels_mod.label_for(name)
+    if label is None:
+        raise KeyError(f"unknown experiment: {name}")
+    testbed = testbed or label.testbed
+    modalities = modalities or ["traces", "metrics", "logs", "api", "coverage"]
+    dirs = {e.name: e for e in discover(testbed, cfg)}.get(label.experiment)
+    exp = Experiment(name=label.experiment, testbed=testbed)
+    any_synth = False
+
+    d = dirs.dirs if dirs else {}
+    if "traces" in modalities:
+        if "traces" in d:
+            if testbed == "TT":
+                art = tt_traces.find_trace_artifact(d["traces"])
+                exp.spans = tt_traces.load_skywalking_json(art) if art else None
+            else:
+                art = sn_traces.find_trace_artifact(d["traces"])
+                if art and art.suffix == ".json":
+                    exp.spans = sn_traces.load_jaeger_json(art)
+                elif art:
+                    exp.spans = sn_traces.load_jaeger_csv(art)
+        if exp.spans is None and cfg.synth_on_lfs:
+            exp.spans = synth.generate_spans(label, n_traces=n_synth_traces)
+            any_synth = True
+
+    if "metrics" in modalities:
+        if "metrics" in d:
+            if testbed == "TT":
+                art = met_io.find_tt_metric_artifact(d["metrics"])
+                exp.metrics = met_io.load_tt_metric_csv(art) if art else None
+            else:
+                exp.metrics = met_io.load_sn_metric_dir(d["metrics"])
+        if exp.metrics is None and cfg.synth_on_lfs:
+            exp.metrics = synth.generate_metrics(label)
+            any_synth = True
+
+    if "logs" in modalities:
+        if "logs" in d:
+            loader = logs_io.load_tt_log_dir if testbed == "TT" else logs_io.load_sn_log_dir
+            exp.logs, exp.log_summaries = loader(d["logs"])
+        if exp.logs is None and cfg.synth_on_lfs:
+            exp.logs, syn_sum = synth.generate_logs(label)
+            if not exp.log_summaries:
+                exp.log_summaries = syn_sum
+            any_synth = True
+
+    if "api" in modalities:
+        if "api" in d:
+            art = api_io.find_api_artifact(d["api"])
+            exp.api = api_io.load_api_jsonl(art) if art else None
+        if exp.api is None and cfg.synth_on_lfs:
+            exp.api = synth.generate_api(label)
+            any_synth = True
+
+    if "coverage" in modalities:
+        if "coverage" in d:
+            loader = (cov_io.load_tt_coverage_report if testbed == "TT"
+                      else cov_io.load_sn_coverage_dir)
+            exp.coverage = loader(d["coverage"])
+        if exp.coverage is None and cfg.synth_on_lfs:
+            exp.coverage = synth.generate_coverage(label)
+            any_synth = True
+
+    exp.synthetic = any_synth
+    return exp
+
+
+def load_corpus(testbed: str, cfg: Optional[Config] = None,
+                modalities: Optional[List[str]] = None,
+                n_synth_traces: int = 200) -> List[Experiment]:
+    """All 13 experiments of a testbed (12 faults + normal)."""
+    return [load_experiment(l.experiment, testbed, cfg, modalities, n_synth_traces)
+            for l in labels_mod.labels_for_testbed(testbed)]

@@ -108,9 +108,15 @@ _TT_TS = re.compile(r"_\d{8}T\d{6}Z(_em)?.*$")
 
 
 def canonical_experiment(dir_name: str) -> str:
-    """Strip timestamp/modality suffixes from an experiment directory name."""
+    """Strip timestamp/modality suffixes from an experiment directory name.
+
+    Handles both suffix orders: ``<Base>_<ts>_em`` (anomalies) and
+    ``Normal_case_em_<ts>`` (run_all_experiments.sh:554-555 vs :447).
+    """
     base = _TT_TS.sub("", dir_name)
     base = _SN_TS.sub("", base)
+    if base.endswith("_em"):
+        base = base[:-3]
     return base
 
 
