@@ -1,0 +1,154 @@
+"""Anomaly detection + root-cause ranking (the minimum end-to-end slice).
+
+Per-service p99-latency inflation z-scores against the normal baseline,
+fused with span error rates and log error rates — the quantitative version of
+the reference's manual sanity checks (SN_collection-scripts/README.md:104-106:
+"CPU fault ⇒ system_cpu_usage > 90%", error plateaus, etc.).  The numpy path
+is the correctness oracle (BASELINE.json config 1); the JAX path is the same
+expression tree on device.
+
+Evaluation uses the chaos ground-truth labels (anomod.labels): top-k hit-rate
+of the culprit service over the 2x12 fault experiments, plus experiment-level
+detection accuracy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from anomod import backend as backend_mod
+from anomod import labels as labels_mod
+from anomod.graph import ServiceStats, service_stats
+from anomod.schemas import Experiment, LOG_ERROR
+
+
+class ServiceFeatures(NamedTuple):
+    """Per-service feature matrix for one experiment — fixed [S, F] shape."""
+    services: Tuple[str, ...]
+    x: np.ndarray  # float32 [S, F]
+
+
+FEATURES = ("lat_p99_log", "lat_p50_log", "err_rate", "log_err_rate",
+            "span_count_log", "lat_mean_log")
+
+
+def extract_features(exp: Experiment,
+                     services: Tuple[str, ...]) -> ServiceFeatures:
+    """[S, F] features from spans + logs (metric features join in anomod.fuse)."""
+    S = len(services)
+    st = service_stats(exp.spans, services) if exp.spans is not None else None
+    x = np.zeros((S, len(FEATURES)), np.float32)
+    if st is not None:
+        x[:, 0] = np.log1p(st.lat_p99_us)
+        x[:, 1] = np.log1p(st.lat_p50_us)
+        x[:, 2] = st.err_rate
+        x[:, 4] = np.log1p(st.count)
+        x[:, 5] = np.log1p(st.lat_mean_us)
+    if exp.logs is not None:
+        svc_index = {s: i for i, s in enumerate(services)}
+        remap = np.array([svc_index.get(s, -1) for s in exp.logs.services] or [-1],
+                         np.int32)
+        svc = remap[exp.logs.service]
+        keep = svc >= 0
+        tot = np.zeros(S, np.int64)
+        err = np.zeros(S, np.int64)
+        np.add.at(tot, svc[keep], 1)
+        np.add.at(err, svc[keep], (exp.logs.level[keep] == LOG_ERROR).astype(np.int64))
+        with np.errstate(invalid="ignore"):
+            x[:, 3] = np.where(tot > 0, err / np.maximum(tot, 1), 0.0)
+    return ServiceFeatures(services=services, x=x)
+
+
+# Score weights: latency inflation, error-rate delta, log-error delta.
+_W_LAT, _W_ERR, _W_LOG = 1.0, 4.0, 2.0
+
+
+def service_scores(feat: np.ndarray, base: np.ndarray,
+                   backend: Optional[str] = None):
+    """Anomaly score per service vs the normal-baseline feature matrix.
+
+    score = w_lat * log-p99 inflation + w_err * Δerr_rate + w_log * Δlog_err.
+    Pure function of two [S, F] arrays — identical under numpy and jax.numpy.
+    """
+    xp = backend_mod.xp(backend)
+    feat = xp.asarray(feat)
+    base = xp.asarray(base)
+    lat_infl = xp.clip(feat[:, 0] - base[:, 0], 0.0, None)
+    d_err = xp.clip(feat[:, 2] - base[:, 2], 0.0, None)
+    d_log = xp.clip(feat[:, 3] - base[:, 3], 0.0, None)
+    # evidence shrinkage: a p99/err estimate from a handful of spans is noise;
+    # weight by n/(n+k) using the span counts carried in feature col 4 (log1p)
+    n = xp.expm1(feat[:, 4])
+    conf = n / (n + 20.0)
+    return conf * (_W_LAT * lat_infl + _W_ERR * d_err) + _W_LOG * d_log
+
+
+def experiment_score(scores) -> float:
+    """Experiment-level anomaly score = max service score."""
+    return float(np.max(backend_mod.to_host(scores))) if np.size(scores) else 0.0
+
+
+@dataclasses.dataclass
+class DetectionResult:
+    experiment: str
+    is_anomaly_true: bool
+    score: float
+    ranked_services: List[str]       # descending culprit likelihood
+    target_service: str
+
+    def hit(self, k: int) -> Optional[bool]:
+        if not self.target_service:
+            return None  # host-level fault: no single culprit service
+        return self.target_service in self.ranked_services[:k]
+
+
+@dataclasses.dataclass
+class EvalSummary:
+    top1: float
+    top3: float
+    top5: float
+    detection_accuracy: float
+    n_rca_cases: int
+    results: List[DetectionResult]
+
+
+def evaluate_corpus(experiments: Sequence[Experiment],
+                    backend: Optional[str] = None,
+                    threshold: float = 0.35) -> EvalSummary:
+    """Run detector over a 13-experiment corpus; eval vs chaos labels."""
+    normal = next(e for e in experiments
+                  if labels_mod.label_for(e.name).anomaly_level == "normal")
+    testbed = normal.testbed
+    # pinned service set: union across corpus, stable order
+    services: Dict[str, None] = {}
+    for e in experiments:
+        if e.spans is not None:
+            for s in e.spans.services:
+                services.setdefault(s)
+    services = tuple(services)
+
+    base = extract_features(normal, services).x
+    results: List[DetectionResult] = []
+    for e in experiments:
+        label = labels_mod.label_for(e.name)
+        feat = extract_features(e, services).x
+        scores = backend_mod.to_host(service_scores(feat, base, backend))
+        order = np.argsort(-scores, kind="stable")
+        results.append(DetectionResult(
+            experiment=e.name,
+            is_anomaly_true=label.is_anomaly,
+            score=experiment_score(scores),
+            ranked_services=[services[i] for i in order],
+            target_service=label.target_service,
+        ))
+
+    det_correct = sum((r.score > threshold) == r.is_anomaly_true for r in results)
+    rca = [r for r in results if r.is_anomaly_true and r.target_service]
+    def rate(k: int) -> float:
+        return (sum(bool(r.hit(k)) for r in rca) / len(rca)) if rca else 0.0
+    return EvalSummary(top1=rate(1), top3=rate(3), top5=rate(5),
+                       detection_accuracy=det_correct / len(results),
+                       n_rca_cases=len(rca), results=results)

@@ -1,0 +1,7 @@
+"""Device-mesh parallelism: sharded replay, collectives, mesh helpers."""
+
+from anomod.parallel.mesh import make_mesh, shard_chunks
+from anomod.parallel.replay import make_sharded_replay_fn, sharded_throughput
+
+__all__ = ["make_mesh", "shard_chunks", "make_sharded_replay_fn",
+           "sharded_throughput"]

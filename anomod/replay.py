@@ -1,0 +1,185 @@
+"""Sharded span-stream replay: the TPU feature-extraction hot path.
+
+The reference's richest data path is trace ingestion — paginated fetch, then
+per-span Python graph building (trace_collector.py:296-547).  The TPU-native
+equivalent replays an experiment corpus *as data*: span columns staged into
+HBM, then a jitted scan over fixed-size chunks computes windowed per-service
+aggregates (count / errors / latency moments / log-latency histogram) on the
+MXU.  Throughput (spans/sec/chip) is the headline benchmark
+(BASELINE.json: ≥1M spans/sec/chip on TT_data replay).
+
+Design notes (TPU-first):
+  - static shapes: spans padded to chunk multiples; windows/services fixed.
+  - the scatter-heavy aggregation is expressed as one-hot matmuls (MXU) for
+    the [S*W] aggregate plane and a segment histogram over log-latency
+    buckets — fused by XLA into a handful of kernels.
+  - per-chip state is tiny (S*W*F + S*W*H floats), so the multi-chip replay
+    shards the span stream and psum-merges state (anomod.parallel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from anomod.schemas import SpanBatch
+
+F_COUNT, F_ERR, F_LAT, F_LOGLAT, F_LOGLAT2, F_STATUS5XX = range(6)
+N_FEATS = 6
+
+
+class ReplayState(NamedTuple):
+    agg: "object"    # [S*W, F] float32
+    hist: "object"   # [S*W, H] float32 — log2-latency histogram
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplayConfig:
+    n_services: int
+    n_windows: int = 32
+    n_hist_buckets: int = 16
+    chunk_size: int = 1 << 15
+    window_us: int = 60_000_000  # 60 s windows
+
+    @property
+    def sw(self) -> int:
+        return self.n_services * self.n_windows
+
+
+def stage_columns(batch: SpanBatch, cfg: ReplayConfig, t0_us: Optional[int] = None):
+    """Host-side packing: SpanBatch -> padded int32/float32 chunk arrays."""
+    n = batch.n_spans
+    t0 = int(batch.start_us.min()) if t0_us is None and n else (t0_us or 0)
+    window = np.minimum((batch.start_us - t0) // cfg.window_us,
+                        cfg.n_windows - 1).astype(np.int32)
+    window = np.maximum(window, 0)
+    pad = (-n) % cfg.chunk_size
+    def p(a, fill=0):
+        return np.pad(a, (0, pad), constant_values=fill)
+    cols = dict(
+        sid=p(batch.service.astype(np.int32) * cfg.n_windows + window,
+              fill=cfg.sw),  # padding rows target a dead segment
+        dur=p(np.log1p(batch.duration_us.astype(np.float32))),
+        dur_raw=p(batch.duration_us.astype(np.float32)),
+        err=p(batch.is_error.astype(np.float32)),
+        s5=p((batch.status >= 500).astype(np.float32)),
+        valid=p(np.ones(n, np.float32)),
+    )
+    n_chunks = (n + pad) // cfg.chunk_size
+    return {k: v.reshape(n_chunks, cfg.chunk_size) for k, v in cols.items()}, n
+
+
+def make_replay_fn(cfg: ReplayConfig):
+    """Build the jitted replay: scan over chunks, one-hot matmul aggregation."""
+    import jax
+    import jax.numpy as jnp
+
+    SW = cfg.sw
+    H = cfg.n_hist_buckets
+
+    def chunk_step(state: ReplayState, chunk):
+        sid = chunk["sid"]                    # [C] int32, SW = padding
+        # features [C, F]
+        feats = jnp.stack([
+            chunk["valid"], chunk["err"], chunk["dur_raw"],
+            chunk["dur"], chunk["dur"] * chunk["dur"], chunk["s5"],
+        ], axis=1)
+        # one-hot [C, SW+1] — pad lane absorbs padding rows, dropped after.
+        # HIGHEST precision: on TPU the default bf16 matmul would round the
+        # µs-scale latency sums (and exact counts) to 8 mantissa bits.
+        onehot = jax.nn.one_hot(sid, SW + 1, dtype=jnp.float32)
+        agg = state.agg + jnp.matmul(
+            onehot.T, feats, precision=jax.lax.Precision.HIGHEST)[:SW]
+        # log-latency histogram as a second MXU matmul instead of a scatter:
+        # hist[s, h] += Σ_c 1[sid=c]·1[bucket=h]  =  (onehotᵀ @ bucket_onehot)
+        bucket = jnp.clip(chunk["dur"].astype(jnp.int32), 0, H - 1)
+        bucket_oh = jax.nn.one_hot(bucket, H, dtype=jnp.float32)
+        bucket_oh = bucket_oh * chunk["valid"][:, None]
+        hist = state.hist + jnp.matmul(
+            onehot.T, bucket_oh, precision=jax.lax.Precision.HIGHEST)[:SW]
+        return ReplayState(agg=agg, hist=hist), None
+
+    def replay(chunks):
+        state = ReplayState(
+            agg=jnp.zeros((SW, N_FEATS), jnp.float32),
+            hist=jnp.zeros((SW, H), jnp.float32))
+        state, _ = jax.lax.scan(chunk_step, state, chunks)
+        return state
+
+    return jax.jit(replay)
+
+
+def replay_numpy(chunks, cfg: ReplayConfig) -> ReplayState:
+    """CPU oracle for the replay aggregation."""
+    SW, H = cfg.sw, cfg.n_hist_buckets
+    agg = np.zeros((SW, N_FEATS), np.float32)
+    hist = np.zeros((SW, H), np.float32)
+    sid = chunks["sid"].reshape(-1)
+    valid = chunks["valid"].reshape(-1) > 0
+    sid = sid[valid]
+    feats = np.stack([
+        chunks["valid"].reshape(-1)[valid],
+        chunks["err"].reshape(-1)[valid],
+        chunks["dur_raw"].reshape(-1)[valid],
+        chunks["dur"].reshape(-1)[valid],
+        (chunks["dur"] ** 2).reshape(-1)[valid],
+        chunks["s5"].reshape(-1)[valid],
+    ], axis=1)
+    np.add.at(agg, sid, feats.astype(np.float32))
+    bucket = np.clip(chunks["dur"].reshape(-1)[valid].astype(np.int32), 0, H - 1)
+    np.add.at(hist, (sid, bucket), 1.0)
+    return ReplayState(agg=agg, hist=hist)
+
+
+def percentile_from_hist(hist: np.ndarray, q: float) -> np.ndarray:
+    """Approx per-row percentile (in log1p-µs units) from the histogram."""
+    cum = np.cumsum(hist, axis=-1)
+    total = cum[..., -1:]
+    target = q * total
+    idx = (cum < target).sum(axis=-1)
+    return idx.astype(np.float32)  # bucket index ≈ log1p(duration_us)
+
+
+@dataclasses.dataclass
+class ThroughputResult:
+    n_spans: int
+    wall_s: float
+    spans_per_sec: float
+    compile_s: float
+
+
+def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
+                       repeats: int = 3, replicate: int = 1) -> ThroughputResult:
+    """Compile, warm up, then time the replay over the staged corpus.
+
+    Timing reads the aggregate state back to host each iteration — over a
+    tunneled device, ``block_until_ready`` alone returns before execution
+    finishes, so a host read-back is the only honest barrier.  ``replicate``
+    tiles the staged chunks to amortize the fixed dispatch/RPC overhead into
+    a steady-state number.
+    """
+    import jax
+    cfg = cfg or ReplayConfig(n_services=len(batch.services))
+    chunks_np, n = stage_columns(batch, cfg)
+    if replicate > 1:
+        chunks_np = {k: np.concatenate([v] * replicate) for k, v in chunks_np.items()}
+        n *= replicate
+    chunks = jax.device_put(chunks_np)
+    fn = make_replay_fn(cfg)
+    t0 = time.perf_counter()
+    np.asarray(fn(chunks).agg)
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(chunks)
+        total = float(np.asarray(out.agg)[:, F_COUNT].sum())  # host barrier
+        times.append(time.perf_counter() - t0)
+    assert int(total) == n, f"span count mismatch: {total} != {n}"
+    wall = sorted(times)[len(times) // 2]
+    return ThroughputResult(n_spans=n, wall_s=wall,
+                            spans_per_sec=n / wall, compile_s=compile_s)

@@ -1,0 +1,53 @@
+"""Sharded replay on the virtual 8-device CPU mesh: parity with single-chip."""
+
+import numpy as np
+import pytest
+
+from anomod import labels, synth
+from anomod.parallel import make_mesh, sharded_throughput
+from anomod.parallel.mesh import shard_chunks
+from anomod.replay import ReplayConfig, replay_numpy, stage_columns
+from anomod.schemas import concat_span_batches
+
+
+@pytest.fixture(scope="module")
+def batch():
+    return concat_span_batches([
+        synth.generate_spans(l, n_traces=30)
+        for l in labels.labels_for_testbed("TT")])
+
+
+def test_mesh_has_8_virtual_devices():
+    import jax
+    assert len(jax.devices()) == 8
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+
+
+def test_shard_chunks_preserves_rows(batch):
+    cfg = ReplayConfig(n_services=batch.n_services, chunk_size=512)
+    chunks, n = stage_columns(batch, cfg)
+    sh = shard_chunks(chunks, 8)
+    assert sh["sid"].shape[0] == 8
+    assert int(sh["valid"].sum()) == n
+
+
+def test_sharded_replay_matches_numpy(batch):
+    cfg = ReplayConfig(n_services=batch.n_services, chunk_size=512)
+    chunks, n = stage_columns(batch, cfg)
+    ref = replay_numpy(chunks, cfg)
+    mesh = make_mesh()
+    r = sharded_throughput(batch, mesh, cfg, repeats=1)
+    assert r.n_spans == n
+    # independently recompute the state for assertion
+    from anomod.parallel.replay import make_sharded_replay_fn
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharded = shard_chunks(chunks, 8)
+    flat = {k: v.reshape(-1, v.shape[-1]) for k, v in sharded.items()}
+    dev = {k: jax.device_put(v, NamedSharding(mesh, P("data")))
+           for k, v in flat.items()}
+    out = make_sharded_replay_fn(cfg, mesh)(dev)
+    np.testing.assert_allclose(np.asarray(out.agg), ref.agg, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(out.hist), ref.hist, rtol=1e-6)
+    assert int(np.asarray(out.agg)[:, 0].sum()) == batch.n_spans
