@@ -1,0 +1,8 @@
+"""TPU-friendly streaming sketches and segment ops (numpy oracle + JAX/Pallas)."""
+
+from anomod.ops.tdigest import (TDigest, tdigest_build, tdigest_merge,
+                                tdigest_quantile)
+from anomod.ops.hll import (hll_add, hll_estimate, hll_merge, hll_init)
+
+__all__ = ["TDigest", "tdigest_build", "tdigest_merge", "tdigest_quantile",
+           "hll_add", "hll_estimate", "hll_merge", "hll_init"]

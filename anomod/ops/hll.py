@@ -1,0 +1,96 @@
+"""HyperLogLog with int32-native hashing — TPU-friendly cardinality sketch.
+
+2^p registers of max leading-zero rank; updates are a segment-max, merges are
+elementwise max (so shard states combine with ``lax.pmax`` over ICI — exactly
+associative, unlike the t-digest).  Hashing sticks to uint32 ops (TPU has no
+fast 64-bit int path): two rounds of a murmur3-style avalanche.
+
+Used for distinct-count featurization over span/metric streams (distinct
+trace ids per service, distinct endpoints per edge, ...) — capability-new vs
+the reference, which counts exact sets in Python (collect_trace.sh:54-58 jq
+dedup; trace_collector.py:358-360 set()).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+_ALPHA = {16: 0.673, 32: 0.697, 64: 0.709}
+
+
+def _alpha(m: int) -> float:
+    return _ALPHA.get(m, 0.7213 / (1.0 + 1.079 / m))
+
+
+def _avalanche32(x, xp):
+    """murmur3 fmix32 — uint32 in/out."""
+    x = x.astype("uint32")
+    x = x ^ (x >> np.uint32(16))
+    x = (x * np.uint32(0x85EBCA6B)).astype("uint32")
+    x = x ^ (x >> np.uint32(13))
+    x = (x * np.uint32(0xC2B2AE35)).astype("uint32")
+    x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def _clz32(x, xp):
+    """Count leading zeros of uint32 via float trick (no native clz on VPU)."""
+    # log2 via float conversion is exact enough for rank (x>0)
+    xf = x.astype("float64") if xp is np else x.astype("float32")
+    fl = xp.floor(xp.log2(xp.where(xf > 0, xf, 1.0)))
+    return xp.where(x > 0, 31 - fl.astype("int32"), np.int32(32))
+
+
+def hll_init(p: int = 12, lanes: Optional[int] = None, xp=np):
+    """Zeroed registers: [m] or [lanes, m] with m = 2^p."""
+    m = 1 << p
+    shape = (m,) if lanes is None else (lanes, m)
+    return xp.zeros(shape, dtype="int32")
+
+
+def hll_add(registers, items, p: int = 12, lane=None, xp=np):
+    """Add an int32 item batch. ``lane`` (optional, same shape as items)
+    scatters items into per-lane registers (e.g. per-service sketches)."""
+    items = xp.asarray(items).astype("uint32")
+    h = _avalanche32(items, xp)
+    bucket = (h >> np.uint32(32 - p)).astype("int32")
+    h2 = _avalanche32(h ^ np.uint32(0x9E3779B9), xp)
+    # rank: leading zeros (of the remaining bits) + 1, capped
+    rank = xp.minimum(_clz32(h2, xp) + 1, np.int32(32)).astype("int32")
+    m = 1 << p
+    if lane is None:
+        if xp is np:
+            out = registers.copy()
+            np.maximum.at(out, bucket, rank)
+            return out
+        return registers.at[bucket].max(rank)
+    flat = lane.astype("int64") * m + bucket.astype("int64") if xp is np else \
+        lane.astype("int32") * m + bucket
+    L = registers.shape[0]
+    if xp is np:
+        out = registers.copy().reshape(-1)
+        np.maximum.at(out, flat, rank)
+        return out.reshape(L, m)
+    # jax: scatter-max
+    out = registers.reshape(-1)
+    out = out.at[flat].max(rank)
+    return out.reshape(L, m)
+
+
+def hll_merge(a, b, xp=np):
+    return xp.maximum(a, b)
+
+
+def hll_estimate(registers, xp=np):
+    """Cardinality estimate with small-range (linear counting) correction."""
+    m = registers.shape[-1]
+    regs = registers.astype("float64" if xp is np else "float32")
+    inv = xp.sum(xp.power(2.0, -regs), axis=-1)
+    raw = _alpha(m) * m * m / inv
+    zeros = xp.sum((registers == 0).astype("int32"), axis=-1)
+    # linear counting when estimate is small and empty registers exist
+    lc = m * xp.log(m / xp.maximum(zeros, 1).astype(raw.dtype))
+    use_lc = (raw <= 2.5 * m) & (zeros > 0)
+    return xp.where(use_lc, lc, raw)

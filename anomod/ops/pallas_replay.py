@@ -1,0 +1,101 @@
+"""Pallas TPU kernel for the replay aggregation hot loop.
+
+Fuses one-hot construction + the two MXU matmuls of anomod.replay (windowed
+per-service feature aggregation and log-latency histogram) into a single
+kernel with VMEM-resident accumulator state across grid steps: the [SW, F+H]
+state never round-trips to HBM between chunks, and the one-hot tile lives
+only in VMEM.
+
+Grid: one step per span block (BLOCK rows).  Outputs use a constant index
+map so the same VMEM block accumulates across the whole grid (standard
+revisiting-output pattern); step 0 zero-initializes.
+
+Falls back to interpret mode off-TPU (used by the CPU-mesh tests).
+
+Status: measured 6.0e7 spans/sec/chip on v5e (30M-span corpus, block=8192) vs
+1.1e8 for the XLA scan path in anomod.replay — the [SW, F+H] output tile is
+too narrow to fill the MXU from inside one kernel, so the XLA path stays the
+bench default.  Kept as the tuning base for a double-buffered variant.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+
+def make_pallas_replay_fn(n_segments: int, n_feats: int, n_hist: int,
+                          block: int = 4096, interpret: bool = False):
+    """Returns fn(sid[N], feats[F,N], bucket[N]) -> agg[SW, F+H].
+
+    ``sid`` may contain n_segments (== dead/padding lane, dropped).
+    The histogram occupies the trailing H lanes of the output.
+    ``feats`` is feature-major [F, N]: a span-major [N, F] layout would be
+    lane-padded F->128 by XLA (21x HBM blowup at replay scale).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    SW1 = n_segments + 1          # + dead lane
+    FH = n_feats + n_hist
+
+    def kernel(sid_ref, feats_ref, bucket_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        sid = sid_ref[:]                       # [B] int32
+        feats = feats_ref[:].T                 # [F, B] block -> [B, F]
+        bucket = bucket_ref[:]                 # [B] int32
+        # one-hot over segments, [B, SW1] — VMEM-resident tile
+        seg_iota = jax.lax.broadcasted_iota(jnp.int32, (block, SW1), 1)
+        onehot = (seg_iota == sid[:, None]).astype(jnp.float32)
+        # histogram one-hot over buckets, [B, H]; valid = feats[:, 0]
+        h_iota = jax.lax.broadcasted_iota(jnp.int32, (block, n_hist), 1)
+        bucket_oh = (h_iota == bucket[:, None]).astype(jnp.float32)
+        bucket_oh = bucket_oh * feats[:, 0][:, None]
+        rhs = jnp.concatenate([feats, bucket_oh], axis=1)  # [B, F+H]
+        out_ref[:] += jax.lax.dot_general(
+            onehot, rhs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+
+    @jax.jit
+    def run(sid, feats, bucket):
+        n = sid.shape[0]
+        assert feats.shape == (n_feats, n), "feats must be feature-major [F, N]"
+        assert n % block == 0, f"span count {n} must be a multiple of {block}"
+        grid = (n // block,)
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block,), lambda i: (i,)),
+                pl.BlockSpec((n_feats, block), lambda i: (0, i)),
+                pl.BlockSpec((block,), lambda i: (i,)),
+            ],
+            out_specs=pl.BlockSpec((SW1, FH), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((SW1, FH), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=interpret,
+        )(sid, feats, bucket)
+        return out[:n_segments]  # drop the dead padding lane
+
+    return run
+
+
+def pallas_replay_numpy(sid, feats, bucket, n_segments, n_feats, n_hist):
+    """Oracle for the fused kernel (feats feature-major [F, N])."""
+    FH = n_feats + n_hist
+    out = np.zeros((n_segments + 1, FH), np.float32)
+    np.add.at(out[:, :n_feats], sid, feats.T)
+    valid = feats[0]
+    np.add.at(out, (sid, n_feats + np.clip(bucket, 0, n_hist - 1)), valid)
+    return out[:n_segments]

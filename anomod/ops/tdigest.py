@@ -1,0 +1,102 @@
+"""t-digest with fixed-capacity centroids — TPU-native design.
+
+Classic t-digests grow/shrink centroid lists dynamically; that's hostile to
+XLA (dynamic shapes).  This implementation keeps a **fixed K-centroid array**
+and rebuilds by sort + quantile-bucketing + segment reduction, which vmaps
+cleanly over (service, edge, metric) lanes and runs on the MXU/VPU:
+
+  build:  sort values → normalized rank q → centroid bucket via the t-digest
+          scale function k(q) = K·(asin(2q−1)/π + ½) → segment mean/weight.
+  merge:  concatenate centroid sets, weighted re-bucket by the same rule
+          (associative up to sketch error; shard states merge over ICI via
+          all_gather + rebuild).
+  query:  interpolated inverse of the cumulative-weight curve.
+
+The numpy path is the oracle; the jax path is identical math under jit/vmap.
+No reference counterpart exists (the reference computes exact percentiles in
+Python, enhanced_openapi_monitor.py:321-332) — this is the streaming-scale
+replacement.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+
+class TDigest(NamedTuple):
+    mean: "object"    # [..., K] float32 — centroid means (sorted)
+    weight: "object"  # [..., K] float32 — centroid weights (0 = empty slot)
+
+    @property
+    def capacity(self) -> int:
+        return self.mean.shape[-1]
+
+
+def _scale_bucket(q, k: int, xp):
+    """t-digest k1 scale function mapped to integer buckets [0, k)."""
+    z = xp.clip(2.0 * q - 1.0, -1.0, 1.0)
+    s = (xp.arcsin(z) / np.pi + 0.5) * k
+    return xp.clip(s.astype(np.int32) if xp is np else s.astype("int32"), 0, k - 1)
+
+
+def _segment_mean(bucket, values, weights, k: int, xp):
+    """Weighted per-bucket mean/weight via one-hot reductions (works for both
+    numpy and jax.numpy; jax lowers the one-hot matmul onto the MXU)."""
+    onehot = (bucket[..., None] == xp.arange(k)[None, :]).astype(values.dtype)
+    w = xp.sum(onehot * weights[..., None], axis=-2)
+    m = xp.sum(onehot * (weights * values)[..., None], axis=-2)
+    return xp.where(w > 0, m / xp.where(w > 0, w, 1.0), 0.0), w
+
+
+def tdigest_build(values, k: int = 64, weights=None, xp=np) -> TDigest:
+    """Build a K-centroid digest from a value batch (last axis reduced)."""
+    values = xp.asarray(values, dtype="float32" if xp is not np else np.float32)
+    n = values.shape[-1]
+    if weights is None:
+        weights = xp.ones_like(values)
+    order = xp.argsort(values, axis=-1)
+    v = xp.take_along_axis(values, order, axis=-1)
+    w = xp.take_along_axis(weights, order, axis=-1)
+    cum = xp.cumsum(w, axis=-1)
+    total = cum[..., -1:]
+    q = (cum - 0.5 * w) / xp.where(total > 0, total, 1.0)
+    bucket = _scale_bucket(q, k, xp)
+    mean, weight = _segment_mean(bucket, v, w, k, xp)
+    return TDigest(mean=mean, weight=weight)
+
+
+def tdigest_merge(a: TDigest, b: TDigest, xp=np) -> TDigest:
+    """Merge two digests (same capacity) by weighted rebuild."""
+    k = a.capacity
+    values = xp.concatenate([a.mean, b.mean], axis=-1)
+    weights = xp.concatenate([a.weight, b.weight], axis=-1)
+    return tdigest_build(values, k=k, weights=weights, xp=xp)
+
+
+def tdigest_merge_many(digests, xp=np) -> TDigest:
+    """Merge a leading axis of digests (e.g. all-gathered shard states)."""
+    mean = xp.concatenate([d.mean for d in digests], axis=-1)
+    weight = xp.concatenate([d.weight for d in digests], axis=-1)
+    return tdigest_build(mean, k=digests[0].capacity, weights=weight, xp=xp)
+
+
+def tdigest_quantile(d: TDigest, q, xp=np):
+    """Approximate quantile(s) by interpolating the centroid CDF."""
+    w = d.weight
+    total = xp.sum(w, axis=-1, keepdims=True)
+    cum = xp.cumsum(w, axis=-1) - 0.5 * w
+    qq = xp.asarray(q, dtype=d.mean.dtype)
+    target = qq * xp.squeeze(total, -1)
+    # index of first centroid with cum >= target
+    idx = xp.sum((cum < target[..., None]).astype("int32"), axis=-1)
+    idx = xp.clip(idx, 0, d.mean.shape[-1] - 1)
+    idx0 = xp.clip(idx - 1, 0, d.mean.shape[-1] - 1)
+    c0 = xp.take_along_axis(cum, idx0[..., None], axis=-1)[..., 0]
+    c1 = xp.take_along_axis(cum, idx[..., None], axis=-1)[..., 0]
+    m0 = xp.take_along_axis(d.mean, idx0[..., None], axis=-1)[..., 0]
+    m1 = xp.take_along_axis(d.mean, idx[..., None], axis=-1)[..., 0]
+    t = xp.where(c1 > c0, (target - c0) / xp.where(c1 > c0, c1 - c0, 1.0), 0.0)
+    t = xp.clip(t, 0.0, 1.0)
+    return m0 + t * (m1 - m0)

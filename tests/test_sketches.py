@@ -1,0 +1,131 @@
+"""t-digest + HLL: accuracy vs exact, numpy/jax parity, merge associativity."""
+
+import numpy as np
+import pytest
+
+from anomod.ops import (hll_add, hll_estimate, hll_init, hll_merge,
+                        tdigest_build, tdigest_merge, tdigest_quantile)
+from anomod.ops.tdigest import tdigest_merge_many
+
+
+def test_tdigest_quantile_accuracy():
+    rng = np.random.default_rng(0)
+    vals = rng.lognormal(3.0, 1.0, 20_000).astype(np.float32)
+    d = tdigest_build(vals, k=64)
+    for q in (0.5, 0.9, 0.95, 0.99):
+        exact = np.quantile(vals, q)
+        approx = tdigest_quantile(d, q)
+        assert abs(approx - exact) / exact < 0.05, (q, exact, approx)
+
+
+def test_tdigest_merge_matches_full_build():
+    rng = np.random.default_rng(1)
+    a = rng.normal(100, 10, 8000).astype(np.float32)
+    b = rng.normal(200, 30, 8000).astype(np.float32)
+    d = tdigest_merge(tdigest_build(a, 64), tdigest_build(b, 64))
+    full = np.concatenate([a, b])
+    for q in (0.25, 0.5, 0.9):
+        exact = np.quantile(full, q)
+        assert abs(tdigest_quantile(d, q) - exact) / abs(exact) < 0.05
+
+
+def test_tdigest_vmapped_lanes():
+    rng = np.random.default_rng(2)
+    vals = rng.lognormal(2.0, 0.7, (5, 4000)).astype(np.float32)
+    d = tdigest_build(vals, k=32)
+    assert d.mean.shape == (5, 32)
+    q = tdigest_quantile(d, 0.5)
+    for i in range(5):
+        exact = np.quantile(vals[i], 0.5)
+        assert abs(q[i] - exact) / exact < 0.06
+
+
+def test_tdigest_jax_matches_numpy():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    vals = rng.lognormal(3.0, 1.0, 4096).astype(np.float32)
+    dn = tdigest_build(vals, k=64, xp=np)
+    dj = tdigest_build(jnp.asarray(vals), k=64, xp=jnp)
+    np.testing.assert_allclose(np.asarray(dj.mean), dn.mean, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dj.weight), dn.weight, rtol=1e-5)
+    qn = tdigest_quantile(dn, 0.95)
+    qj = tdigest_quantile(dj, jnp.float32(0.95), xp=jnp)
+    assert abs(float(qj) - qn) / qn < 1e-3
+
+
+def test_tdigest_merge_many_shards():
+    rng = np.random.default_rng(4)
+    shards = [rng.lognormal(3.0, 1.0, 5000).astype(np.float32) for _ in range(8)]
+    digests = [tdigest_build(s, 64) for s in shards]
+    merged = tdigest_merge_many(digests)
+    full = np.concatenate(shards)
+    for q in (0.5, 0.99):
+        exact = np.quantile(full, q)
+        assert abs(tdigest_quantile(merged, q) - exact) / exact < 0.05
+
+
+def test_hll_estimate_accuracy():
+    p = 12
+    for true_n in (100, 5_000, 200_000):
+        items = np.arange(true_n, dtype=np.int64) * 2654435761 % (2**31)
+        regs = hll_add(hll_init(p), items.astype(np.int32), p=p)
+        est = hll_estimate(regs)
+        rel = abs(est - len(np.unique(items))) / len(np.unique(items))
+        assert rel < 0.08, (true_n, est, rel)
+
+
+def test_hll_merge_exact():
+    p = 10
+    a_items = np.arange(0, 3000, dtype=np.int32)
+    b_items = np.arange(1500, 6000, dtype=np.int32)
+    ra = hll_add(hll_init(p), a_items, p=p)
+    rb = hll_add(hll_init(p), b_items, p=p)
+    merged = hll_merge(ra, rb)
+    both = hll_add(hll_add(hll_init(p), a_items, p=p), b_items, p=p)
+    np.testing.assert_array_equal(merged, both)
+    est = hll_estimate(merged)
+    assert abs(est - 6000) / 6000 < 0.1
+
+
+def test_hll_lanes_scatter():
+    p = 8
+    lanes = 4
+    regs = hll_init(p, lanes=lanes)
+    items = np.arange(8000, dtype=np.int32)
+    lane = items % lanes
+    regs = hll_add(regs, items, p=p, lane=lane)
+    est = hll_estimate(regs)
+    assert est.shape == (lanes,)
+    for i in range(lanes):
+        assert abs(est[i] - 2000) / 2000 < 0.15
+
+
+def test_hll_jax_matches_numpy():
+    import jax.numpy as jnp
+    p = 10
+    items = (np.arange(10_000, dtype=np.int64) * 2654435761 % (2**31)
+             ).astype(np.int32)
+    rn = hll_add(hll_init(p), items, p=p)
+    rj = hll_add(hll_init(p, xp=jnp), jnp.asarray(items), p=p, xp=jnp)
+    np.testing.assert_array_equal(np.asarray(rj), rn)
+    lane = jnp.asarray(items % 3)
+    rjl = hll_add(hll_init(p, lanes=3, xp=jnp), jnp.asarray(items), p=p,
+                  lane=lane, xp=jnp)
+    rnl = hll_add(hll_init(p, lanes=3), items, p=p, lane=np.asarray(items) % 3)
+    np.testing.assert_array_equal(np.asarray(rjl), rnl)
+
+
+def test_pallas_replay_kernel_interpret():
+    """Fused pallas aggregation kernel vs numpy oracle (interpret mode on CPU)."""
+    from anomod.ops.pallas_replay import (make_pallas_replay_fn,
+                                          pallas_replay_numpy)
+    rng = np.random.default_rng(7)
+    n, S, F, H, B = 2048, 93, 6, 16, 256
+    sid = rng.integers(0, S + 1, n).astype(np.int32)
+    feats = rng.random((F, n)).astype(np.float32)
+    feats[0] = (sid < S).astype(np.float32)
+    bucket = rng.integers(0, H, n).astype(np.int32)
+    ref = pallas_replay_numpy(sid, feats, bucket, S, F, H)
+    fn = make_pallas_replay_fn(S, F, H, block=B, interpret=True)
+    out = np.asarray(fn(sid, feats, bucket))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
