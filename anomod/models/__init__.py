@@ -1,0 +1,6 @@
+"""GNN model zoo for anomaly scoring and root-cause localization."""
+
+from anomod.models.gnn import GCN, GAT, GraphSAGE, normalized_adjacency
+from anomod.models.temporal import TemporalGCN
+
+__all__ = ["GCN", "GAT", "GraphSAGE", "TemporalGCN", "normalized_adjacency"]

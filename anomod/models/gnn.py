@@ -1,0 +1,133 @@
+"""Sparse GNN layers/models over the service DAG (flax.linen).
+
+Message passing is ``jax.ops.segment_sum`` over a padded edge list — static
+[E_max, 2] shapes so XLA compiles one program for every experiment graph
+(SN ~12 services, TT ~45; BASELINE.json configs 3-4).  Edges carry the call
+direction from anomod.graph (caller → callee); messages flow both ways via
+the symmetrized edge list so upstream effects propagate to culprit scoring.
+
+No reference counterpart: the reference ships labeled data for exactly this
+model family but no model code (SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def normalized_adjacency(adj_counts, add_self_loops: bool = True):
+    """Symmetric GCN normalization D^-1/2 (A + A^T + I) D^-1/2 from the dense
+    call-count matrix (counts binarized)."""
+    a = (adj_counts > 0).astype(jnp.float32)
+    a = jnp.maximum(a, a.T)
+    if add_self_loops:
+        a = a + jnp.eye(a.shape[0], dtype=jnp.float32)
+    d = a.sum(axis=1)
+    d_inv_sqrt = jnp.where(d > 0, 1.0 / jnp.sqrt(jnp.maximum(d, 1e-9)), 0.0)
+    return a * d_inv_sqrt[:, None] * d_inv_sqrt[None, :]
+
+
+def segment_mean(messages, dst, num_nodes):
+    s = jax.ops.segment_sum(messages, dst, num_segments=num_nodes)
+    cnt = jax.ops.segment_sum(jnp.ones((messages.shape[0],), messages.dtype),
+                              dst, num_segments=num_nodes)
+    return s / jnp.maximum(cnt, 1.0)[:, None]
+
+
+class GCNLayer(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, h, a_norm):
+        # dense S×S matmul: S ≤ 64, one MXU tile — cheaper than gather/scatter
+        return nn.Dense(self.features, use_bias=True)(a_norm @ h)
+
+
+class GCN(nn.Module):
+    """2-layer GCN anomaly scorer (BASELINE.json config 3)."""
+    hidden: int = 64
+    n_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x, adj_counts):
+        a = normalized_adjacency(adj_counts)
+        h = x
+        for _ in range(self.n_layers - 1):
+            h = nn.relu(GCNLayer(self.hidden)(h, a))
+        h = GCNLayer(self.hidden)(h, a)
+        h = nn.relu(h)
+        scores = nn.Dense(1)(h)[:, 0]          # per-service culprit logit
+        return scores
+
+
+class GraphSAGE(nn.Module):
+    """GraphSAGE with mean aggregation over the padded edge list."""
+    hidden: int = 64
+    n_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x, edge_src, edge_dst, edge_mask):
+        S = x.shape[0]
+        # symmetrize: messages flow caller->callee and callee->caller
+        src = jnp.concatenate([edge_src, edge_dst])
+        dst = jnp.concatenate([edge_dst, edge_src])
+        mask = jnp.concatenate([edge_mask, edge_mask]).astype(x.dtype)
+        h = x
+        for i in range(self.n_layers):
+            msgs = h[src] * mask[:, None]
+            neigh = segment_mean(msgs, dst, S)
+            h = nn.Dense(self.hidden)(h) + nn.Dense(self.hidden)(neigh)
+            h = nn.relu(h)
+            h = h / jnp.maximum(jnp.linalg.norm(h, axis=-1, keepdims=True), 1e-6)
+        return nn.Dense(1)(h)[:, 0]
+
+
+class GATLayer(nn.Module):
+    features: int
+    n_heads: int = 4
+
+    @nn.compact
+    def __call__(self, h, edge_src, edge_dst, edge_mask):
+        S = h.shape[0]
+        F, Hd = self.features, self.n_heads
+        wh = nn.Dense(F * Hd, use_bias=False)(h).reshape(S, Hd, F)
+        a_src = self.param("a_src", nn.initializers.glorot_uniform(), (Hd, F))
+        a_dst = self.param("a_dst", nn.initializers.glorot_uniform(), (Hd, F))
+        e = (jnp.einsum("shf,hf->sh", wh, a_src)[edge_src]
+             + jnp.einsum("shf,hf->sh", wh, a_dst)[edge_dst])  # [E, Hd]
+        e = nn.leaky_relu(e, negative_slope=0.2)
+        e = jnp.where(edge_mask[:, None], e, -1e9)
+        # segment softmax over incoming edges of each dst
+        e_max = jax.ops.segment_max(e, edge_dst, num_segments=S)
+        e = jnp.exp(e - e_max[edge_dst])
+        e = e * edge_mask[:, None]
+        denom = jax.ops.segment_sum(e, edge_dst, num_segments=S)
+        alpha = e / jnp.maximum(denom[edge_dst], 1e-9)            # [E, Hd]
+        msgs = wh[edge_src] * alpha[:, :, None]                   # [E, Hd, F]
+        out = jax.ops.segment_sum(msgs, edge_dst, num_segments=S)
+        return out.reshape(S, Hd * F)
+
+
+class GAT(nn.Module):
+    """Graph attention RCA scorer (BASELINE.json config 4)."""
+    hidden: int = 32
+    n_heads: int = 4
+    n_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x, edge_src, edge_dst, edge_mask):
+        # symmetrize + self loops so every node attends to itself
+        S = x.shape[0]
+        loops = jnp.arange(S, dtype=edge_src.dtype)
+        src = jnp.concatenate([edge_src, edge_dst, loops])
+        dst = jnp.concatenate([edge_dst, edge_src, loops])
+        mask = jnp.concatenate(
+            [edge_mask, edge_mask, jnp.ones(S, dtype=edge_mask.dtype)])
+        h = x
+        for _ in range(self.n_layers):
+            h = nn.elu(GATLayer(self.hidden, self.n_heads)(h, src, dst, mask))
+        return nn.Dense(1)(h)[:, 0]

@@ -1,0 +1,36 @@
+"""Temporal GNN: windowed multimodal features → GRU over time → GCN scorer.
+
+BASELINE.json config 5 ("multimodal log+metric+trace temporal-GNN"): inputs
+are per-window per-service feature planes straight from the replay engine's
+windowed aggregates ([S, W, F], anomod.replay) fused with log/metric planes;
+an ``nn.scan`` GRU consumes the window axis (compiler-friendly recurrence),
+then a GCN head scores services on the final hidden state.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from anomod.models.gnn import GCNLayer, normalized_adjacency
+
+
+class TemporalGCN(nn.Module):
+    """GRU over windows, then a 2-layer GCN over the service DAG."""
+    hidden: int = 64
+    gnn_hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x_swf, adj_counts):
+        # project each window's features, GRU over the window axis
+        x = nn.Dense(self.hidden)(x_swf)          # [S, W, hidden]
+        h0 = jnp.zeros((x.shape[0], self.hidden), x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)                # [W, S, hidden]
+        ScanGRU = nn.scan(
+            nn.GRUCell, variable_broadcast="params",
+            split_rngs={"params": False}, in_axes=0, out_axes=0)
+        h_final, _ = ScanGRU(features=self.hidden)(h0, xs)
+        a = normalized_adjacency(adj_counts)
+        h = nn.relu(GCNLayer(self.gnn_hidden)(h_final, a))
+        h = nn.relu(GCNLayer(self.gnn_hidden)(h, a))
+        return nn.Dense(1)(h)[:, 0]

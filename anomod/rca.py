@@ -1,0 +1,232 @@
+"""RCA training/eval harness: GNNs trained on chaos fault labels.
+
+Dataset: synthetic experiment corpora (many seeds per fault label — the
+reference ships one run per label; seeds are the augmentation axis), features
+relative to the same-seed normal baseline (exactly what an operator has: a
+healthy profile of the same deployment).  Targets: the culprit service from
+the chaos metadata (anomod.labels).  Eval: top-k hit-rate on held-out seeds,
+the metric BASELINE.json ties to the numpy-baseline parity requirement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from anomod import detect, labels as labels_mod, synth
+from anomod.graph import build_service_graph
+from anomod.replay import ReplayConfig, replay_numpy, stage_columns
+
+
+@dataclasses.dataclass
+class RCASample:
+    experiment: str
+    x: np.ndarray          # [S, F] baseline-relative features
+    x_t: np.ndarray        # [S, W, Ft] windowed temporal features
+    adj: np.ndarray        # [S, S] call counts
+    edge_src: np.ndarray   # [E_max] int32 (padded)
+    edge_dst: np.ndarray   # [E_max] int32
+    edge_mask: np.ndarray  # [E_max] bool
+    target: int            # culprit service index (-1 if none)
+    is_anomaly: bool
+
+
+def _windowed_features(batch, services, cfg: ReplayConfig) -> np.ndarray:
+    """[S, W, 4]: count, err_rate, mean log-latency, 5xx rate per window."""
+    svc_index = {s: i for i, s in enumerate(services)}
+    remap = np.array([svc_index.get(s, 0) for s in batch.services] or [0], np.int32)
+    batch = batch._replace(service=remap[batch.service], services=tuple(services))
+    chunks, _ = stage_columns(batch, cfg)
+    st = replay_numpy(chunks, cfg)
+    agg = st.agg.reshape(len(services), cfg.n_windows, -1)
+    count = agg[..., 0]
+    safe = np.maximum(count, 1.0)
+    return np.stack([
+        np.log1p(count), agg[..., 1] / safe, agg[..., 3] / safe,
+        agg[..., 5] / safe,
+    ], axis=-1).astype(np.float32)
+
+
+def build_dataset(testbed: str, seeds: Sequence[int], n_traces: int = 80,
+                  n_windows: int = 8) -> Tuple[List[RCASample], Tuple[str, ...]]:
+    """One sample per (fault label, seed), features relative to the same-seed
+    normal baseline."""
+    svc_list = synth.SN_SERVICES if testbed == "SN" else synth.TT_SERVICES
+    services = tuple(svc_list)
+    cfg = ReplayConfig(n_services=len(services), n_windows=n_windows,
+                       chunk_size=2048, window_us=300_000_000)
+    samples: List[RCASample] = []
+    e_max = 0
+    raw: List[tuple] = []
+    for seed in seeds:
+        normal_label = next(l for l in labels_mod.labels_for_testbed(testbed)
+                            if not l.is_anomaly)
+        normal = synth.generate_experiment(normal_label, n_traces=n_traces,
+                                           seed=seed * 1000)
+        base_x = detect.extract_features(normal, services).x
+        base_t = _windowed_features(normal.spans, services, cfg)
+        for label in labels_mod.labels_for_testbed(testbed):
+            exp = synth.generate_experiment(label, n_traces=n_traces,
+                                            seed=seed * 1000 + hash(label.experiment) % 997)
+            x = detect.extract_features(exp, services).x - base_x
+            x_t = _windowed_features(exp.spans, services, cfg) - base_t
+            g = build_service_graph(exp.spans, services=services)
+            e_max = max(e_max, g.n_edges)
+            target = (services.index(label.target_service)
+                      if label.target_service in services else -1)
+            raw.append((label.experiment, x, x_t, g, target, label.is_anomaly))
+    for name, x, x_t, g, target, is_anom in raw:
+        E = e_max
+        src = np.zeros(E, np.int32); dst = np.zeros(E, np.int32)
+        mask = np.zeros(E, np.bool_)
+        src[:g.n_edges] = g.edge_src; dst[:g.n_edges] = g.edge_dst
+        mask[:g.n_edges] = True
+        samples.append(RCASample(name, x.astype(np.float32), x_t, g.adj_counts,
+                                 src, dst, mask, target, is_anom))
+    return samples, services
+
+
+def _stack(samples: List[RCASample]) -> Dict[str, np.ndarray]:
+    return {
+        "x": np.stack([s.x for s in samples]),
+        "x_t": np.stack([s.x_t for s in samples]),
+        "adj": np.stack([s.adj for s in samples]).astype(np.float32),
+        "edge_src": np.stack([s.edge_src for s in samples]),
+        "edge_dst": np.stack([s.edge_dst for s in samples]),
+        "edge_mask": np.stack([s.edge_mask for s in samples]),
+        "target": np.array([s.target for s in samples], np.int32),
+        "is_anomaly": np.array([s.is_anomaly for s in samples], np.float32),
+    }
+
+
+def _apply_model(model_name: str, model, params, batch):
+    import jax
+    if model_name in ("gcn",):
+        return jax.vmap(lambda x, a: model.apply(params, x, a))(
+            batch["x"], batch["adj"])
+    if model_name == "temporal":
+        import jax.numpy as jnp
+        # fuse static multimodal features (logs etc.) into every window
+        W = batch["x_t"].shape[2]
+        x_full = jnp.concatenate(
+            [batch["x_t"],
+             jnp.repeat(batch["x"][:, :, None, :], W, axis=2)], axis=-1)
+        return jax.vmap(lambda x, a: model.apply(params, x, a))(
+            x_full, batch["adj"])
+    return jax.vmap(lambda x, s, d, m: model.apply(params, x, s, d, m))(
+        batch["x"], batch["edge_src"], batch["edge_dst"], batch["edge_mask"])
+
+
+def make_model(model_name: str):
+    from anomod.models import GAT, GCN, GraphSAGE, TemporalGCN
+    return {"gcn": GCN(), "gat": GAT(), "sage": GraphSAGE(),
+            "temporal": TemporalGCN()}[model_name]
+
+
+@dataclasses.dataclass
+class TrainResult:
+    model_name: str
+    top1: float
+    top3: float
+    detection_auc: float
+    n_eval: int
+    params: object
+
+
+def train_rca(testbed: str = "TT", model_name: str = "gcn",
+              train_seeds: Sequence[int] = range(8),
+              eval_seeds: Sequence[int] = range(100, 104),
+              epochs: int = 150, lr: float = 3e-3,
+              n_traces: int = 80, verbose: bool = False) -> TrainResult:
+    """Train a GNN RCA scorer on chaos labels; report held-out top-k."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    train_samples, services = build_dataset(testbed, train_seeds, n_traces)
+    eval_samples, _ = build_dataset(testbed, eval_seeds, n_traces)
+    # pad eval edge arrays to the train E_max (or vice versa)
+    E = max(train_samples[0].edge_src.shape[0], eval_samples[0].edge_src.shape[0])
+    def repad(samples):
+        for s in samples:
+            cur = s.edge_src.shape[0]
+            if cur < E:
+                s.edge_src = np.pad(s.edge_src, (0, E - cur))
+                s.edge_dst = np.pad(s.edge_dst, (0, E - cur))
+                s.edge_mask = np.pad(s.edge_mask, (0, E - cur))
+    repad(train_samples); repad(eval_samples)
+    train = _stack([s for s in train_samples])
+    evalb = _stack(eval_samples)
+
+    # standardize features on train statistics (shared with eval)
+    for key in ("x", "x_t"):
+        axes = tuple(range(train[key].ndim - 1))  # all but the feature axis
+        mu = train[key].mean(axis=axes, keepdims=True)
+        sd = train[key].std(axis=axes, keepdims=True) + 1e-6
+        train[key] = (train[key] - mu) / sd
+        evalb[key] = (evalb[key] - mu) / sd
+
+    model = make_model(model_name)
+    rng = jax.random.PRNGKey(0)
+    sample0 = {k: v[0] for k, v in train.items()}
+    if model_name == "gcn":
+        params = model.init(rng, sample0["x"], sample0["adj"])
+    elif model_name == "temporal":
+        W = sample0["x_t"].shape[1]
+        fused = np.concatenate(
+            [sample0["x_t"],
+             np.repeat(sample0["x"][:, None, :], W, axis=1)], axis=-1)
+        params = model.init(rng, fused, sample0["adj"])
+    else:
+        params = model.init(rng, sample0["x"], sample0["edge_src"],
+                            sample0["edge_dst"], sample0["edge_mask"])
+
+    tx = optax.adamw(lr, weight_decay=1e-4)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, batch):
+        scores = _apply_model(model_name, model, params, batch)  # [B, S]
+        # RCA loss: CE over services where a culprit exists
+        has_target = batch["target"] >= 0
+        logp = jax.nn.log_softmax(scores, axis=-1)
+        tgt = jnp.clip(batch["target"], 0, scores.shape[-1] - 1)
+        ce = -jnp.take_along_axis(logp, tgt[:, None], axis=1)[:, 0]
+        rca_loss = jnp.sum(ce * has_target) / jnp.maximum(has_target.sum(), 1)
+        # detection loss: max-score logit vs is_anomaly
+        det_logit = scores.max(axis=-1)
+        det_loss = optax.sigmoid_binary_cross_entropy(
+            det_logit, batch["is_anomaly"]).mean()
+        return rca_loss + 0.3 * det_loss
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    batch = {k: jnp.asarray(v) for k, v in train.items()}
+    for ep in range(epochs):
+        params, opt_state, loss = step(params, opt_state, batch)
+        if verbose and ep % 20 == 0:
+            print(f"epoch {ep}: loss {float(loss):.4f}")
+
+    # eval
+    scores = np.asarray(_apply_model(model_name, model, params,
+                                     {k: jnp.asarray(v) for k, v in evalb.items()}))
+    tgt = evalb["target"]
+    rca_mask = tgt >= 0
+    order = np.argsort(-scores, axis=-1)
+    rank = np.array([np.where(order[i] == tgt[i])[0][0] if rca_mask[i] else -1
+                     for i in range(len(tgt))])
+    top1 = float((rank[rca_mask] == 0).mean()) if rca_mask.any() else 0.0
+    top3 = float((rank[rca_mask] < 3).mean()) if rca_mask.any() else 0.0
+    # detection AUC (rank-based)
+    det = scores.max(axis=-1)
+    y = evalb["is_anomaly"]
+    pos, neg = det[y > 0], det[y == 0]
+    auc = float((pos[:, None] > neg[None, :]).mean()) if len(neg) else 1.0
+    return TrainResult(model_name=model_name, top1=top1, top3=top3,
+                       detection_auc=auc, n_eval=int(rca_mask.sum()),
+                       params=params)

@@ -1,0 +1,67 @@
+"""GNN models + RCA harness: shapes, gradients, and a fast end-to-end train."""
+
+import numpy as np
+import pytest
+
+from anomod.rca import build_dataset, make_model, train_rca
+
+
+@pytest.fixture(scope="module")
+def sn_data():
+    samples, services = build_dataset("SN", seeds=[0], n_traces=40)
+    return samples, services
+
+
+def test_build_dataset_shapes(sn_data):
+    samples, services = sn_data
+    assert len(samples) == 13
+    S = len(services)
+    for s in samples:
+        assert s.x.shape[0] == S
+        assert s.x_t.shape[0] == S
+        assert s.adj.shape == (S, S)
+        assert s.edge_src.shape == s.edge_dst.shape == s.edge_mask.shape
+    # anomalous samples with service targets carry valid indices
+    tgts = [s.target for s in samples if s.target >= 0]
+    assert len(tgts) >= 9
+    assert all(0 <= t < S for t in tgts)
+
+
+@pytest.mark.parametrize("name", ["gcn", "gat", "sage", "temporal"])
+def test_model_forward_and_grad(name, sn_data):
+    import jax
+    import jax.numpy as jnp
+    samples, services = sn_data
+    s = samples[1]
+    model = make_model(name)
+    rng = jax.random.PRNGKey(0)
+    if name == "gcn":
+        args = (jnp.asarray(s.x), jnp.asarray(s.adj, jnp.float32))
+    elif name == "temporal":
+        W = s.x_t.shape[1]
+        fused = np.concatenate(
+            [s.x_t, np.repeat(s.x[:, None, :], W, axis=1)], axis=-1)
+        args = (jnp.asarray(fused), jnp.asarray(s.adj, jnp.float32))
+    else:
+        args = (jnp.asarray(s.x), jnp.asarray(s.edge_src),
+                jnp.asarray(s.edge_dst), jnp.asarray(s.edge_mask))
+    params = model.init(rng, *args)
+    scores = model.apply(params, *args)
+    assert scores.shape == (len(services),)
+    assert np.isfinite(np.asarray(scores)).all()
+
+    def loss(p):
+        return (model.apply(p, *args) ** 2).sum()
+
+    grads = jax.grad(loss)(params)
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert leaves and all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    assert any(float(np.abs(np.asarray(l)).max()) > 0 for l in leaves)
+
+
+def test_train_rca_end_to_end_fast():
+    r = train_rca("SN", "gcn", train_seeds=range(4), eval_seeds=[50],
+                  epochs=250, n_traces=40)
+    # GCN must localize culprits on held-out seeds (numpy baseline gets 1.0)
+    assert r.top1 >= 0.7, (r.top1, r.top3)
+    assert r.detection_auc >= 0.8
