@@ -26,6 +26,27 @@ def main(argv=None) -> int:
     p_synth.add_argument("experiment")
     p_synth.add_argument("--traces", type=int, default=100)
 
+    p_detect = sub.add_parser(
+        "detect", help="run the z-score detector + RCA ranking over a corpus")
+    p_detect.add_argument("--testbed", choices=["SN", "TT"], default="TT")
+    p_detect.add_argument("--backend", choices=["cpu", "jax"], default="cpu")
+    p_detect.add_argument("--traces", type=int, default=100)
+    p_detect.add_argument("--from-data", action="store_true",
+                          help="load from the data root (LFS stubs -> synth)")
+
+    p_rca = sub.add_parser("rca", help="train a GNN RCA model on chaos labels")
+    p_rca.add_argument("--testbed", choices=["SN", "TT"], default="TT")
+    p_rca.add_argument("--model", choices=["gcn", "gat", "sage", "temporal"],
+                       default="gcn")
+    p_rca.add_argument("--epochs", type=int, default=300)
+    p_rca.add_argument("--train-seeds", type=int, default=6)
+    p_rca.add_argument("--eval-seeds", type=int, default=2)
+
+    p_replay = sub.add_parser("replay", help="measure span replay throughput")
+    p_replay.add_argument("--testbed", choices=["SN", "TT"], default="TT")
+    p_replay.add_argument("--traces", type=int, default=2000)
+    p_replay.add_argument("--replicate", type=int, default=1)
+
     args = parser.parse_args(argv)
 
     if args.cmd == "list":
@@ -47,6 +68,57 @@ def main(argv=None) -> int:
             "metric_samples": exp.metrics.n_samples,
             "log_lines": exp.logs.n_lines,
             "api_records": exp.api.n_records,
+        }))
+        return 0
+
+    if args.cmd == "detect":
+        from anomod import detect, labels, synth
+        from anomod.io import dataset
+        if args.from_data:
+            corpus = dataset.load_corpus(args.testbed,
+                                         n_synth_traces=args.traces)
+        else:
+            corpus = [synth.generate_experiment(l, n_traces=args.traces)
+                      for l in labels.labels_for_testbed(args.testbed)]
+        s = detect.evaluate_corpus(corpus, backend=args.backend)
+        print(json.dumps({
+            "testbed": args.testbed, "backend": args.backend,
+            "top1": s.top1, "top3": s.top3, "top5": s.top5,
+            "detection_accuracy": s.detection_accuracy,
+            "n_rca_cases": s.n_rca_cases,
+            "per_experiment": {r.experiment: {
+                "score": round(r.score, 4),
+                "top3": r.ranked_services[:3],
+                "target": r.target_service} for r in s.results},
+        }, indent=2))
+        return 0
+
+    if args.cmd == "rca":
+        from anomod.rca import train_rca
+        r = train_rca(args.testbed, args.model,
+                      train_seeds=range(args.train_seeds),
+                      eval_seeds=range(100, 100 + args.eval_seeds),
+                      epochs=args.epochs)
+        print(json.dumps({
+            "testbed": args.testbed, "model": r.model_name,
+            "top1": r.top1, "top3": r.top3,
+            "detection_auc": r.detection_auc, "n_eval": r.n_eval,
+        }))
+        return 0
+
+    if args.cmd == "replay":
+        from anomod import labels, synth
+        from anomod.replay import ReplayConfig, measure_throughput
+        from anomod.schemas import concat_span_batches
+        batch = concat_span_batches([
+            synth.generate_spans(l, n_traces=args.traces)
+            for l in labels.labels_for_testbed(args.testbed)])
+        cfg = ReplayConfig(n_services=batch.n_services)
+        r = measure_throughput(batch, cfg, replicate=args.replicate)
+        print(json.dumps({
+            "n_spans": r.n_spans, "wall_s": round(r.wall_s, 4),
+            "spans_per_sec": round(r.spans_per_sec, 1),
+            "compile_s": round(r.compile_s, 2),
         }))
         return 0
 

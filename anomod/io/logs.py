@@ -58,10 +58,12 @@ def parse_sn_summary(text: str) -> List[LogSummary]:
     return out
 
 
+# substring + case-insensitive, matching the reference's `grep -c -i error`
+# semantics (collect_log.sh:104-106); "exception" added for Java stacks
 _LEVEL_PAT = [
-    (re.compile(r"\berror\b|\bERROR\b|\bException\b", re.I), LOG_ERROR),
-    (re.compile(r"\bwarn(ing)?\b", re.I), LOG_WARN),
-    (re.compile(r"\binfo\b", re.I), LOG_INFO),
+    (re.compile(r"error|exception", re.I), LOG_ERROR),
+    (re.compile(r"warn", re.I), LOG_WARN),
+    (re.compile(r"info", re.I), LOG_INFO),
 ]
 # ISO-ish timestamp prefix e.g. "2025-11-03 22:02:28" or "2025-11-03T22:02:28"
 _TS_PAT = re.compile(r"(\d{4})-(\d{2})-(\d{2})[T ](\d{2}):(\d{2}):(\d{2})")
@@ -70,7 +72,18 @@ _TS_PAT = re.compile(r"(\d{4})-(\d{2})-(\d{2})[T ](\d{2}):(\d{2}):(\d{2})")
 def parse_log_lines(text: str, service_idx: int,
                     default_t: float = 0.0) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Line-level classification, reproducing the reference's grep -c -i
-    info/warn/error counting (collect_log.sh:104-106)."""
+    info/warn/error counting (collect_log.sh:104-106).
+
+    Dispatches to the C++ scanner (anomod.io.native) when built; the Python
+    path below is the behavioral oracle."""
+    from anomod.io import native
+    if native.available():
+        res = native.scan_log(text.encode("utf-8", errors="replace"))
+        if res is not None:
+            lvl, t = res
+            t = np.where(t == 0.0, default_t, t)
+            svc = np.full(lvl.shape[0], service_idx, np.int32)
+            return svc, t, lvl.astype(np.int8)
     import calendar
     lines = text.splitlines()
     n = len(lines)

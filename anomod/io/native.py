@@ -1,0 +1,89 @@
+"""ctypes bindings for the C++ native runtime (native/libanomod_native.so).
+
+Builds on first use if the shared object is missing (g++ is baked into the
+image); every entry point has a pure-Python fallback so the package works
+without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_SO_PATH = _NATIVE_DIR / "libanomod_native.so"
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if not _SO_PATH.exists() and (_NATIVE_DIR / "Makefile").exists():
+        try:
+            subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                           capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, OSError):
+            return None
+    if not _SO_PATH.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_SO_PATH))
+    except OSError:
+        return None
+    lib.anomod_scan_log_mt.restype = ctypes.c_int64
+    lib.anomod_scan_log_mt.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int64, ctypes.c_int32]
+    lib.anomod_scan_api_jsonl.restype = ctypes.c_int64
+    lib.anomod_scan_api_jsonl.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def scan_log(text: bytes, n_threads: int = 4) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(levels int8, timestamps float64) per line; None if native unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    max_lines = text.count(b"\n") + 1
+    levels = np.empty(max_lines, np.int8)
+    ts = np.empty(max_lines, np.float64)
+    n = lib.anomod_scan_log_mt(
+        text, len(text),
+        levels.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        max_lines, n_threads)
+    return levels[:n], ts[:n]
+
+
+def scan_api_jsonl(text: bytes) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """(status int16, latency_ms float32, content_length int32) per record."""
+    lib = _load()
+    if lib is None:
+        return None
+    max_recs = text.count(b"\n") + 1
+    status = np.empty(max_recs, np.int16)
+    lat = np.empty(max_recs, np.float32)
+    clen = np.empty(max_recs, np.int32)
+    n = lib.anomod_scan_api_jsonl(
+        text, len(text),
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        lat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        clen.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        max_recs)
+    return status[:n], lat[:n], clen[:n]

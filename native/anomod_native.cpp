@@ -1,0 +1,179 @@
+// anomod native runtime: ingestion hot loops in C++.
+//
+// The reference's collectors shell out per artifact (docker logs, kubectl
+// logs — collect_log.sh, log_collector.py) and post-process line-by-line in
+// bash/python.  Here the per-line scanning (log level classification +
+// timestamp extraction) and JSONL field extraction run natively, exposed via
+// a C ABI consumed with ctypes (anomod/io/native.py).
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC -pthread)
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <cctype>
+#include <ctime>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// case-insensitive substring search (ASCII)
+inline bool contains_ci(const char* hay, size_t n, const char* needle) {
+    const size_t m = std::strlen(needle);
+    if (m > n) return false;
+    const char c0l = (char)std::tolower(needle[0]);
+    for (size_t i = 0; i + m <= n; ++i) {
+        if ((char)std::tolower(hay[i]) != c0l) continue;
+        size_t j = 1;
+        for (; j < m; ++j)
+            if ((char)std::tolower(hay[i + j]) != (char)std::tolower(needle[j]))
+                break;
+        if (j == m) return true;
+    }
+    return false;
+}
+
+// parse "YYYY-MM-DD[T ]HH:MM:SS" anywhere in the first 64 bytes -> epoch secs
+inline double parse_ts(const char* line, size_t n) {
+    const size_t limit = n < 64 ? n : 64;
+    for (size_t i = 0; i + 19 <= limit; ++i) {
+        const char* p = line + i;
+        if (std::isdigit(p[0]) && std::isdigit(p[1]) && std::isdigit(p[2]) &&
+            std::isdigit(p[3]) && p[4] == '-' && std::isdigit(p[5]) &&
+            std::isdigit(p[6]) && p[7] == '-' && std::isdigit(p[8]) &&
+            std::isdigit(p[9]) && (p[10] == ' ' || p[10] == 'T') &&
+            std::isdigit(p[11]) && std::isdigit(p[12]) && p[13] == ':' &&
+            std::isdigit(p[14]) && std::isdigit(p[15]) && p[16] == ':' &&
+            std::isdigit(p[17]) && std::isdigit(p[18])) {
+            std::tm tm{};
+            tm.tm_year = (p[0]-'0')*1000 + (p[1]-'0')*100 + (p[2]-'0')*10 + (p[3]-'0') - 1900;
+            tm.tm_mon  = (p[5]-'0')*10 + (p[6]-'0') - 1;
+            tm.tm_mday = (p[8]-'0')*10 + (p[9]-'0');
+            tm.tm_hour = (p[11]-'0')*10 + (p[12]-'0');
+            tm.tm_min  = (p[14]-'0')*10 + (p[15]-'0');
+            tm.tm_sec  = (p[17]-'0')*10 + (p[18]-'0');
+            return (double)timegm(&tm);
+        }
+    }
+    return 0.0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Classify lines: level 0=info 1=warn 2=error 3=other (matches
+// anomod.schemas LOG_* codes; semantics of collect_log.sh:104-106 grep -c -i).
+// Returns the number of lines written (<= max_lines).
+int64_t anomod_scan_log(const char* text, int64_t len,
+                        int8_t* levels_out, double* ts_out,
+                        int64_t max_lines) {
+    int64_t count = 0;
+    const char* p = text;
+    const char* end = text + len;
+    while (p < end && count < max_lines) {
+        const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+        const size_t n = nl ? (size_t)(nl - p) : (size_t)(end - p);
+        int8_t lvl = 3;
+        if (contains_ci(p, n, "error") || contains_ci(p, n, "exception")) lvl = 2;
+        else if (contains_ci(p, n, "warn")) lvl = 1;
+        else if (contains_ci(p, n, "info")) lvl = 0;
+        levels_out[count] = lvl;
+        ts_out[count] = parse_ts(p, n);
+        ++count;
+        if (!nl) break;
+        p = nl + 1;
+    }
+    return count;
+}
+
+// Multithreaded variant over pre-split chunks of one large buffer.
+int64_t anomod_scan_log_mt(const char* text, int64_t len,
+                           int8_t* levels_out, double* ts_out,
+                           int64_t max_lines, int32_t n_threads) {
+    if (n_threads <= 1 || len < (1 << 20))
+        return anomod_scan_log(text, len, levels_out, ts_out, max_lines);
+    // split at line boundaries
+    std::vector<int64_t> starts{0};
+    for (int t = 1; t < n_threads; ++t) {
+        int64_t pos = len * t / n_threads;
+        const char* nl = (const char*)memchr(text + pos, '\n', (size_t)(len - pos));
+        starts.push_back(nl ? (int64_t)(nl - text) + 1 : len);
+    }
+    starts.push_back(len);
+    // count lines per chunk first (cheap memchr pass) to place outputs
+    std::vector<int64_t> line_ofs(n_threads + 1, 0);
+    for (int t = 0; t < n_threads; ++t) {
+        int64_t c = 0;
+        const char* p = text + starts[t];
+        const char* endp = text + starts[t + 1];
+        while (p < endp) {
+            const char* nl = (const char*)memchr(p, '\n', (size_t)(endp - p));
+            ++c;
+            if (!nl) break;
+            p = nl + 1;
+        }
+        line_ofs[t + 1] = line_ofs[t] + c;
+    }
+    const int64_t total = line_ofs[n_threads] < max_lines ? line_ofs[n_threads]
+                                                          : max_lines;
+    std::vector<std::thread> threads;
+    for (int t = 0; t < n_threads; ++t) {
+        threads.emplace_back([=]() {
+            const int64_t cap = total - (line_ofs[t] < total ? line_ofs[t] : total);
+            if (cap <= 0) return;
+            anomod_scan_log(text + starts[t], starts[t + 1] - starts[t],
+                            levels_out + line_ofs[t], ts_out + line_ofs[t], cap);
+        });
+    }
+    for (auto& th : threads) th.join();
+    return total;
+}
+
+// Extract numeric fields from API-response JSONL (one object per line):
+// status_code, latency_ms, content_length (enhanced_openapi_monitor.py
+// record contract).  Returns number of records.
+int64_t anomod_scan_api_jsonl(const char* text, int64_t len,
+                              int16_t* status_out, float* latency_out,
+                              int32_t* clen_out, int64_t max_recs) {
+    int64_t count = 0;
+    const char* p = text;
+    const char* end = text + len;
+    auto find_num = [](const char* line, size_t n, const char* key,
+                       double* out) -> bool {
+        const size_t klen = std::strlen(key);
+        for (size_t i = 0; i + klen + 1 < n; ++i) {
+            if (line[i] == '"' && i + 1 + klen < n &&
+                std::memcmp(line + i + 1, key, klen) == 0 &&
+                line[i + 1 + klen] == '"') {
+                const char* q = line + i + 2 + klen;
+                while (q < line + n && (*q == ':' || *q == ' ')) ++q;
+                char* endq = nullptr;
+                const double v = std::strtod(q, &endq);
+                if (endq != q) { *out = v; return true; }
+                return false;
+            }
+        }
+        return false;
+    };
+    while (p < end && count < max_recs) {
+        const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+        const size_t n = nl ? (size_t)(nl - p) : (size_t)(end - p);
+        if (n > 2) {
+            double st = 0, lat = 0, cl = 0;
+            find_num(p, n, "status_code", &st);
+            find_num(p, n, "latency_ms", &lat);
+            find_num(p, n, "content_length", &cl);
+            status_out[count] = (int16_t)st;
+            latency_out[count] = (float)lat;
+            clen_out[count] = (int32_t)cl;
+            ++count;
+        }
+        if (!nl) break;
+        p = nl + 1;
+    }
+    return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,65 @@
+"""Native C++ scanner: parity with the Python oracle + throughput sanity."""
+
+import numpy as np
+import pytest
+
+from anomod.io import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib not built")
+
+SAMPLE_LOG = """2025-11-03 22:02:28 INFO Starting service
+2025-11-03 22:02:29 WARN slow response detected
+2025-11-03T22:02:30 ERROR connection refused
+plain line without level or time
+2025-11-03 22:02:31 info lowercase info
+NullPointerException at line 42
+"""
+
+
+def _python_oracle(text):
+    # bypass the native dispatch inside parse_log_lines
+    import importlib
+    from anomod.io import logs as logs_io
+    orig = native.available
+    native.available = lambda: False
+    try:
+        svc, t, lvl = logs_io.parse_log_lines(text, 0)
+    finally:
+        native.available = orig
+    return t, lvl
+
+
+def test_scan_log_matches_python():
+    levels, ts = native.scan_log(SAMPLE_LOG.encode())
+    t_ref, lvl_ref = _python_oracle(SAMPLE_LOG)
+    assert levels.shape[0] == lvl_ref.shape[0]
+    np.testing.assert_array_equal(levels, lvl_ref)
+    np.testing.assert_allclose(np.where(ts == 0, 0, ts), t_ref)
+
+
+def test_scan_log_levels():
+    levels, ts = native.scan_log(SAMPLE_LOG.encode())
+    from anomod.schemas import LOG_ERROR, LOG_INFO, LOG_OTHER, LOG_WARN
+    assert list(levels) == [LOG_INFO, LOG_WARN, LOG_ERROR, LOG_OTHER,
+                            LOG_INFO, LOG_ERROR]
+    assert ts[0] > 1.7e9
+    assert ts[3] == 0.0
+
+
+def test_scan_log_multithreaded_large():
+    big = (SAMPLE_LOG * 50_000).encode()  # ~18 MB, crosses the MT threshold
+    levels, ts = native.scan_log(big, n_threads=4)
+    assert levels.shape[0] == 6 * 50_000
+    # pattern repeats
+    np.testing.assert_array_equal(levels[:6], levels[6:12])
+
+
+def test_scan_api_jsonl():
+    text = b"""{"timestamp": "2025-11-03T22:02:28", "endpoint": "/x", "status_code": 200, "latency_ms": 12.5, "content_length": 512}
+{"timestamp": "2025-11-03T22:02:29", "endpoint": "/y", "status_code": 500, "latency_ms": 3001.75, "content_length": 0}
+"""
+    status, lat, clen = native.scan_api_jsonl(text)
+    assert list(status) == [200, 500]
+    np.testing.assert_allclose(lat, [12.5, 3001.75])
+    assert list(clen) == [512, 0]

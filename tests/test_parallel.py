@@ -51,3 +51,14 @@ def test_sharded_replay_matches_numpy(batch):
     np.testing.assert_allclose(np.asarray(out.agg), ref.agg, rtol=1e-3)
     np.testing.assert_allclose(np.asarray(out.hist), ref.hist, rtol=1e-6)
     assert int(np.asarray(out.agg)[:, 0].sum()) == batch.n_spans
+
+
+def test_graft_entry_dryrun():
+    import sys
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    import jax
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] > 0
+    g.dryrun_multichip(8)
