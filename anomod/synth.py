@@ -279,7 +279,12 @@ def generate_spans(label: FaultLabel, n_traces: int = 200,
     # SN host-level performance faults hit every service.
     host_level = label.is_anomaly and target_idx < 0
 
-    tpl_ids = rng.integers(0, len(templates), size=n_traces)
+    # Round-robin template assignment (shuffled): the reference replays the
+    # complete EvoMaster suite each iteration, so every call path shows up in
+    # every experiment — random sampling would leave rare paths out of the
+    # normal baseline and fabricate latency-inflation artifacts.
+    tpl_ids = np.arange(n_traces) % len(templates)
+    rng.shuffle(tpl_ids)
     # Per-service baseline latency (ms, lognormal median), deterministic per testbed.
     svc_rng = np.random.default_rng(_seed_for(label.testbed, 7))
     base_ms = svc_rng.uniform(2.0, 30.0, size=len(services))

@@ -1,0 +1,72 @@
+"""Campaign materializer: reference-shaped trees that roundtrip the loaders."""
+
+import numpy as np
+import pytest
+
+from anomod import detect
+from anomod.campaign import run_campaign
+from anomod.config import Config
+from anomod.io import dataset
+
+
+@pytest.fixture(scope="module")
+def tt_tree(tmp_path_factory):
+    out = tmp_path_factory.mktemp("campaign")
+    done = run_campaign("TT", out, n_traces=60)
+    return out, done
+
+
+@pytest.fixture(scope="module")
+def sn_tree(tmp_path_factory):
+    out = tmp_path_factory.mktemp("campaign_sn")
+    done = run_campaign("SN", out, n_traces=60)
+    return out, done
+
+
+def test_campaign_tt_tree_shape(tt_tree):
+    out, done = tt_tree
+    assert len(done) == 13
+    root = out / "TT_data"
+    for sub in ("trace_data", "metric_data", "log_data", "api_responses",
+                "coverage_report"):
+        assert (root / sub).is_dir()
+        assert len(list((root / sub).iterdir())) == 13
+
+
+def test_campaign_tt_roundtrip_loaders(tt_tree):
+    out, _ = tt_tree
+    cfg = Config(data_root=out, synth_on_lfs=False)
+    found = dataset.discover("TT", cfg)
+    assert len(found) == 13
+    exp = dataset.load_experiment("Lv_P_CPU_preserve", "TT", cfg)
+    assert not exp.synthetic            # everything loaded from disk
+    assert exp.spans.n_spans > 0
+    assert exp.metrics.n_samples > 0
+    assert exp.logs.n_lines > 0
+    assert exp.api.n_records > 0
+    assert exp.coverage is not None
+
+
+def test_campaign_sn_roundtrip_loaders(sn_tree):
+    out, _ = sn_tree
+    cfg = Config(data_root=out, synth_on_lfs=False)
+    exp = dataset.load_experiment("Svc_Kill_Media", "SN", cfg)
+    assert not exp.synthetic
+    assert exp.spans.n_spans > 0
+    assert exp.log_summaries           # summary.txt parsed back
+    by_name = {s.service: s for s in exp.log_summaries}
+    assert "MediaService" in by_name
+
+
+def test_detector_on_materialized_corpus(tt_tree):
+    """Full loop: campaign -> disk -> loaders -> detector -> labels."""
+    out, _ = tt_tree
+    cfg = Config(data_root=out, synth_on_lfs=False)
+    corpus = dataset.load_corpus("TT", cfg)
+    assert all(not e.synthetic for e in corpus)
+    s = detect.evaluate_corpus(corpus)
+    assert s.top1 >= 0.9, [(r.experiment, r.ranked_services[:3])
+                           for r in s.results
+                           if r.is_anomaly_true and r.target_service
+                           and not r.hit(1)]
+    assert s.detection_accuracy >= 0.9
