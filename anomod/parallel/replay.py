@@ -52,7 +52,7 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data"):
         return ReplayState(agg=jax.lax.psum(state.agg, axis),
                            hist=jax.lax.psum(state.hist, axis))
 
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     fn = shard_map(shard_body, mesh=mesh,
                    in_specs=({k: P(axis) for k in
                               ("sid", "dur", "dur_raw", "err", "s5", "valid")},),

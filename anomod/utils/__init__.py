@@ -1,0 +1,6 @@
+"""Utilities: checkpointing, tracing/profiling, run reports."""
+
+from anomod.utils.checkpoint import restore_train_state, save_train_state
+from anomod.utils.tracing import Tracer, profile_to
+
+__all__ = ["save_train_state", "restore_train_state", "Tracer", "profile_to"]

@@ -1,0 +1,62 @@
+"""Checkpoint / resume for RCA training and replay state (orbax).
+
+The reference has no checkpointing — each experiment is run-to-completion and
+the archive folder is the only persisted state (SURVEY.md §5).  Training a
+GNN RCA model is iterative, so this framework adds real checkpoint/resume:
+params + opt_state + step counter via orbax-checkpoint, with a numpy
+fallback writer for environments without orbax.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+from pathlib import Path
+from typing import Any, Optional, Tuple
+
+
+def _try_orbax():
+    try:
+        import orbax.checkpoint as ocp
+        return ocp
+    except ImportError:
+        return None
+
+
+def save_train_state(path: Path, params: Any, opt_state: Any,
+                     step: int, meta: Optional[dict] = None) -> str:
+    """Persist a training state; returns the backend used ("orbax"/"pickle")."""
+    import jax
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    host = jax.tree_util.tree_map(lambda x: jax.device_get(x), (params, opt_state))
+    ocp = _try_orbax()
+    (path / "meta.json").write_text(json.dumps(
+        {"step": step, **(meta or {})}))
+    if ocp is not None:
+        ckptr = ocp.PyTreeCheckpointer()
+        target = (path / "state.orbax").resolve()
+        if target.exists():
+            import shutil
+            shutil.rmtree(target)
+        ckptr.save(target, host)
+        return "orbax"
+    with open(path / "state.pkl", "wb") as f:
+        pickle.dump(host, f)
+    return "pickle"
+
+
+def restore_train_state(path: Path) -> Tuple[Any, Any, int, dict]:
+    """Restore (params, opt_state, step, meta)."""
+    path = Path(path)
+    meta = json.loads((path / "meta.json").read_text())
+    step = int(meta.pop("step", 0))
+    ocp = _try_orbax()
+    orbax_dir = path / "state.orbax"
+    if ocp is not None and orbax_dir.exists():
+        ckptr = ocp.PyTreeCheckpointer()
+        params, opt_state = ckptr.restore(orbax_dir.resolve())
+        return params, opt_state, step, meta
+    with open(path / "state.pkl", "rb") as f:
+        params, opt_state = pickle.load(f)
+    return params, opt_state, step, meta

@@ -1,0 +1,77 @@
+"""Tracing / profiling: the framework's own observability.
+
+The reference instruments its SUTs with Jaeger/SkyWalking (SURVEY.md §5);
+the analog for a TPU framework is (a) wall-clock span timing of pipeline
+stages emitted in a Jaeger-compatible JSON shape — so this framework's own
+trace can be loaded back through anomod.io.sn_traces — and (b) XLA device
+profiling via jax.profiler for kernel-level inspection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from pathlib import Path
+from typing import List, Optional
+
+
+class Tracer:
+    """Lightweight span tracer; dumps Jaeger-API-shaped JSON."""
+
+    def __init__(self, service: str = "anomod"):
+        self.service = service
+        self._spans: List[dict] = []
+        self._stack: List[int] = []
+        self._trace_id = f"anomod-{int(time.time() * 1e6):x}"
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        idx = len(self._spans)
+        parent = self._stack[-1] if self._stack else None
+        start = time.time()
+        self._spans.append({"name": name, "start": start, "dur": 0.0,
+                            "parent": parent})
+        self._stack.append(idx)
+        try:
+            yield
+        finally:
+            self._stack.pop()
+            self._spans[idx]["dur"] = time.time() - start
+
+    def to_jaeger(self) -> dict:
+        """Jaeger API JSON (loadable by anomod.io.sn_traces)."""
+        spans = []
+        for i, s in enumerate(self._spans):
+            refs = ([{"refType": "CHILD_OF", "traceID": self._trace_id,
+                      "spanID": f"s{s['parent']:08x}"}]
+                    if s["parent"] is not None else [])
+            spans.append({
+                "traceID": self._trace_id, "spanID": f"s{i:08x}",
+                "processID": "p0", "operationName": s["name"],
+                "startTime": int(s["start"] * 1e6),
+                "duration": int(s["dur"] * 1e6),
+                "references": refs,
+                "tags": [{"key": "span.kind", "value": "internal"}],
+                "logs": [],
+            })
+        return {"data": [{"traceID": self._trace_id,
+                          "processes": {"p0": {"serviceName": self.service}},
+                          "spans": spans}]}
+
+    def dump(self, path: Path) -> None:
+        Path(path).write_text(json.dumps(self.to_jaeger()))
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: Optional[str]):
+    """XLA device profiling (TensorBoard trace) when a dir is given."""
+    if not log_dir:
+        yield
+        return
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
