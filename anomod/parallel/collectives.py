@@ -16,13 +16,10 @@ from __future__ import annotations
 
 from typing import Optional
 
-import numpy as np
-
 
 def ring_allreduce(x, axis: str):
     """Ring all-reduce via ppermute (call inside shard_map over ``axis``)."""
     import jax
-    import jax.numpy as jnp
 
     n = jax.lax.axis_size(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]

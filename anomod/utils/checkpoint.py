@@ -3,8 +3,10 @@
 The reference has no checkpointing — each experiment is run-to-completion and
 the archive folder is the only persisted state (SURVEY.md §5).  Training a
 GNN RCA model is iterative, so this framework adds real checkpoint/resume:
-params + opt_state + step counter via orbax-checkpoint, with a numpy
-fallback writer for environments without orbax.
+params + opt_state + step counter via orbax-checkpoint (arrays) with the
+pytree structure pickled alongside (optax states are namedtuples, which a
+bare orbax restore would flatten into lists/dicts), plus a pure-pickle
+fallback for environments without orbax.
 """
 
 from __future__ import annotations
@@ -29,17 +31,19 @@ def save_train_state(path: Path, params: Any, opt_state: Any,
     import jax
     path = Path(path)
     path.mkdir(parents=True, exist_ok=True)
-    host = jax.tree_util.tree_map(lambda x: jax.device_get(x), (params, opt_state))
+    host = jax.tree_util.tree_map(jax.device_get, (params, opt_state))
+    # caller meta must not clobber the step counter
+    (path / "meta.json").write_text(json.dumps({**(meta or {}), "step": step}))
     ocp = _try_orbax()
-    (path / "meta.json").write_text(json.dumps(
-        {"step": step, **(meta or {})}))
     if ocp is not None:
-        ckptr = ocp.PyTreeCheckpointer()
+        leaves, treedef = jax.tree_util.tree_flatten(host)
         target = (path / "state.orbax").resolve()
         if target.exists():
             import shutil
             shutil.rmtree(target)
-        ckptr.save(target, host)
+        ocp.PyTreeCheckpointer().save(target, leaves)
+        with open(path / "treedef.pkl", "wb") as f:
+            pickle.dump(treedef, f)
         return "orbax"
     with open(path / "state.pkl", "wb") as f:
         pickle.dump(host, f)
@@ -47,15 +51,23 @@ def save_train_state(path: Path, params: Any, opt_state: Any,
 
 
 def restore_train_state(path: Path) -> Tuple[Any, Any, int, dict]:
-    """Restore (params, opt_state, step, meta)."""
+    """Restore (params, opt_state, step, meta) with original pytree structure."""
+    import jax
     path = Path(path)
     meta = json.loads((path / "meta.json").read_text())
     step = int(meta.pop("step", 0))
-    ocp = _try_orbax()
     orbax_dir = path / "state.orbax"
-    if ocp is not None and orbax_dir.exists():
-        ckptr = ocp.PyTreeCheckpointer()
-        params, opt_state = ckptr.restore(orbax_dir.resolve())
+    if orbax_dir.exists():
+        ocp = _try_orbax()
+        if ocp is None:
+            raise RuntimeError(
+                f"{path} was written with orbax-checkpoint, which is not "
+                "importable here — install orbax-checkpoint or restore on a "
+                "machine that has it.")
+        leaves = ocp.PyTreeCheckpointer().restore(orbax_dir.resolve())
+        with open(path / "treedef.pkl", "rb") as f:
+            treedef = pickle.load(f)
+        params, opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
         return params, opt_state, step, meta
     with open(path / "state.pkl", "rb") as f:
         params, opt_state = pickle.load(f)

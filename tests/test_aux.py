@@ -22,9 +22,23 @@ def test_checkpoint_roundtrip(tmp_path):
     assert meta["model"] == "gcn"
     np.testing.assert_array_equal(np.asarray(p2["dense"]["kernel"]),
                                   np.arange(12.0).reshape(3, 4))
+    # structure must survive (optax namedtuples), not just leaf values:
+    # a resumed tx.update must work on the restored state
     import jax
-    assert len(jax.tree_util.tree_leaves(o2)) == \
-        len(jax.tree_util.tree_leaves(opt_state))
+    import jax.numpy as jnp2
+    assert (jax.tree_util.tree_structure(o2)
+            == jax.tree_util.tree_structure(opt_state))
+    grads = jax.tree_util.tree_map(jnp2.ones_like, p2)
+    updates, _ = tx.update(grads, o2, p2)
+    assert jax.tree_util.tree_leaves(updates)
+
+
+def test_checkpoint_meta_cannot_clobber_step(tmp_path):
+    import jax.numpy as jnp
+    save_train_state(tmp_path / "ck", {"w": jnp.ones(2)}, (), step=42,
+                     meta={"step": 99})
+    _, _, step, _ = restore_train_state(tmp_path / "ck")
+    assert step == 42
 
 
 def test_tracer_jaeger_roundtrip(tmp_path):
