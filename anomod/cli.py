@@ -42,6 +42,14 @@ def main(argv=None) -> int:
     p_rca.add_argument("--train-seeds", type=int, default=6)
     p_rca.add_argument("--eval-seeds", type=int, default=2)
 
+    p_camp = sub.add_parser(
+        "campaign", help="run the full 13-experiment collection campaign "
+        "and archive a reference-shaped dataset tree")
+    p_camp.add_argument("--testbed", choices=["SN", "TT"], default="TT")
+    p_camp.add_argument("--out", required=True)
+    p_camp.add_argument("--traces", type=int, default=200)
+    p_camp.add_argument("--experiments", nargs="*", default=None)
+
     p_replay = sub.add_parser("replay", help="measure span replay throughput")
     p_replay.add_argument("--testbed", choices=["SN", "TT"], default="TT")
     p_replay.add_argument("--traces", type=int, default=2000)
@@ -104,6 +112,15 @@ def main(argv=None) -> int:
             "top1": r.top1, "top3": r.top3,
             "detection_auc": r.detection_auc, "n_eval": r.n_eval,
         }))
+        return 0
+
+    if args.cmd == "campaign":
+        from anomod.campaign import run_campaign
+        done = run_campaign(args.testbed, args.out,
+                            experiments=args.experiments,
+                            n_traces=args.traces)
+        print(json.dumps({"testbed": args.testbed, "out": args.out,
+                          "experiments": done}))
         return 0
 
     if args.cmd == "replay":

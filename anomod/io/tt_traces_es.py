@@ -1,0 +1,87 @@
+"""TT enhanced (Elasticsearch) trace collector schema → SpanBatch.
+
+The reference's alternative trace path queries SkyWalking's ``sw_segment-*``
+indices directly and emits segment-level records
+(enhanced_trace_collector.py:102-163: trace_id, segment_id, base64-encoded
+``service_id``, endpoint_name, start/end ms, latency, is_error) as a
+``detailed_traces_<ts>.{json,csv}`` pair (:168-213).  Segments carry no
+parent refs in this export, so parents resolve to -1 (segment-level view).
+"""
+
+from __future__ import annotations
+
+import base64
+import csv
+import json
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from anomod.io.lfs import is_lfs_pointer
+from anomod.schemas import KIND_ENTRY, SpanBatch, empty_span_batch
+
+
+def decode_service_id(service_id: str) -> str:
+    """``dHMtdHJhdmVsLXNlcnZpY2U=.1`` -> ``ts-travel-service``
+    (enhanced_trace_collector.py:131-148)."""
+    if not service_id:
+        return "unknown"
+    b64 = service_id.split(".")[0]
+    try:
+        return base64.b64decode(b64, validate=True).decode("utf-8")
+    except Exception:
+        return b64
+
+
+def _records_to_batch(records: List[dict]) -> SpanBatch:
+    if not records:
+        return empty_span_batch()
+    n = len(records)
+    services: Dict[str, int] = {}
+    endpoints: Dict[str, int] = {}
+    trace_ids: Dict[str, int] = {}
+    trace_c = np.zeros(n, np.int32)
+    service_c = np.zeros(n, np.int32)
+    endpoint_c = np.zeros(n, np.int32)
+    start_c = np.zeros(n, np.int64)
+    dur_c = np.zeros(n, np.int64)
+    err_c = np.zeros(n, np.bool_)
+    for r, rec in enumerate(records):
+        trace_c[r] = trace_ids.setdefault(str(rec.get("trace_id", "")), len(trace_ids))
+        svc = rec.get("service_name") or decode_service_id(str(rec.get("service_id", "")))
+        service_c[r] = services.setdefault(svc, len(services))
+        endpoint_c[r] = endpoints.setdefault(str(rec.get("endpoint_name", "")),
+                                             len(endpoints))
+        start_ms = int(float(rec.get("start_time", 0) or 0))
+        latency = rec.get("latency", 0)
+        end_ms = int(float(rec.get("end_time", 0) or 0))
+        start_c[r] = start_ms * 1000
+        dur_c[r] = int(float(latency or 0)) * 1000 if latency else \
+            max(0, end_ms - start_ms) * 1000
+        err_c[r] = bool(int(float(rec.get("is_error", 0) or 0)))
+    return SpanBatch(
+        trace=trace_c, parent=np.full(n, -1, np.int32), service=service_c,
+        endpoint=endpoint_c, start_us=start_c, duration_us=dur_c,
+        is_error=err_c, status=np.zeros(n, np.int16),
+        kind=np.full(n, KIND_ENTRY, np.int8),
+        services=tuple(services), endpoints=tuple(endpoints),
+        trace_ids=tuple(trace_ids),
+    ).validate()
+
+
+def load_detailed_traces_json(path: Path) -> Optional[SpanBatch]:
+    path = Path(path)
+    if not path.is_file() or is_lfs_pointer(path):
+        return None
+    with open(path) as f:
+        doc = json.load(f)
+    return _records_to_batch(doc.get("traces", []))
+
+
+def load_detailed_traces_csv(path: Path) -> Optional[SpanBatch]:
+    path = Path(path)
+    if not path.is_file() or is_lfs_pointer(path):
+        return None
+    with open(path, newline="") as f:
+        return _records_to_batch(list(csv.DictReader(f)))

@@ -100,3 +100,41 @@ def tdigest_quantile(d: TDigest, q, xp=np):
     t = xp.where(c1 > c0, (target - c0) / xp.where(c1 > c0, c1 - c0, 1.0), 0.0)
     t = xp.clip(t, 0.0, 1.0)
     return m0 + t * (m1 - m0)
+
+
+def tdigest_by_segment(values, segment_ids, n_segments: int, k: int = 64,
+                       xp=np) -> TDigest:
+    """Per-segment t-digests from a flat value stream — the vmapped
+    featurization path (BASELINE.json: per-service latency digests).
+
+    Sorts once by (segment, value), scatters each segment's run into a padded
+    [n_segments, L_max] matrix (weight 0 = padding), then builds all digests
+    with one vmapped/broadcast tdigest_build.
+    """
+    values = xp.asarray(values, dtype="float32")
+    segment_ids = xp.asarray(segment_ids)
+    n = values.shape[0]
+    if n == 0:
+        z = xp.zeros((n_segments, k), dtype="float32")
+        return TDigest(mean=z, weight=z)
+    order = xp.argsort(segment_ids * xp.asarray(1, segment_ids.dtype), stable=True) \
+        if xp is not np else np.argsort(segment_ids, kind="stable")
+    seg_s = segment_ids[order]
+    val_s = values[order]
+    # position of each row within its segment
+    idx = xp.arange(n)
+    starts = xp.searchsorted(seg_s, xp.arange(n_segments))
+    pos = idx - starts[seg_s]
+    counts = xp.bincount(seg_s, length=n_segments) if xp is not np else \
+        np.bincount(seg_s, minlength=n_segments)
+    l_max = int(counts.max()) if xp is np else int(np.asarray(counts).max())
+    l_max = max(l_max, 1)
+    padded = xp.zeros((n_segments, l_max), dtype="float32")
+    weights = xp.zeros((n_segments, l_max), dtype="float32")
+    if xp is np:
+        padded[seg_s, pos] = val_s
+        weights[seg_s, pos] = 1.0
+    else:
+        padded = padded.at[seg_s, pos].set(val_s)
+        weights = weights.at[seg_s, pos].set(1.0)
+    return tdigest_build(padded, k=k, weights=weights, xp=xp)

@@ -55,7 +55,8 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data"):
     from jax import shard_map
     fn = shard_map(shard_body, mesh=mesh,
                    in_specs=({k: P(axis) for k in
-                              ("sid", "dur", "dur_raw", "err", "s5", "valid")},),
+                              ("sid", "dur", "dur_raw", "err", "s5", "valid",
+                               "tid")},),
                    out_specs=ReplayState(agg=P(), hist=P()))
     return jax.jit(fn)
 

@@ -33,8 +33,9 @@ N_FEATS = 6
 
 
 class ReplayState(NamedTuple):
-    agg: "object"    # [S*W, F] float32
-    hist: "object"   # [S*W, H] float32 — log2-latency histogram
+    agg: "object"          # [S*W, F] float32
+    hist: "object"         # [S*W, H] float32 — log-latency histogram
+    hll: "object" = None   # [S, 2^p] int32 — distinct-trace registers (opt.)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,10 +45,15 @@ class ReplayConfig:
     n_hist_buckets: int = 16
     chunk_size: int = 1 << 15
     window_us: int = 60_000_000  # 60 s windows
+    hll_p: int = 8               # per-service distinct-trace HLL precision
 
     @property
     def sw(self) -> int:
         return self.n_services * self.n_windows
+
+    @property
+    def hll_m(self) -> int:
+        return 1 << self.hll_p
 
 
 def stage_columns(batch: SpanBatch, cfg: ReplayConfig, t0_us: Optional[int] = None):
@@ -68,18 +74,39 @@ def stage_columns(batch: SpanBatch, cfg: ReplayConfig, t0_us: Optional[int] = No
         err=p(batch.is_error.astype(np.float32)),
         s5=p((batch.status >= 500).astype(np.float32)),
         valid=p(np.ones(n, np.float32)),
+        tid=p(batch.trace.astype(np.int32)),  # for distinct-trace HLL
     )
     n_chunks = (n + pad) // cfg.chunk_size
     return {k: v.reshape(n_chunks, cfg.chunk_size) for k, v in cols.items()}, n
 
 
-def make_replay_fn(cfg: ReplayConfig):
-    """Build the jitted replay: scan over chunks, one-hot matmul aggregation."""
+def make_replay_fn(cfg: ReplayConfig, with_hll: bool = False):
+    """Build the jitted replay: scan over chunks, one-hot matmul aggregation.
+
+    ``with_hll=True`` additionally maintains per-service distinct-trace-count
+    HLL registers ([S, 2^p] int32, merged exactly by max) — the streaming
+    replacement for the reference's exact trace-ID sets
+    (trace_collector.py:358-360).
+    """
     import jax
     import jax.numpy as jnp
 
+    from anomod.ops.hll import _avalanche32, _clz32
+
     SW = cfg.sw
     H = cfg.n_hist_buckets
+    M = cfg.hll_m
+
+    def hll_update(regs, chunk):
+        sid = chunk["sid"]
+        svc = sid // cfg.n_windows                       # [C]
+        h = _avalanche32(chunk["tid"].astype(jnp.uint32), jnp)
+        bucket = (h >> jnp.uint32(32 - cfg.hll_p)).astype(jnp.int32)
+        h2 = _avalanche32(h ^ jnp.uint32(0x9E3779B9), jnp)
+        rank = jnp.minimum(_clz32(h2, jnp) + 1, jnp.int32(32))
+        rank = jnp.where(sid < SW, rank, 0)              # dead rows contribute 0
+        flat = jnp.clip(svc, 0, cfg.n_services - 1) * M + bucket
+        return regs.reshape(-1).at[flat].max(rank).reshape(cfg.n_services, M)
 
     def chunk_step(state: ReplayState, chunk):
         sid = chunk["sid"]                    # [C] int32, SW = padding
@@ -101,12 +128,15 @@ def make_replay_fn(cfg: ReplayConfig):
         bucket_oh = bucket_oh * chunk["valid"][:, None]
         hist = state.hist + jnp.matmul(
             onehot.T, bucket_oh, precision=jax.lax.Precision.HIGHEST)[:SW]
-        return ReplayState(agg=agg, hist=hist), None
+        hll = hll_update(state.hll, chunk) if with_hll else None
+        return ReplayState(agg=agg, hist=hist, hll=hll), None
 
     def replay(chunks):
         state = ReplayState(
             agg=jnp.zeros((SW, N_FEATS), jnp.float32),
-            hist=jnp.zeros((SW, H), jnp.float32))
+            hist=jnp.zeros((SW, H), jnp.float32),
+            hll=(jnp.zeros((cfg.n_services, M), jnp.int32)
+                 if with_hll else None))
         state, _ = jax.lax.scan(chunk_step, state, chunks)
         return state
 

@@ -149,3 +149,35 @@ def test_parse_gcov():
     fc = cov_io.parse_gcov(text, "svc", "x/y.cpp")
     assert fc.lines_total == 2
     assert fc.lines_covered == 1
+
+
+def test_es_trace_loader_roundtrip(tmp_path):
+    """Enhanced (Elasticsearch) collector schema -> SpanBatch."""
+    import base64
+    from anomod.io import tt_traces_es
+    doc = {
+        "timestamp": "x", "total_traces": 2,
+        "traces": [
+            {"trace_id": "t1",
+             "service_id": base64.b64encode(b"ts-travel-service").decode() + ".1",
+             "service_name": "",
+             "endpoint_name": "/api/v1/travelservice/trips",
+             "start_time": 1762180000000, "end_time": 1762180000150,
+             "latency": 150, "is_error": 0},
+            {"trace_id": "t2", "service_id": "ts-order-service.1",
+             "service_name": "ts-order-service",
+             "endpoint_name": "/api/v1/orderservice",
+             "start_time": 1762180001000, "end_time": 1762180001500,
+             "latency": 500, "is_error": 1},
+        ],
+    }
+    p = tmp_path / "detailed_traces_x.json"
+    p.write_text(json.dumps(doc))
+    b = tt_traces_es.load_detailed_traces_json(p)
+    assert b.n_spans == 2
+    assert b.n_traces == 2
+    assert tt_traces_es.decode_service_id(
+        base64.b64encode(b"ts-travel-service").decode() + ".1") == "ts-travel-service"
+    i = b.services.index("ts-order-service")
+    assert bool(b.is_error[b.service == i][0])
+    assert int(b.duration_us[b.service == i][0]) == 500_000

@@ -74,3 +74,20 @@ def test_measure_throughput_smoke(tt_batch):
     r = measure_throughput(tt_batch, cfg, repeats=1)
     assert r.n_spans == tt_batch.n_spans
     assert r.spans_per_sec > 0
+
+
+def test_replay_hll_distinct_traces(tt_batch):
+    """HLL plane counts distinct traces per service within sketch error."""
+    import numpy as np
+    from anomod.ops.hll import hll_estimate
+    cfg = ReplayConfig(n_services=tt_batch.n_services, chunk_size=2048)
+    chunks, _ = stage_columns(tt_batch, cfg)
+    fn = make_replay_fn(cfg, with_hll=True)
+    out = fn(chunks)
+    regs = np.asarray(out.hll)
+    assert regs.shape == (cfg.n_services, cfg.hll_m)
+    est = hll_estimate(regs)
+    for s in range(cfg.n_services):
+        true = len(np.unique(tt_batch.trace[tt_batch.service == s]))
+        if true >= 50:
+            assert abs(est[s] - true) / true < 0.25, (s, true, est[s])

@@ -129,3 +129,43 @@ def test_pallas_replay_kernel_interpret():
     fn = make_pallas_replay_fn(S, F, H, block=B, interpret=True)
     out = np.asarray(fn(sid, feats, bucket))
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+
+
+def test_tdigest_by_segment_matches_per_service_quantiles():
+    from anomod.ops.tdigest import tdigest_by_segment
+    rng = np.random.default_rng(11)
+    S = 7
+    seg = rng.integers(0, S, 30_000).astype(np.int32)
+    vals = rng.lognormal(3.0 + seg * 0.3, 0.8).astype(np.float32)
+    d = tdigest_by_segment(vals, seg, S, k=64)
+    assert d.mean.shape == (S, 64)
+    q99 = tdigest_quantile(d, 0.99)
+    for s in range(S):
+        exact = np.quantile(vals[seg == s], 0.99)
+        assert abs(q99[s] - exact) / exact < 0.06, (s, q99[s], exact)
+
+
+def test_tdigest_by_segment_jax_matches_numpy():
+    import jax.numpy as jnp
+    from anomod.ops.tdigest import tdigest_by_segment
+    rng = np.random.default_rng(12)
+    seg = rng.integers(0, 5, 4000).astype(np.int32)
+    vals = rng.lognormal(3.0, 1.0, 4000).astype(np.float32)
+    dn = tdigest_by_segment(vals, seg, 5, k=32)
+    dj = tdigest_by_segment(jnp.asarray(vals), jnp.asarray(seg), 5, k=32, xp=jnp)
+    np.testing.assert_allclose(np.asarray(dj.weight), dn.weight, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dj.mean), dn.mean, rtol=1e-3, atol=1e-2)
+
+
+def test_pallas_hll_kernel_interpret():
+    """Pallas HLL kernel vs the numpy HLL oracle (interpret mode on CPU)."""
+    from anomod.ops.pallas_hll import make_pallas_hll_fn
+    p = 10
+    items = (np.arange(8192, dtype=np.int64) * 2654435761 % (2**31)
+             ).astype(np.int32)
+    ref = hll_add(hll_init(p), items, p=p)
+    fn = make_pallas_hll_fn(p=p, block=1024, interpret=True)
+    out = np.asarray(fn(items))
+    np.testing.assert_array_equal(out, ref)
+    est = hll_estimate(out)
+    assert abs(est - 8192) / 8192 < 0.1
