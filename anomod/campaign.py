@@ -71,6 +71,8 @@ def _materialize_sn(exp: Experiment, label, root: Path) -> None:
     tdir.mkdir(parents=True, exist_ok=True)
     doc = synth.spans_to_jaeger_json(exp.spans)
     (tdir / "all_traces.json").write_text(json.dumps(doc))
+    from anomod.io.sn_traces import write_jaeger_csv
+    write_jaeger_csv(exp.spans, tdir / "all_traces.csv")
     (tdir / "available_services.json").write_text(json.dumps(
         {"data": sorted(set(exp.spans.services)), "total": exp.spans.n_services}))
 

@@ -32,12 +32,12 @@ class ServiceFeatures(NamedTuple):
 
 
 FEATURES = ("lat_p99_log", "lat_p50_log", "err_rate", "log_err_rate",
-            "span_count_log", "lat_mean_log")
+            "span_count_log", "lat_mean_log", "metric_level_log")
 
 
 def extract_features(exp: Experiment,
                      services: Tuple[str, ...]) -> ServiceFeatures:
-    """[S, F] features from spans + logs (metric features join in anomod.fuse)."""
+    """[S, F] multimodal features: spans + logs + per-service metric levels."""
     S = len(services)
     st = service_stats(exp.spans, services) if exp.spans is not None else None
     x = np.zeros((S, len(FEATURES)), np.float32)
@@ -59,11 +59,27 @@ def extract_features(exp: Experiment,
         np.add.at(err, svc[keep], (exp.logs.level[keep] == LOG_ERROR).astype(np.int64))
         with np.errstate(invalid="ignore"):
             x[:, 3] = np.where(tot > 0, err / np.maximum(tot, 1), 0.0)
+    if exp.metrics is not None and len(exp.metrics.services):
+        m = exp.metrics
+        svc_index = {s: i for i, s in enumerate(services)}
+        # mean log-level of all series attributed to each service
+        series_to_svc = np.array(
+            [svc_index.get(m.services[s] if s >= 0 else "", -1)
+             for s in m.series_service], np.int32)
+        sample_svc = series_to_svc[m.series]
+        keep = (sample_svc >= 0) & np.isfinite(m.value)
+        tot = np.zeros(S, np.float64)
+        cnt = np.zeros(S, np.int64)
+        np.add.at(tot, sample_svc[keep], np.log1p(np.abs(m.value[keep])))
+        np.add.at(cnt, sample_svc[keep], 1)
+        with np.errstate(invalid="ignore"):
+            x[:, 6] = np.where(cnt > 0, tot / np.maximum(cnt, 1), 0.0)
     return ServiceFeatures(services=services, x=x)
 
 
-# Score weights: latency inflation, error-rate delta, log-error delta.
-_W_LAT, _W_ERR, _W_LOG = 1.0, 4.0, 2.0
+# Score weights: latency inflation, error-rate delta, log-error delta,
+# per-service metric level rise.
+_W_LAT, _W_ERR, _W_LOG, _W_MET = 1.0, 4.0, 2.0, 0.5
 
 
 def service_scores(feat: np.ndarray, base: np.ndarray,
@@ -81,9 +97,11 @@ def service_scores(feat: np.ndarray, base: np.ndarray,
     d_log = xp.clip(feat[:, 3] - base[:, 3], 0.0, None)
     # evidence shrinkage: a p99/err estimate from a handful of spans is noise;
     # weight by n/(n+k) using the span counts carried in feature col 4 (log1p)
+    d_met = xp.clip(feat[:, 6] - base[:, 6], 0.0, None)
     n = xp.expm1(feat[:, 4])
     conf = n / (n + 20.0)
-    return conf * (_W_LAT * lat_infl + _W_ERR * d_err) + _W_LOG * d_log
+    return (conf * (_W_LAT * lat_infl + _W_ERR * d_err)
+            + _W_LOG * d_log + _W_MET * d_met)
 
 
 def experiment_score(scores) -> float:

@@ -165,3 +165,32 @@ def find_trace_artifact(exp_dir: Path) -> Optional[Path]:
         if p.is_file():
             return p
     return None
+
+
+_CSV_COLUMNS = ("trace_id", "span_id", "parent_span_id", "service", "operation",
+                "start_time", "duration_us", "http_status_code", "http_method",
+                "http_url", "component", "tags", "logs")
+
+
+def write_jaeger_csv(batch: SpanBatch, path: Path) -> None:
+    """Flatten a SpanBatch to the reference's 13-column CSV
+    (jaeger_to_csv.py:76-90) — the jaeger_to_csv flattener equivalent."""
+    from datetime import datetime, timezone
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(_CSV_COLUMNS)
+        for i in range(batch.n_spans):
+            par = int(batch.parent[i])
+            start = datetime.fromtimestamp(
+                batch.start_us[i] / 1e6, tz=timezone.utc
+            ).strftime("%Y-%m-%d %H:%M:%S.%f")
+            status = int(batch.status[i])
+            w.writerow([
+                batch.trace_ids[int(batch.trace[i])], f"s{i:08x}",
+                f"s{par:08x}" if par >= 0 else "",
+                batch.services[int(batch.service[i])],
+                batch.endpoints[int(batch.endpoint[i])],
+                start, int(batch.duration_us[i]),
+                status if status else "", "", "", "thrift",
+                json.dumps({"error": bool(batch.is_error[i])}), "",
+            ])

@@ -62,3 +62,16 @@ def test_graft_entry_dryrun():
     out = jax.jit(fn)(*args)
     assert out.shape[0] > 0
     g.dryrun_multichip(8)
+
+
+def test_hybrid_mesh_single_process():
+    from anomod.parallel.multihost import (dcn_data_parallel_spec,
+                                           initialize_distributed,
+                                           make_hybrid_mesh)
+    initialize_distributed()  # no-op single-process
+    mesh = make_hybrid_mesh()
+    assert mesh.axis_names == ("dcn", "data")
+    assert mesh.shape["dcn"] == 1
+    assert mesh.shape["data"] == 8
+    spec = dcn_data_parallel_spec(mesh)
+    assert spec == __import__("jax").sharding.PartitionSpec(("dcn", "data"))
