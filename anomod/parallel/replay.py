@@ -34,17 +34,22 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data"):
 
         def step(state, chunk):
             sid = chunk["sid"]
-            feats = jnp.stack([
-                chunk["valid"], chunk["err"], chunk["dur_raw"],
-                chunk["dur"], chunk["dur"] * chunk["dur"], chunk["s5"],
-            ], axis=1)
-            onehot = jax.nn.one_hot(sid, SW + 1, dtype=jnp.float32)
-            agg = state.agg + jnp.matmul(
-                onehot.T, feats, precision=jax.lax.Precision.HIGHEST)[:SW]
+            # same split-precision pattern as the single-chip kernel
+            onehot16 = jax.nn.one_hot(sid, SW + 1, dtype=jnp.bfloat16)
+            exact = jnp.stack([chunk["valid"], chunk["err"], chunk["s5"]],
+                              axis=1).astype(jnp.bfloat16)
+            durs = jnp.stack([chunk["dur_raw"], chunk["dur"],
+                              chunk["dur"] * chunk["dur"]], axis=1)
+            a_exact = jnp.matmul(onehot16.T, exact,
+                                 preferred_element_type=jnp.float32)[:SW]
+            a_dur = jnp.matmul(onehot16.astype(jnp.float32).T, durs,
+                               precision=jax.lax.Precision.HIGHEST)[:SW]
+            agg = state.agg + jnp.concatenate([a_exact, a_dur], axis=1)
             bucket = jnp.clip(chunk["dur"].astype(jnp.int32), 0, H - 1)
-            hid = sid * H + bucket
-            hist = state.hist.reshape(-1).at[jnp.clip(hid, 0, SW * H - 1)].add(
-                jnp.where(sid < SW, chunk["valid"], 0.0)).reshape(SW, H)
+            bucket_oh = (jax.nn.one_hot(bucket, H, dtype=jnp.bfloat16)
+                         * chunk["valid"][:, None].astype(jnp.bfloat16))
+            hist = state.hist + jnp.matmul(
+                onehot16.T, bucket_oh, preferred_element_type=jnp.float32)[:SW]
             return ReplayState(agg=agg, hist=hist), None
 
         state, _ = jax.lax.scan(step, state, chunks)

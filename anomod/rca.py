@@ -40,12 +40,13 @@ def _windowed_features(batch, services, cfg: ReplayConfig) -> np.ndarray:
     batch = batch._replace(service=remap[batch.service], services=tuple(services))
     chunks, _ = stage_columns(batch, cfg)
     st = replay_numpy(chunks, cfg)
+    from anomod.replay import F_ERR, F_LOGLAT, F_STATUS5XX
     agg = st.agg.reshape(len(services), cfg.n_windows, -1)
     count = agg[..., 0]
     safe = np.maximum(count, 1.0)
     return np.stack([
-        np.log1p(count), agg[..., 1] / safe, agg[..., 3] / safe,
-        agg[..., 5] / safe,
+        np.log1p(count), agg[..., F_ERR] / safe, agg[..., F_LOGLAT] / safe,
+        agg[..., F_STATUS5XX] / safe,
     ], axis=-1).astype(np.float32)
 
 

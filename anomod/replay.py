@@ -28,7 +28,9 @@ import numpy as np
 
 from anomod.schemas import SpanBatch
 
-F_COUNT, F_ERR, F_LAT, F_LOGLAT, F_LOGLAT2, F_STATUS5XX = range(6)
+# Feature plane order: the three exact 0/1 columns first (bf16-exact matmul),
+# the three latency moments last (HIGHEST-precision matmul).
+F_COUNT, F_ERR, F_STATUS5XX, F_LAT, F_LOGLAT, F_LOGLAT2 = range(6)
 N_FEATS = 6
 
 
@@ -110,24 +112,27 @@ def make_replay_fn(cfg: ReplayConfig, with_hll: bool = False):
 
     def chunk_step(state: ReplayState, chunk):
         sid = chunk["sid"]                    # [C] int32, SW = padding
-        # features [C, F]
-        feats = jnp.stack([
-            chunk["valid"], chunk["err"], chunk["dur_raw"],
-            chunk["dur"], chunk["dur"] * chunk["dur"], chunk["s5"],
-        ], axis=1)
         # one-hot [C, SW+1] — pad lane absorbs padding rows, dropped after.
-        # HIGHEST precision: on TPU the default bf16 matmul would round the
-        # µs-scale latency sums (and exact counts) to 8 mantissa bits.
-        onehot = jax.nn.one_hot(sid, SW + 1, dtype=jnp.float32)
-        agg = state.agg + jnp.matmul(
-            onehot.T, feats, precision=jax.lax.Precision.HIGHEST)[:SW]
+        # Split precision: the 0/1 planes (counts, errors, 5xx, histogram)
+        # are EXACT in bf16 with the MXU's f32 accumulation — one pass; only
+        # the µs-scale latency moments need the HIGHEST (6-pass) matmul.
+        onehot16 = jax.nn.one_hot(sid, SW + 1, dtype=jnp.bfloat16)
+        exact = jnp.stack([chunk["valid"], chunk["err"], chunk["s5"]],
+                          axis=1).astype(jnp.bfloat16)
+        durs = jnp.stack([chunk["dur_raw"], chunk["dur"],
+                          chunk["dur"] * chunk["dur"]], axis=1)
+        a_exact = jnp.matmul(onehot16.T, exact,
+                             preferred_element_type=jnp.float32)[:SW]
+        a_dur = jnp.matmul(onehot16.astype(jnp.float32).T, durs,
+                           precision=jax.lax.Precision.HIGHEST)[:SW]
+        agg = state.agg + jnp.concatenate([a_exact, a_dur], axis=1)
         # log-latency histogram as a second MXU matmul instead of a scatter:
         # hist[s, h] += Σ_c 1[sid=c]·1[bucket=h]  =  (onehotᵀ @ bucket_onehot)
         bucket = jnp.clip(chunk["dur"].astype(jnp.int32), 0, H - 1)
-        bucket_oh = jax.nn.one_hot(bucket, H, dtype=jnp.float32)
-        bucket_oh = bucket_oh * chunk["valid"][:, None]
+        bucket_oh = (jax.nn.one_hot(bucket, H, dtype=jnp.bfloat16)
+                     * chunk["valid"][:, None].astype(jnp.bfloat16))
         hist = state.hist + jnp.matmul(
-            onehot.T, bucket_oh, precision=jax.lax.Precision.HIGHEST)[:SW]
+            onehot16.T, bucket_oh, preferred_element_type=jnp.float32)[:SW]
         hll = hll_update(state.hll, chunk) if with_hll else None
         return ReplayState(agg=agg, hist=hist, hll=hll), None
 
@@ -154,10 +159,10 @@ def replay_numpy(chunks, cfg: ReplayConfig) -> ReplayState:
     feats = np.stack([
         chunks["valid"].reshape(-1)[valid],
         chunks["err"].reshape(-1)[valid],
+        chunks["s5"].reshape(-1)[valid],
         chunks["dur_raw"].reshape(-1)[valid],
         chunks["dur"].reshape(-1)[valid],
         (chunks["dur"] ** 2).reshape(-1)[valid],
-        chunks["s5"].reshape(-1)[valid],
     ], axis=1)
     np.add.at(agg, sid, feats.astype(np.float32))
     bucket = np.clip(chunks["dur"].reshape(-1)[valid].astype(np.int32), 0, H - 1)
