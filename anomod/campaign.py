@@ -118,6 +118,11 @@ def _materialize_sn(exp: Experiment, label, root: Path) -> None:
         "p95_latency_ms": float(np.percentile(lat, 95)),
         "p99_latency_ms": float(np.percentile(lat, 99)),
     }))
+    from anomod.io.api import analyze_api_batch
+    analysis = analyze_api_batch(exp.api)
+    (adir / "traffic_analysis.json").write_text(json.dumps(analysis))
+    (adir / "endpoint_performance.json").write_text(
+        json.dumps(analysis["endpoint_performance"]))
     with open(adir / "status_code_distribution.csv", "w") as f:
         f.write("status_code,count\n")
         for c in np.unique(exp.api.status):

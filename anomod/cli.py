@@ -94,6 +94,7 @@ def main(argv=None) -> int:
             "top1": s.top1, "top3": s.top3, "top5": s.top5,
             "detection_accuracy": s.detection_accuracy,
             "n_rca_cases": s.n_rca_cases,
+            "per_level": detect.per_level_breakdown(s),
             "per_experiment": {r.experiment: {
                 "score": round(r.score, 4),
                 "top3": r.ranked_services[:3],

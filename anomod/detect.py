@@ -170,3 +170,21 @@ def evaluate_corpus(experiments: Sequence[Experiment],
     return EvalSummary(top1=rate(1), top3=rate(3), top5=rate(5),
                        detection_accuracy=det_correct / len(results),
                        n_rca_cases=len(rca), results=results)
+
+
+def per_level_breakdown(summary: EvalSummary) -> Dict[str, Dict[str, float]]:
+    """Top-1/top-3 hit-rates split by anomaly level (performance/service/
+    database/code) — the granularity of the reference's fault taxonomy."""
+    out: Dict[str, Dict[str, float]] = {}
+    for level in ("performance", "service", "database", "code"):
+        rs = [r for r in summary.results
+              if r.is_anomaly_true and r.target_service
+              and labels_mod.label_for(r.experiment).anomaly_level == level]
+        if not rs:
+            continue
+        out[level] = {
+            "n": len(rs),
+            "top1": sum(bool(r.hit(1)) for r in rs) / len(rs),
+            "top3": sum(bool(r.hit(3)) for r in rs) / len(rs),
+        }
+    return out

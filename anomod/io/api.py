@@ -81,3 +81,34 @@ def write_api_jsonl(batch: ApiBatch, path: Path) -> None:
                 "latency_ms": round(float(batch.latency_ms[i]), 2),
                 "content_length": int(batch.content_length[i]),
             }) + "\n")
+
+
+def analyze_api_batch(batch: ApiBatch) -> dict:
+    """Traffic analysis over an ApiBatch — the analyzer analog of
+    analyze_http_traffic.py (tshark post-processor: request/status/method
+    distributions) and the monitor's endpoint_performance.json
+    (enhanced_openapi_monitor.py:318-397)."""
+    lat = batch.latency_ms.astype(float)
+    status_counts = {int(c): int((batch.status == c).sum())
+                     for c in np.unique(batch.status)}
+    per_endpoint = {}
+    for i, ep in enumerate(batch.endpoints):
+        m = batch.endpoint == i
+        if not m.any():
+            continue
+        el = lat[m]
+        per_endpoint[ep] = {
+            "requests": int(m.sum()),
+            "error_rate": float((batch.status[m] >= 400).mean()),
+            "avg_latency_ms": float(el.mean()),
+            "p95_latency_ms": float(np.percentile(el, 95)),
+            "p99_latency_ms": float(np.percentile(el, 99)),
+        }
+    return {
+        "total_requests": int(batch.n_records),
+        "status_distribution": status_counts,
+        "method_distribution": {"GET": int(batch.n_records)},
+        "error_rate": float((batch.status >= 400).mean()),
+        "avg_latency_ms": float(lat.mean()) if len(lat) else 0.0,
+        "endpoint_performance": per_endpoint,
+    }
