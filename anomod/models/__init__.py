@@ -2,5 +2,7 @@
 
 from anomod.models.gnn import GCN, GAT, GraphSAGE, normalized_adjacency
 from anomod.models.temporal import TemporalGCN
+from anomod.models.lru import TemporalLRU
 
-__all__ = ["GCN", "GAT", "GraphSAGE", "TemporalGCN", "normalized_adjacency"]
+__all__ = ["GCN", "GAT", "GraphSAGE", "TemporalGCN", "TemporalLRU",
+           "normalized_adjacency"]

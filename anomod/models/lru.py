@@ -1,0 +1,38 @@
+"""LRU-style temporal model: associative linear recurrence over windows.
+
+Unlike the GRU (models/temporal.py), the recurrence here is associative —
+``h_t = σ(decay) ⊙ h_{t-1} + W x_t`` — so it parallelizes over time both
+within a device (``lax.associative_scan``, log-depth) and across devices
+(anomod.parallel.seqscan block scan).  This is the long-context temporal
+scorer: window streams can shard over the mesh with exact results.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from anomod.models.gnn import GCNLayer, normalized_adjacency
+from anomod.parallel.seqscan import linear_recurrence
+
+
+class TemporalLRU(nn.Module):
+    """Linear-recurrence temporal encoder + 2-layer GCN head."""
+    hidden: int = 64
+    gnn_hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x_swf, adj_counts):
+        S = x_swf.shape[0]
+        x = nn.Dense(self.hidden)(x_swf)            # [S, W, hidden]
+        # learnable per-channel decay in (0, 1)
+        decay_logit = self.param("decay_logit", nn.initializers.uniform(2.0),
+                                 (self.hidden,))
+        decay = nn.sigmoid(decay_logit + 1.0)
+        xs = jnp.swapaxes(x, 0, 1)                  # [W, S, hidden]
+        h_all = linear_recurrence(xs, decay)        # [W, S, hidden]
+        h_final = h_all[-1]
+        a = normalized_adjacency(adj_counts)
+        h = nn.relu(GCNLayer(self.gnn_hidden)(h_final, a))
+        h = nn.relu(GCNLayer(self.gnn_hidden)(h, a))
+        return nn.Dense(1)(h)[:, 0]

@@ -54,7 +54,7 @@ def make_distributed_train_step(model_name: str, sample_batch: dict, mesh):
     rng = jax.random.PRNGKey(0)
     if model_name == "gcn":
         params = model.init(rng, sample0["x"], jnp.asarray(sample0["adj"]))
-    elif model_name == "temporal":
+    elif model_name in ("temporal", "lru"):
         W = sample0["x_t"].shape[1]
         fused = np.concatenate(
             [sample0["x_t"], np.repeat(sample0["x"][:, None, :], W, axis=1)],

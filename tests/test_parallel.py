@@ -75,3 +75,29 @@ def test_hybrid_mesh_single_process():
     assert mesh.shape["data"] == 8
     spec = dcn_data_parallel_spec(mesh)
     assert spec == __import__("jax").sharding.PartitionSpec(("dcn", "data"))
+
+
+def test_seqpar_linear_recurrence_matches_single_device():
+    import jax
+    import jax.numpy as jnp
+    from anomod.parallel import make_mesh
+    from anomod.parallel.seqscan import linear_recurrence, make_seqpar_recurrence
+
+    rng = np.random.default_rng(3)
+    T, S, F = 64, 12, 5          # 64 windows sharded over 8 devices
+    xs = rng.normal(0, 1, (T, S, F)).astype(np.float32)
+    decay = rng.uniform(0.5, 0.99, (S, F)).astype(np.float32)
+
+    ref = np.asarray(linear_recurrence(jnp.asarray(xs), jnp.asarray(decay)))
+    # sequential oracle
+    h = np.zeros((S, F), np.float32)
+    seq = np.zeros_like(xs)
+    for t in range(T):
+        h = decay * h + xs[t]
+        seq[t] = h
+    np.testing.assert_allclose(ref, seq, rtol=1e-4, atol=1e-5)
+
+    mesh = make_mesh(8)
+    fn = make_seqpar_recurrence(mesh)
+    out = np.asarray(fn(jnp.asarray(xs), jnp.asarray(decay)))
+    np.testing.assert_allclose(out, seq, rtol=1e-4, atol=1e-5)
