@@ -50,6 +50,12 @@ def main(argv=None) -> int:
     p_camp.add_argument("--traces", type=int, default=200)
     p_camp.add_argument("--experiments", nargs="*", default=None)
 
+    p_val = sub.add_parser("validate", help="data-quality validation report "
+                           "over a corpus (reference-style embedded checks)")
+    p_val.add_argument("--testbed", choices=["SN", "TT"], default="TT")
+    p_val.add_argument("--traces", type=int, default=60)
+    p_val.add_argument("--from-data", action="store_true")
+
     p_replay = sub.add_parser("replay", help="measure span replay throughput")
     p_replay.add_argument("--testbed", choices=["SN", "TT"], default="TT")
     p_replay.add_argument("--traces", type=int, default=2000)
@@ -113,6 +119,21 @@ def main(argv=None) -> int:
             "top1": r.top1, "top3": r.top3,
             "detection_auc": r.detection_auc, "n_eval": r.n_eval,
         }))
+        return 0
+
+    if args.cmd == "validate":
+        from anomod import labels, synth
+        from anomod.io import dataset
+        from anomod.validate import validate_experiment
+        if args.from_data:
+            corpus = dataset.load_corpus(args.testbed, n_synth_traces=args.traces)
+        else:
+            corpus = [synth.generate_experiment(l, n_traces=args.traces)
+                      for l in labels.labels_for_testbed(args.testbed)]
+        reports = [validate_experiment(e).to_dict() for e in corpus]
+        print(json.dumps({"testbed": args.testbed,
+                          "ok": all(r["ok"] for r in reports),
+                          "reports": reports}, indent=2))
         return 0
 
     if args.cmd == "campaign":

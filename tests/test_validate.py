@@ -1,0 +1,42 @@
+"""Data-quality validation + trace dedup."""
+
+import numpy as np
+
+from anomod import synth, labels
+from anomod.schemas import concat_span_batches, Experiment
+from anomod.validate import dedup_traces, validate_experiment
+
+
+def test_validate_clean_experiment():
+    exp = synth.generate_experiment("Lv_P_CPU_preserve", n_traces=30)
+    rep = validate_experiment(exp)
+    assert rep.ok, [i.message for i in rep.issues if i.severity == "error"]
+    assert rep.counts["spans"] > 0
+    assert rep.counts["metric_samples"] > 0
+    d = rep.to_dict()
+    assert d["experiment"] == "Lv_P_CPU_preserve"
+
+
+def test_validate_missing_modalities():
+    exp = Experiment(name="Normal_case", testbed="TT")
+    rep = validate_experiment(exp)
+    assert not rep.ok
+    mods = {i.modality for i in rep.issues if i.severity == "error"}
+    assert "traces" in mods and "metrics" in mods
+
+
+def test_dedup_traces_removes_repeats():
+    b = synth.generate_spans(labels.label_for("Normal_case"), n_traces=20)
+    doubled = concat_span_batches([b, b])
+    # concat re-interns trace ids so both copies share ids -> true duplicates
+    dd = dedup_traces(doubled)
+    assert dd.n_spans == b.n_spans
+    # parent links stay consistent
+    nz = dd.parent >= 0
+    assert (dd.trace[nz] == dd.trace[dd.parent[nz]]).all()
+
+
+def test_dedup_noop_on_clean_batch():
+    b = synth.generate_spans(labels.label_for("Normal_case"), n_traces=20)
+    dd = dedup_traces(b)
+    assert dd.n_spans == b.n_spans
