@@ -279,11 +279,24 @@ def generate_spans(label: FaultLabel, n_traces: int = 200,
     # SN host-level performance faults hit every service.
     host_level = label.is_anomaly and target_idx < 0
 
-    # Round-robin template assignment (shuffled): the reference replays the
-    # complete EvoMaster suite each iteration, so every call path shows up in
-    # every experiment — random sampling would leave rare paths out of the
-    # normal baseline and fabricate latency-inflation artifacts.
-    tpl_ids = np.arange(n_traces) % len(templates)
+    # Deterministic proportional template assignment: every call path shows
+    # up in every experiment (the reference replays its complete suite each
+    # iteration — random sampling would leave rare paths out of the normal
+    # baseline and fabricate latency-inflation artifacts), with SN templates
+    # weighted by the wrk2 request mix (mixed-workload.lua:113-115).
+    weights = np.ones(len(templates))
+    if label.testbed == "SN":
+        from anomod.workload import SN_REQUEST_MIX
+        svc_of_root_child = [services[tpl[2][0]] if len(tpl) > 2 else ""
+                             for tpl in templates]
+        for i, svc in enumerate(svc_of_root_child):
+            weights[i] = SN_REQUEST_MIX.get(svc, 0.05) * 10
+    alloc = np.maximum((weights / weights.sum() * n_traces).astype(int), 1)
+    # trim/pad to exactly n_traces while keeping every template present
+    tpl_ids = np.repeat(np.arange(len(templates)), alloc)[:n_traces]
+    if tpl_ids.shape[0] < n_traces:
+        tpl_ids = np.concatenate([
+            tpl_ids, np.arange(n_traces - tpl_ids.shape[0]) % len(templates)])
     rng.shuffle(tpl_ids)
     # Per-service baseline latency (ms, lognormal median), deterministic per testbed.
     svc_rng = np.random.default_rng(_seed_for(label.testbed, 7))

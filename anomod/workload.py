@@ -1,0 +1,54 @@
+"""Workload-suite helpers — analogs of the EvoMaster test utilities and the
+wrk2 mixed-workload request mix.
+
+- ``resolve_location``: merge a ``Location`` response header against a URI
+  template, the behavior of the reference's generated-suite helper
+  (BlackBox_tests/Final_version_2m/em_test_utils.py:4-26) re-implemented
+  fresh on urllib.
+- ``is_valid_uri_or_empty``: permissive URI syntax check
+  (em_test_utils.py:27-46 uses rfc3986; this uses urllib splitting).
+- ``SN_REQUEST_MIX``: the wrk2 workload distribution
+  (mixed-workload.lua:113-115 — 60% home-timeline read, 30% user-timeline
+  read, 10% compose), used by the synthetic generator's SN template
+  weighting.
+"""
+
+from __future__ import annotations
+
+from urllib.parse import urlparse, urlunparse
+
+# mixed-workload.lua:113-115
+SN_REQUEST_MIX = {
+    "home-timeline-service": 0.60,
+    "user-timeline-service": 0.30,
+    "compose-post-service": 0.10,
+}
+
+
+def resolve_location(location_header: str, expected_template: str) -> str:
+    """Resolve a Location header against the URI template of the followed-up
+    endpoint: absolute locations win; relative ones adopt the template's
+    scheme/authority; an empty location falls back to the template."""
+    if not location_header:
+        return expected_template
+    loc = urlparse(location_header)
+    if loc.scheme and loc.netloc:
+        return location_header
+    tpl = urlparse(expected_template)
+    path = location_header if location_header.startswith("/") else \
+        "/" + location_header
+    return urlunparse((tpl.scheme, tpl.netloc, path, "", loc.query, ""))
+
+
+def is_valid_uri_or_empty(uri: str) -> bool:
+    """True for "" or a syntactically plausible absolute/relative URI."""
+    if uri == "":
+        return True
+    try:
+        parsed = urlparse(uri)
+    except ValueError:
+        return False
+    if parsed.scheme and not parsed.netloc and not parsed.path:
+        return False
+    # reject whitespace and control characters anywhere
+    return not any(c.isspace() or ord(c) < 32 for c in uri)

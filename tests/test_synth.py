@@ -157,3 +157,32 @@ def test_jaeger_json_schema():
     for key in ("traceID", "spanID", "processID", "operationName",
                 "startTime", "duration", "references", "tags"):
         assert key in sp
+
+
+def test_workload_helpers():
+    from anomod.workload import is_valid_uri_or_empty, resolve_location
+    assert resolve_location("", "http://h:8080/api/x") == "http://h:8080/api/x"
+    assert resolve_location("http://other/api/y", "http://h:8080/api/x") \
+        == "http://other/api/y"
+    assert resolve_location("/api/y/123", "http://h:8080/api/x") \
+        == "http://h:8080/api/y/123"
+    assert is_valid_uri_or_empty("")
+    assert is_valid_uri_or_empty("/api/v1/orders/5")
+    assert is_valid_uri_or_empty("http://x/y?z=1")
+    assert not is_valid_uri_or_empty("has space")
+
+
+def test_sn_request_mix_weighting():
+    # home-timeline-rooted templates dominate SN traffic (wrk2 60/30/10 mix)
+    b = synth.generate_spans(labels.label_for("Normal_Baseline"), n_traces=300)
+    ht = b.services.index("home-timeline-service")
+    ut = b.services.index("user-timeline-service")
+    # count ROOT-adjacent entries: spans whose parent is the nginx root
+    root_child = b.parent >= 0
+    nginx = b.services.index("nginx-web-server")
+    first_hop = root_child & (b.service[np.clip(b.parent, 0, None)] == nginx)
+    ht_n = (b.service[first_hop] == ht).sum()
+    ut_n = (b.service[first_hop] == ut).sum()
+    assert ht_n > ut_n  # 60% vs 30%
+    # every template still present: all 12 services appear
+    assert len(np.unique(b.service)) == len(b.services)
