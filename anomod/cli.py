@@ -56,6 +56,12 @@ def main(argv=None) -> int:
     p_val.add_argument("--traces", type=int, default=60)
     p_val.add_argument("--from-data", action="store_true")
 
+    p_chaos = sub.add_parser(
+        "chaos", help="render the fault-injection plan for an experiment "
+        "(Chaos Mesh CRD YAML / ChaosBlade argv / docker argv)")
+    p_chaos.add_argument("experiment")
+    p_chaos.add_argument("--format", choices=["yaml", "json"], default="yaml")
+
     p_replay = sub.add_parser("replay", help="measure span replay throughput")
     p_replay.add_argument("--testbed", choices=["SN", "TT"], default="TT")
     p_replay.add_argument("--traces", type=int, default=2000)
@@ -143,6 +149,33 @@ def main(argv=None) -> int:
                             n_traces=args.traces)
         print(json.dumps({"testbed": args.testbed, "out": args.out,
                           "experiments": done}))
+        return 0
+
+    if args.cmd == "chaos":
+        from anomod import chaos, labels
+        label = labels.label_for(args.experiment)
+        if label is None:
+            print(f"unknown experiment: {args.experiment}", file=sys.stderr)
+            return 1
+        plan = {"experiment": label.experiment, "tool": label.chaos_tool}
+        if label.chaos_tool == "chaosmesh":
+            if args.format == "yaml":
+                print(chaos.mesh_crd_yaml(label))
+                return 0
+            plan["crd"] = chaos.build_mesh_crd(label)
+        elif label.chaos_tool == "chaosblade":
+            cmd = chaos.blade_create_command(label)
+            if cmd is not None:
+                plan["blade"] = list(cmd.args)
+                plan["needs_sudo"] = cmd.needs_sudo
+            dc = chaos.docker_command(label)
+            if dc is not None:
+                plan["docker"] = list(dc)
+        if args.format == "yaml":
+            import yaml
+            print(yaml.safe_dump(plan, sort_keys=False), end="")
+        else:
+            print(json.dumps(plan, indent=2))
         return 0
 
     if args.cmd == "replay":

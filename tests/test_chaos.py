@@ -1,0 +1,140 @@
+"""Fault-injection subsystem: CRD roundtrip, blade argv, lifecycle."""
+
+import json
+
+import pytest
+
+from anomod import chaos, labels
+
+
+def test_mesh_crd_roundtrip_all():
+    for exp in chaos.mesh_experiments():
+        label = labels.label_for(exp)
+        doc = chaos.build_mesh_crd(label)
+        assert doc["apiVersion"] == "chaos-mesh.org/v1alpha1"
+        meta = doc["metadata"]["labels"]
+        assert meta["anomaly_level"] == label.anomaly_level
+        assert meta["anomaly_type"] == label.anomaly_type
+        back = chaos.parse_mesh_crd_yaml(chaos.mesh_crd_yaml(exp))
+        assert back == label
+
+
+def test_mesh_covers_every_tt_chaosmesh_label():
+    want = {l.experiment for l in labels.TT_LABELS if l.chaos_tool == "chaosmesh"}
+    assert want == set(chaos.mesh_experiments())
+
+
+def test_mesh_crd_shapes():
+    cpu = chaos.build_mesh_crd("Lv_P_CPU_preserve")
+    assert cpu["kind"] == "StressChaos"
+    assert cpu["spec"]["stressors"]["cpu"] == {"workers": 2, "load": 80}
+    assert cpu["spec"]["selector"]["labelSelectors"]["app"] == "ts-preserve-service"
+
+    kill = chaos.build_mesh_crd("Lv_S_KILLPOD_preserve")
+    assert kill["kind"] == "Schedule"
+    assert kill["spec"]["schedule"] == "@every 3s"
+    assert kill["spec"]["podChaos"]["action"] == "pod-kill"
+    # Schedule nests selector/mode inside podChaos, not at spec level
+    assert "selector" not in kill["spec"] and "mode" not in kill["spec"]
+
+    http = chaos.build_mesh_crd("Lv_S_HTTPABORT_preserve")
+    assert http["spec"]["abort"] is True
+    assert http["spec"]["replace"]["code"] == 503
+    assert http["spec"]["value"] == "70"
+
+    delay = chaos.build_mesh_crd("Lv_D_TRANSACTION_timeout")
+    assert delay["spec"]["delay"]["latency"] == "15s"
+    assert delay["spec"]["direction"] == "to"
+
+    pool = chaos.build_mesh_crd("Lv_D_CONNECTION_POOL_exhaustion")
+    assert pool["spec"]["direction"] == "from"
+    sel = pool["spec"]["target"]["selector"]["expressionSelectors"][0]
+    assert "ts-order-service" in sel["values"]
+
+
+def test_blade_commands_sn():
+    cpu = chaos.blade_create_command("Perf_CPU_Contention")
+    assert cpu.args[:3] == ("create", "cpu", "load") and not cpu.needs_sudo
+
+    net = chaos.blade_create_command("Perf_Network_Loss")
+    assert net.needs_sudo and "docker0" in net.args
+
+    kill = chaos.blade_create_command("Svc_Kill_Media")
+    assert "MediaService" in kill.args and "--signal" in kill.args
+
+    redis = chaos.blade_create_command("DB_Redis_CacheLimit_HomeTimeline")
+    assert any("home-timeline-redis" in a for a in redis.args)
+
+    # code-level SN faults are docker stop, not blade
+    assert chaos.blade_create_command("Code_Stop_UserService") is None
+    assert chaos.docker_command("Code_Stop_UserService") == (
+        "docker", "stop", "socialnetwork_user-service_1")
+
+
+def test_blade_commands_tt_jvm():
+    sec = chaos.blade_create_command("Lv_C_security_check")
+    assert sec.k8s and "container-jvm" in sec.args and "return" in sec.args
+    assert "security.service.SecurityServiceImpl" in sec.args
+
+    exc = chaos.blade_create_command("Lv_C_exception_injection")
+    assert "throwCustomException" in exc.args
+    assert "CHAOS_EXCEPTION_INJECTION" in exc.args
+
+    trv = chaos.blade_create_command("Lv_C_travel_detail_failure")
+    assert "getTripAllDetailInfo" in trv.args
+
+
+def test_parse_blade_output_formats():
+    assert chaos.parse_blade_output(
+        '{"code":200,"success":true,"result":"abc123"}') == "abc123"
+    assert chaos.parse_blade_output('{"Uid":"def456","ok":1}') == "def456"
+    assert chaos.parse_blade_output("created\nuid: 789xyz\n") == "789xyz"
+    assert chaos.parse_blade_output("nothing here") is None
+
+
+def test_controller_lifecycle():
+    ctl = chaos.ChaosController()
+    out = ctl.create_result_json("Lv_P_CPU_preserve")
+    uid = chaos.parse_blade_output(out)
+    assert uid and len(ctl.status()) == 1
+
+    # active fault conditions the target service, not others
+    lat, err = ctl.active_effects("ts-preserve-service")
+    assert lat > 1.0
+    lat2, _ = ctl.active_effects("ts-station-service")
+    assert lat2 == 1.0
+
+    assert ctl.destroy(uid)
+    assert not ctl.destroy(uid)
+    assert ctl.status() == []
+
+
+def test_controller_sweep_and_context():
+    ctl = chaos.ChaosController()
+    ctl.create("Perf_CPU_Contention")
+    ctl.create("Svc_Kill_Media")
+    assert ctl.destroy_all() == 2
+
+    with ctl.inject("Lv_D_TRANSACTION_timeout") as h:
+        assert ctl.status() == [h]
+        lat, err = ctl.active_effects("ts-order-service")
+        assert lat >= 10.0
+    assert ctl.status() == []
+
+    # normal experiments inject nothing
+    h = ctl.create("Normal_case")
+    assert h.plan == "none" and ctl.status() == []
+
+
+def test_host_level_fault_hits_every_service():
+    ctl = chaos.ChaosController()
+    ctl.create("Perf_CPU_Contention")  # SN host-level: target_service == ""
+    lat, _ = ctl.active_effects("user-service")
+    assert lat > 1.0
+
+
+def test_unknown_experiment_rejected():
+    with pytest.raises(ValueError):
+        chaos.build_mesh_crd("Lv_C_security_check")  # blade, not mesh
+    with pytest.raises(ValueError):
+        chaos.ChaosController().create("NoSuchExperiment")
