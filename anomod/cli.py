@@ -62,6 +62,14 @@ def main(argv=None) -> int:
     p_chaos.add_argument("experiment")
     p_chaos.add_argument("--format", choices=["yaml", "json"], default="yaml")
 
+    p_scen = sub.add_parser(
+        "scenario", help="drive the TT user-journey workload against the "
+        "synthetic SUT (optionally under an injected fault)")
+    p_scen.add_argument("--iterations", type=int, default=1)
+    p_scen.add_argument("--seed", type=int, default=0)
+    p_scen.add_argument("--chaos", default=None,
+                        help="experiment name to inject during the run")
+
     p_replay = sub.add_parser("replay", help="measure span replay throughput")
     p_replay.add_argument("--testbed", choices=["SN", "TT"], default="TT")
     p_replay.add_argument("--traces", type=int, default=2000)
@@ -176,6 +184,41 @@ def main(argv=None) -> int:
             print(yaml.safe_dump(plan, sort_keys=False), end="")
         else:
             print(json.dumps(plan, indent=2))
+        return 0
+
+    if args.cmd == "scenario":
+        import numpy as np
+
+        from anomod import labels, scenario
+        from anomod.chaos import ChaosController
+        if args.iterations < 1:
+            print("--iterations must be >= 1", file=sys.stderr)
+            return 1
+        ctl = None
+        if args.chaos:
+            label = labels.label_for(args.chaos)
+            if label is None:
+                print(f"unknown experiment: {args.chaos}", file=sys.stderr)
+                return 1
+            if label.testbed != "TT":
+                print(f"{label.experiment} is an {label.testbed} fault; the "
+                      "scenario workload drives the TT testbed", file=sys.stderr)
+                return 1
+            ctl = ChaosController()
+            ctl.create(label)
+        batch = scenario.run_scenario(iterations=args.iterations,
+                                      seed=args.seed, controller=ctl)
+        by_status = {str(c): int((batch.status == c).sum())
+                     for c in np.unique(batch.status)}
+        print(json.dumps({
+            "requests": batch.n_records,
+            "endpoints": len(batch.endpoints),
+            "status_codes": by_status,
+            "error_rate": round(float((batch.status >= 500).mean()), 4),
+            "avg_latency_ms": round(float(batch.latency_ms.mean()), 2),
+            "p99_latency_ms": round(float(np.percentile(batch.latency_ms, 99)), 2),
+            "chaos": args.chaos,
+        }))
         return 0
 
     if args.cmd == "replay":

@@ -1,0 +1,65 @@
+"""Social-graph seeder: scale, determinism, program shape."""
+
+import numpy as np
+
+from anomod import seeder
+
+
+def test_graph_scale_and_determinism():
+    g = seeder.generate_graph()
+    assert g.n_users == seeder.REED98_USERS
+    assert g.n_edges == seeder.REED98_EDGES
+    # no self loops, no duplicates, u < v canonical form
+    assert (g.edges[:, 0] < g.edges[:, 1]).all()
+    assert len({(int(a), int(b)) for a, b in g.edges}) == g.n_edges
+
+    g2 = seeder.generate_graph()
+    assert np.array_equal(g.edges, g2.edges)
+    assert np.array_equal(g.posts_per_user, g2.posts_per_user)
+    g3 = seeder.generate_graph(seed=2)
+    assert not np.array_equal(g.edges, g3.edges)
+
+
+def test_heavy_tail_degrees():
+    g = seeder.generate_graph()
+    deg = g.follower_counts()
+    # heavy tail: the top user has far more followers than the median
+    assert deg.max() > 8 * max(np.median(deg), 1)
+    assert deg.sum() == 2 * g.n_edges
+
+
+def test_seeding_program_shape():
+    g = seeder.generate_graph(n_users=50, n_edges=120)
+    ops = seeder.seeding_program(g, compose=True)
+    n_reg = sum(1 for o in ops if o.path.endswith("register"))
+    n_fol = sum(1 for o in ops if o.path.endswith("follow"))
+    n_cmp = sum(1 for o in ops if o.path.endswith("compose"))
+    assert n_reg == 50
+    assert n_fol == 2 * 120            # both directions per edge
+    assert n_cmp == int(g.posts_per_user.sum())
+    # registers precede follows precede composes
+    kinds = [o.path.rsplit("/", 1)[1] for o in ops]
+    assert kinds.index("follow") == 50
+    assert "register" not in kinds[50:]
+
+
+def test_waves_batching():
+    g = seeder.generate_graph(n_users=30, n_edges=40)
+    ops = seeder.seeding_program(g)
+    batches = list(seeder.waves(ops, limit=32))
+    assert all(len(b) <= 32 for b in batches)
+    assert sum(len(b) for b in batches) == len(ops)
+
+
+def test_timeline_weights():
+    g = seeder.generate_graph(n_users=100, n_edges=300)
+    w = seeder.timeline_weights(g)
+    assert np.isclose(w.sum(), 1.0)
+    assert (w >= 0).all() and len(w) == 100
+    # hottest user gets the biggest weight
+    assert w.argmax() == g.follower_counts().argmax()
+
+
+def test_posts_average_about_ten():
+    g = seeder.generate_graph()
+    assert 8.0 < g.posts_per_user.mean() < 12.0
