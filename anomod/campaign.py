@@ -176,30 +176,17 @@ def _materialize_tt(exp: Experiment, label, root: Path) -> None:
     adir.mkdir(parents=True, exist_ok=True)
     write_api_jsonl(exp.api, adir / "api_responses.jsonl")
 
-    # coverage_report/<exp>/<svc>/coverage-summary.txt (+ minimal xml)
-    for si, svc in enumerate(exp.coverage.services):
-        rows = np.flatnonzero(exp.coverage.service == si)
-        total = int(exp.coverage.lines_total[rows].sum())
-        covered = int(exp.coverage.lines_covered[rows].sum())
-        pct = covered * 100 // max(total, 1)
-        sdir = root / "coverage_report" / base / svc
-        sdir.mkdir(parents=True, exist_ok=True)
-        (sdir / "coverage-summary.txt").write_text(
-            "==================================================================\n"
-            "  Simple Code Coverage Report\n"
-            "------------------------------------------------------------------\n"
-            f"Service: {svc}\n"
-            "------------------------------------------------------------------\n"
-            f"TOTAL               Lines    {total}  Cover  {pct}%\n"
-            "------------------------------------------------------------------\n")
-        sf = "".join(
-            f'<sourcefile name="f{i}.java"><counter type="LINE" '
-            f'missed="{int(exp.coverage.lines_total[r] - exp.coverage.lines_covered[r])}" '
-            f'covered="{int(exp.coverage.lines_covered[r])}"/></sourcefile>'
-            for i, r in enumerate(rows))
-        (sdir / "coverage.xml").write_text(
-            f'<?xml version="1.0"?><report name="synthetic">'
-            f'<package name="{svc}">{sf}</package></report>')
+    # coverage: per-pod exec-analog dumps + per-service merged report tree
+    # (collect_coverage_reports.sh:54-191 pipeline shape)
+    from anomod.io.coverage_report import batch_to_dumps, collect_coverage_reports
+    dumps = batch_to_dumps(exp.coverage,
+                           seed=int(synth._seed_for(exp.name, 13) % 2**31))
+    # pod identity must match the log tree's naming (same salt) so modalities
+    # correlate by pod the way the reference dataset does
+    pods = {f"{d.service}-{synth._seed_for(d.service, 1) % 0xfffff:05x}": [d]
+            for d in dumps}
+    collect_coverage_reports(pods, root / "coverage_data" / base,
+                             root / "coverage_report" / base)
 
 
 def run_campaign(testbed: str, out_dir: Path,

@@ -318,6 +318,7 @@ class ScenarioDriver:
             out.append(dataclasses.replace(collect_one_order(paid.order_id), flow="core"))
             out.append(dataclasses.replace(enter_station(paid.order_id), flow="core"))
             out.append(dataclasses.replace(rebook_ticket(paid.order_id), flow="core"))
+            self._orders.remove(paid)   # ticket used; keep state bounded
         return out
 
     def auxiliary_flow(self) -> List[RequestSpec]:
@@ -365,6 +366,7 @@ class ScenarioDriver:
             o.paid = True
             out.append(dataclasses.replace(collect_one_order(o.order_id), flow="complete"))
             out.append(dataclasses.replace(enter_station(o.order_id), flow="complete"))
+            self._orders.remove(o)      # ticket used; keep state bounded
         return out
 
     def iteration(self) -> List[RequestSpec]:

@@ -1,0 +1,98 @@
+"""Coverage dump/merge/report pipeline (jacococli analog)."""
+
+import numpy as np
+import pytest
+
+from anomod import synth
+from anomod.io import coverage_report as cr
+from anomod.io.coverage import load_tt_coverage_report, parse_jacoco_xml, \
+    parse_summary_txt
+
+
+def _dump(service="ts-order-service", n=20, covered_idx=(0, 1, 2)):
+    mask = np.zeros(n, bool)
+    mask[list(covered_idx)] = True
+    return cr.CoverageDump(service, {"a/File.java": mask})
+
+
+def test_merge_is_probe_union():
+    a = _dump(covered_idx=(0, 1, 2))
+    b = _dump(covered_idx=(2, 3))
+    m = cr.merge_dumps([a, b])
+    assert m.lines_covered == 4          # {0,1,2,3}
+    assert m.lines_total == 20
+    # merge of disjoint files unions the file set
+    c = cr.CoverageDump("ts-order-service",
+                        {"b/Other.java": np.ones(5, bool)})
+    m2 = cr.merge_dumps([a, c])
+    assert set(m2.files) == {"a/File.java", "b/Other.java"}
+    assert m2.lines_covered == 3 + 5
+    # length mismatch pads with uncovered
+    d = cr.CoverageDump("ts-order-service",
+                        {"a/File.java": np.ones(25, bool)})
+    m3 = cr.merge_dumps([a, d])
+    assert m3.files["a/File.java"].size == 25
+    assert m3.lines_covered == 25
+
+
+def test_merge_rejects_cross_service():
+    with pytest.raises(ValueError):
+        cr.merge_dumps([_dump("ts-a"), _dump("ts-b")])
+    with pytest.raises(ValueError):
+        cr.merge_dumps([])
+
+
+def test_dump_save_load_roundtrip(tmp_path):
+    d = _dump(n=77, covered_idx=tuple(range(0, 77, 3)))
+    p = tmp_path / "dump.npz"
+    cr.save_dump(d, p)
+    back = cr.load_dump(p)
+    assert back.service == d.service
+    assert set(back.files) == set(d.files)
+    assert np.array_equal(back.files["a/File.java"], d.files["a/File.java"])
+
+
+def test_xml_and_summary_roundtrip():
+    d = _dump(n=500, covered_idx=tuple(range(215)))
+    xml = cr.write_jacoco_xml(d)
+    total = cr.parse_total_from_xml(xml)
+    assert total == {"covered": 215, "missed": 285}
+    # the existing per-sourcefile parser reads the same document
+    files = parse_jacoco_xml(xml, "ts-order-service")
+    assert files[0].lines_covered == 215 and files[0].lines_total == 500
+
+    txt = cr.write_summary_txt("ts-order-service", 500, 215)
+    fc = parse_summary_txt(txt, "ts-order-service")
+    assert fc.lines_total == 500 and fc.lines_covered == 215
+    assert "Cover  43%" in txt   # the reference example ratio
+
+
+def test_batch_dump_batch_roundtrip():
+    exp = synth.generate_experiment("Lv_C_exception_injection", n_traces=20)
+    dumps = cr.batch_to_dumps(exp.coverage)
+    back = cr.dumps_to_batch(dumps)
+    assert back.lines_total.sum() == exp.coverage.lines_total.sum()
+    assert back.lines_covered.sum() == exp.coverage.lines_covered.sum()
+
+
+def test_collect_coverage_reports_tree(tmp_path):
+    exp = synth.generate_experiment("Normal_case", n_traces=10)
+    dumps = cr.batch_to_dumps(exp.coverage)
+    # two pods per service dump the same coverage → merge is idempotent union
+    pods = {f"{d.service}-pod-a": [d] for d in dumps[:5]}
+    pods.update({f"{d.service}-pod-b": [d] for d in dumps[:5]})
+    totals = cr.collect_coverage_reports(
+        pods, tmp_path / "coverage_data", tmp_path / "coverage_report")
+    assert len(totals) == 5
+    svc = dumps[0].service
+    sdir = tmp_path / "coverage_report" / svc
+    assert (sdir / "coverage.xml").exists()
+    assert (sdir / "coverage-summary.txt").exists()
+    assert (sdir / "merged.npz").exists()
+    # merged union of identical dumps == the single dump
+    assert totals[svc]["lines_covered"] == dumps[0].lines_covered
+    # the existing loader reads the produced report tree
+    batch = load_tt_coverage_report(tmp_path / "coverage_report")
+    assert batch is not None and len(batch.services) == 5
+    # exec-analog archives present per pod
+    assert len(list((tmp_path / "coverage_data").glob("*.npz"))) == 10

@@ -1,0 +1,117 @@
+"""Deploy topology + JaCoCo injection."""
+
+import copy
+
+import yaml
+
+from anomod import topology
+from anomod.synth import SN_SERVICES, TT_SERVICES
+
+
+def test_sn_compose_shape():
+    doc = topology.sn_compose()
+    services = doc["services"]
+    # all 12 SN services present, gcov instrumented except the gateway
+    for svc in SN_SERVICES:
+        assert svc in services
+        if svc != "nginx-web-server":
+            env = services[svc]["environment"]
+            assert any(e.startswith("GCOV_PREFIX=") for e in env)
+            assert "./coverage-reports:/coverage-reports" in services[svc]["volumes"]
+            assert services[svc]["entrypoint"][0].startswith("/usr/local/bin/")
+    # gateway on :8080, jaeger on :16686, prometheus :9090
+    assert "8080:8080" in services["nginx-web-server"]["ports"]
+    assert "16686:16686" in services["jaeger-agent"]["ports"]
+    assert "9090:9090" in services["prometheus"]["ports"]
+    # chaos-target redis stores exist
+    for store in ("home-timeline-redis", "user-timeline-redis",
+                  "social-graph-redis"):
+        assert store in services
+    # yaml roundtrip
+    assert yaml.safe_load(yaml.safe_dump(doc)) == doc
+
+
+def test_sn_container_name():
+    assert topology.sn_container_name("user-service") == \
+        "socialnetwork_user-service_1"
+
+
+def test_tt_deployment_shape():
+    doc = topology.tt_deployment("ts-order-service")
+    assert doc["kind"] == "Deployment"
+    spec = doc["spec"]["template"]["spec"]
+    assert spec["initContainers"][0]["name"] == "agent-container"
+    c = spec["containers"][0]
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["JAVA_TOOL_OPTIONS"].startswith("-javaagent:/skywalking")
+    assert c["readinessProbe"]["tcpSocket"]["port"] == c["ports"][0]["containerPort"]
+    # ports are unique per service
+    ports = {topology.tt_service_port(s) for s in TT_SERVICES}
+    assert len(ports) == len(TT_SERVICES)
+
+
+def test_inject_jacoco_appends_preserving_skywalking():
+    docs = [topology.tt_deployment("ts-order-service")]
+    out, changed = topology.inject_jacoco(docs)
+    assert changed == 1
+    spec = out[0]["spec"]["template"]["spec"]
+    names = [v["name"] for v in spec["volumes"]]
+    assert "jacoco-vol" in names and "coverage-vol" in names
+    assert any(i["name"] == "init-jacoco" for i in spec["initContainers"])
+    c = spec["containers"][0]
+    jto = next(e["value"] for e in c["env"] if e["name"] == "JAVA_TOOL_OPTIONS")
+    # skywalking agent first, jacoco appended after (reference :70-71 order)
+    assert jto.startswith("-javaagent:/skywalking")
+    assert "output=tcpserver,address=*,port=6300" in jto
+    assert "includes=order.*" in jto
+    assert "excludes=org.springframework.*" in jto
+    mounts = [m["name"] for m in c["volumeMounts"]]
+    assert "jacoco-vol" in mounts and "coverage-vol" in mounts
+    # input not mutated
+    orig = next(e["value"] for e in docs[0]["spec"]["template"]["spec"]
+                ["containers"][0]["env"] if e["name"] == "JAVA_TOOL_OPTIONS")
+    assert "jacoco" not in orig
+
+
+def test_inject_jacoco_idempotent():
+    docs = [topology.tt_deployment("ts-travel-service")]
+    once, n1 = topology.inject_jacoco(docs)
+    twice, n2 = topology.inject_jacoco(once)
+    assert n1 == 1 and n2 == 0
+    assert once == twice
+
+
+def test_inject_jacoco_skips_non_workloads():
+    svc = {"kind": "Service", "metadata": {"name": "ts-order-service"},
+           "spec": {"ports": []}}
+    before = copy.deepcopy(svc)
+    out, changed = topology.inject_jacoco([svc])
+    assert changed == 0 and out[0] == before
+
+
+def test_inject_jacoco_file_mode_and_env_creation():
+    # container without JAVA_TOOL_OPTIONS gets one created
+    doc = topology.tt_deployment("ts-station-service", with_tracing=False)
+    out, changed = topology.inject_jacoco([doc], mode="file")
+    assert changed == 1
+    c = out[0]["spec"]["template"]["spec"]["containers"][0]
+    jto = next(e["value"] for e in c["env"] if e["name"] == "JAVA_TOOL_OPTIONS")
+    assert jto.startswith("-javaagent:/jacoco")
+    assert "output=file,destfile=/coverage/jacoco-$(HOSTNAME).exec" in jto
+
+
+def test_package_prefix_inference():
+    assert topology.service_package_prefix("ts-order-service") == "order.*"
+    assert topology.service_package_prefix("ts-admin-basic-info-service") == \
+        "adminbasicinfo.*"
+    assert topology.infer_includes_from_packages(
+        ["user.controller", "user.service", "com.helper"]) == "user.*"
+    assert topology.infer_includes_from_packages([]) is None
+
+
+def test_tt_manifests_full_stream_injection():
+    docs = topology.tt_manifests()
+    out, changed = topology.inject_jacoco(docs)
+    assert changed == len(TT_SERVICES)
+    txt = yaml.safe_dump_all(out)
+    assert txt.count("init-jacoco") == len(TT_SERVICES)
