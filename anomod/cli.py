@@ -36,7 +36,9 @@ def main(argv=None) -> int:
 
     p_rca = sub.add_parser("rca", help="train a GNN RCA model on chaos labels")
     p_rca.add_argument("--testbed", choices=["SN", "TT"], default="TT")
-    p_rca.add_argument("--model", choices=["gcn", "gat", "sage", "temporal", "lru"],
+    p_rca.add_argument("--model",
+                       choices=["gcn", "gat", "sage", "temporal", "lru",
+                                "transformer"],
                        default="gcn")
     p_rca.add_argument("--epochs", type=int, default=300)
     p_rca.add_argument("--train-seeds", type=int, default=6)

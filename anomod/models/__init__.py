@@ -2,7 +2,8 @@
 
 from anomod.models.gnn import GCN, GAT, GraphSAGE, normalized_adjacency
 from anomod.models.temporal import TemporalGCN
+from anomod.models.transformer import TraceTransformer
 from anomod.models.lru import TemporalLRU
 
 __all__ = ["GCN", "GAT", "GraphSAGE", "TemporalGCN", "TemporalLRU",
-           "normalized_adjacency"]
+           "TraceTransformer", "normalized_adjacency"]

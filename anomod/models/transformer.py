@@ -1,0 +1,83 @@
+"""TraceTransformer — long-context attention RCA scorer.
+
+Tokens are (service, time-window) cells of the windowed replay features: the
+experiment is one long sequence of S·W tokens (service embedding + sinusoidal
+window position), processed by pre-LN transformer blocks whose attention core
+is :func:`anomod.parallel.ring_attention.full_attention` — the exact op the
+sequence-parallel ring path computes distributed, so the single-chip model
+and the sharded long-context path share semantics.  A final adjacency hop
+mixes topology into the pooled per-service states before scoring.
+
+No reference counterpart (the reference has no models); sixth member of the
+RCA zoo trained on chaos labels by :mod:`anomod.rca`.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from anomod.models.gnn import normalized_adjacency
+from anomod.parallel.ring_attention import full_attention
+
+
+def sinusoidal_positions(n: int, d: int) -> np.ndarray:
+    """Standard fixed sin/cos position table [n, d]."""
+    pos = np.arange(n)[:, None].astype(np.float32)
+    i = np.arange((d + 1) // 2)[None, :].astype(np.float32)
+    angles = pos / np.power(10_000.0, 2.0 * i / d)
+    out = np.zeros((n, d), np.float32)
+    out[:, 0::2] = np.sin(angles)
+    out[:, 1::2] = np.cos(angles[:, : d // 2])
+    return out
+
+
+class AttentionBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    mlp_hidden: int
+
+    @nn.compact
+    def __call__(self, seq):                       # [L, d_model]
+        L = seq.shape[0]
+        h = nn.LayerNorm()(seq)
+        d_head = self.d_model // self.n_heads
+        qkv = nn.Dense(3 * self.d_model, use_bias=False)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (L, self.n_heads, d_head)
+        attn = full_attention(q.reshape(shape), k.reshape(shape),
+                              v.reshape(shape)).reshape(L, self.d_model)
+        seq = seq + nn.Dense(self.d_model)(attn)
+        h = nn.LayerNorm()(seq)
+        h = nn.Dense(self.mlp_hidden)(h)
+        h = nn.gelu(h)
+        return seq + nn.Dense(self.d_model)(h)
+
+
+class TraceTransformer(nn.Module):
+    """[S, W, F] windowed features + [S, S] adjacency → [S] culprit scores."""
+    d_model: int = 48
+    n_heads: int = 4
+    n_layers: int = 2
+    mlp_hidden: int = 96
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x_swf, adj_counts):
+        S, W, _ = x_swf.shape
+        tok = nn.Dense(self.d_model)(x_swf)                    # [S, W, d]
+        svc_emb = self.param("svc_emb", nn.initializers.normal(0.02),
+                             (S, self.d_model))
+        tok = tok + svc_emb[:, None, :] + \
+            jnp.asarray(sinusoidal_positions(W, self.d_model))[None]
+        seq = tok.reshape(S * W, self.d_model)
+        for _ in range(self.n_layers):
+            seq = AttentionBlock(self.d_model, self.n_heads,
+                                 self.mlp_hidden)(seq)
+        h = nn.LayerNorm()(seq).reshape(S, W, self.d_model).mean(axis=1)
+        # one adjacency hop injects call topology into the pooled states
+        a = normalized_adjacency(adj_counts)
+        h = jnp.concatenate([h, a @ h], axis=-1)
+        h = nn.relu(nn.Dense(self.hidden)(h))
+        return nn.Dense(1)(h)[:, 0]

@@ -107,7 +107,7 @@ def _apply_model(model_name: str, model, params, batch):
     if model_name in ("gcn",):
         return jax.vmap(lambda x, a: model.apply(params, x, a))(
             batch["x"], batch["adj"])
-    if model_name in ("temporal", "lru"):
+    if model_name in ("temporal", "lru", "transformer"):
         import jax.numpy as jnp
         # fuse static multimodal features (logs etc.) into every window
         W = batch["x_t"].shape[2]
@@ -123,8 +123,10 @@ def _apply_model(model_name: str, model, params, batch):
 def make_model(model_name: str):
     from anomod.models import GAT, GCN, GraphSAGE, TemporalGCN
     from anomod.models.lru import TemporalLRU
+    from anomod.models.transformer import TraceTransformer
     return {"gcn": GCN(), "gat": GAT(), "sage": GraphSAGE(),
-            "temporal": TemporalGCN(), "lru": TemporalLRU()}[model_name]
+            "temporal": TemporalGCN(), "lru": TemporalLRU(),
+            "transformer": TraceTransformer()}[model_name]
 
 
 @dataclasses.dataclass
@@ -175,7 +177,7 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
     sample0 = {k: v[0] for k, v in train.items()}
     if model_name == "gcn":
         params = model.init(rng, sample0["x"], sample0["adj"])
-    elif model_name in ("temporal", "lru"):
+    elif model_name in ("temporal", "lru", "transformer"):
         W = sample0["x_t"].shape[1]
         fused = np.concatenate(
             [sample0["x_t"],

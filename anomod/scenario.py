@@ -428,6 +428,15 @@ class SyntheticGateway:
             statuses.append(status)
         return statuses
 
+    @property
+    def rows(self) -> List[Tuple[str, float, int, float, int]]:
+        """Accumulated (endpoint, t_s, status, latency_ms, bytes) records."""
+        return list(self._rows)
+
+    @property
+    def last_row(self) -> Tuple[str, float, int, float, int]:
+        return self._rows[-1]
+
     def to_api_batch(self) -> ApiBatch:
         endpoints = tuple(sorted({r[0] for r in self._rows}))
         idx = {e: i for i, e in enumerate(endpoints)}

@@ -1,0 +1,84 @@
+"""Generated suites: calibration, execution, run-id join."""
+
+import numpy as np
+import pytest
+
+from anomod import chaos, suite
+
+
+def test_budget_calibration_matches_reference_points():
+    # SN: 2 minutes → 13 tests / 72 targets; TT: 10 minutes → 256 / 825
+    sn = suite.generate_suite("SN")
+    assert sn.n_tests == 13 and sn.covered_targets == 72
+    tt = suite.generate_suite("TT")
+    assert tt.n_tests == 256 and tt.covered_targets == 825
+    # scaling: half budget → about half the tests; targets saturate
+    half = suite.generate_suite("TT", budget_s=300)
+    assert 120 <= half.n_tests <= 136
+    assert suite.generate_suite("TT", n_tests=5000).covered_targets == 825
+
+
+def test_suite_deterministic_and_pool_coverage():
+    a = suite.generate_suite("TT", seed=4)
+    b = suite.generate_suite("TT", seed=4)
+    assert a.run_id == b.run_id
+    assert [t.spec.endpoint for t in a.tests] == \
+        [t.spec.endpoint for t in b.tests]
+    # first len(pool) tests round-robin the whole endpoint catalog
+    sn = suite.generate_suite("SN")
+    eps = {t.spec.template for t in sn.tests[:12]}
+    assert len(eps) == 12
+
+
+def test_run_suite_emits_api_and_joined_traces():
+    s = suite.generate_suite("TT", n_tests=20)
+    run = suite.run_suite(s, iterations=3, seed=2)
+    assert run.api.n_records == 60
+    assert len(run.spans.trace_ids) == 60
+    assert run.pass_rate > 0.9
+    # every request joins to exactly one trace, stamped with the run id
+    assert len(np.unique(run.trace_of_request)) == 60
+    assert all(t.startswith(s.run_id + "-") for t in run.spans.trace_ids)
+    got = suite.traces_for_run(run.spans, s.run_id)
+    assert len(got) == 60
+    assert len(suite.traces_for_run(run.spans, "em-nope")) == 0
+
+
+def test_run_suite_trace_structure():
+    s = suite.generate_suite("SN", n_tests=12)
+    run = suite.run_suite(s, iterations=1, seed=0)
+    spans = run.spans
+    # parents resolve to a forest: exactly one root per trace
+    roots = np.flatnonzero(spans.parent == -1)
+    assert len(roots) == len(spans.trace_ids)
+    # root is the gateway
+    assert all(spans.services[spans.service[r]] == "nginx-web-server"
+               for r in roots)
+    # home-timeline test's entry span lands on home-timeline-service
+    tl = [i for i, e in enumerate(spans.endpoints) if "home-timeline" in e]
+    rows = np.flatnonzero(np.isin(spans.endpoint, tl) &
+                          (spans.kind == 1) & (spans.parent >= 0))
+    svcs = {spans.services[spans.service[r]] for r in rows}
+    assert "home-timeline-service" in svcs
+
+
+def test_run_suite_under_chaos_fails_assertions():
+    ctl = chaos.ChaosController()
+    s = suite.generate_suite("TT", n_tests=40)
+    with ctl.inject("Lv_S_HTTPABORT_preserve"):
+        run = suite.run_suite(s, iterations=2, seed=5, controller=ctl)
+    # preserve tests fail often; suite tolerates (records) failures
+    assert 0.5 < run.pass_rate < 1.0
+    errs = run.spans.is_error
+    assert errs.any()
+    # error spans on the faulted endpoint carry the abort status 503
+    pres_eps = [i for i, e in enumerate(run.spans.endpoints)
+                if "preserveservice" in e]
+    pres_err = errs & np.isin(run.spans.endpoint, pres_eps)
+    assert pres_err.any()
+    assert (run.spans.status[pres_err] == 503).all()
+
+
+def test_generate_suite_rejects_unknown_testbed():
+    with pytest.raises(ValueError):
+        suite.generate_suite("XX")
