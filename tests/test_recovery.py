@@ -1,0 +1,139 @@
+"""Recovery subsystem: readiness convergence, force-delete/stuck policies,
+Prometheus OOM guard, trap-equivalent teardown."""
+
+import pytest
+
+from anomod.chaos import ChaosController
+from anomod.recovery import (
+    GuardedRun, Phase, Pod, PrometheusState, ReadinessController,
+    SyntheticCluster, cluster_for_testbed, guard_prometheus,
+    run_with_recovery,
+)
+
+
+def test_healthy_cluster_converges_fast():
+    cluster = cluster_for_testbed("SN", n_slow=0, n_crashloop=0, n_stuck=0)
+    report = ReadinessController().wait_for_pods_ready(cluster)
+    assert report.ready
+    assert report.waited_s <= 30.0
+    assert not report.force_deleted and not report.restarted_stuck
+
+
+def test_crashlooper_is_force_deleted_then_recovers():
+    pods = [Pod(name="ok-1", service="ok"),
+            Pod(name="bad-1", service="bad", crashloop=True,
+                crashes_before_ok=2)]
+    cluster = SyntheticCluster(pods)
+    report = ReadinessController().wait_for_pods_ready(cluster)
+    assert report.ready
+    # deleted exactly as many times as the script demands before clean start
+    assert report.force_deleted.count("bad-1") == 2
+    assert cluster.pods["bad-1"].deletions == 2
+
+
+def test_stuck_running_not_ready_restarted_after_deadline():
+    pods = [Pod(name="stuck-1", service="s", stuck_unready=True)]
+    cluster = SyntheticCluster(pods)
+    ctl = ReadinessController(stuck_deadline_s=180.0, timeout_s=600.0)
+    report = ctl.wait_for_pods_ready(cluster)
+    assert report.ready
+    assert report.restarted_stuck == ["stuck-1"]
+    # not restarted before the 180 s deadline elapsed
+    assert report.waited_s >= 180.0
+
+
+def test_timeout_reports_unready_pods():
+    # a pod that can never become ready within the timeout
+    pods = [Pod(name="never-1", service="n", startup_s=10_000.0)]
+    cluster = SyntheticCluster(pods)
+    report = ReadinessController(timeout_s=120.0).wait_for_pods_ready(cluster)
+    assert not report.ready
+    assert report.unready_at_timeout == ["never-1"]
+    assert report.waited_s >= 120.0
+
+
+def test_seeded_tt_cluster_with_all_archetypes_converges():
+    cluster = cluster_for_testbed("TT", seed=3)
+    report = ReadinessController().wait_for_pods_ready(cluster)
+    assert report.ready
+    assert report.force_deleted            # the crash-looper
+    assert report.restarted_stuck          # the stuck pod
+    # deterministic: same seed reproduces the same recovery trace
+    again = ReadinessController().wait_for_pods_ready(
+        cluster_for_testbed("TT", seed=3))
+    assert again.force_deleted == report.force_deleted
+    assert again.restarted_stuck == report.restarted_stuck
+
+
+def test_prometheus_oom_guard_restarts_and_waits():
+    cluster = SyntheticCluster([])
+    prom = PrometheusState(oom_killed=True, ready=False)
+    assert guard_prometheus(prom, cluster)
+    assert prom.restart_count == 1
+    assert prom.ready
+    # healthy prometheus is left alone
+    assert guard_prometheus(prom, cluster)
+    assert prom.restart_count == 1
+
+
+def test_guarded_run_sweeps_on_entry_and_teardown_on_exception():
+    ctl = ChaosController()
+    leftover = ctl.create("Lv_P_CPU_preserve")     # crashed previous run
+    assert ctl.status()
+    with pytest.raises(RuntimeError):
+        with GuardedRun(ctl) as guard:
+            assert guard.swept_on_entry == 1       # pre-run sweep
+            assert not ctl.status()
+            ctl.create("Lv_S_KILLPOD_preserve")
+            raise RuntimeError("body failed")      # ERR trap path
+    assert not ctl.status()                        # trap destroyed chaos
+    assert not ctl.destroy(leftover.uid)
+
+
+def test_run_with_recovery_full_envelope():
+    cluster = cluster_for_testbed("TT", seed=1)
+    ctl = ChaosController()
+    prom = PrometheusState(oom_killed=True, ready=False)
+    calls = []
+
+    def body():
+        # fault is live exactly while the body runs
+        lat, err = ctl.active_effects("ts-preserve-service")
+        calls.append((lat, err))
+        return "collected"
+
+    result, report = run_with_recovery(
+        cluster, ctl, "Lv_P_CPU_preserve", body, prometheus=prom)
+    assert result == "collected"
+    assert report.ready
+    assert prom.restart_count == 1
+    assert calls and calls[0][0] > 1.0             # latency effect was active
+    assert not ctl.status()                        # torn down after
+
+
+def test_phase_script_shapes():
+    p = Pod(name="x", service="s", crashloop=True, crashes_before_ok=1)
+    assert p.phase_at(2.0)[0] is Phase.PENDING
+    assert p.phase_at(10.0)[0] is Phase.CRASHLOOP
+    cluster = SyntheticCluster([p])
+    cluster.advance(10.0)
+    cluster.delete_pod("x")
+    phase, ready = p.phase_at(cluster.now + 25.0)
+    assert phase is Phase.RUNNING and ready
+
+
+def test_stuck_deadline_counts_running_time_only():
+    # long Pending phase must not pre-charge the stuck deadline
+    pods = [Pod(name="late-stuck", service="s", startup_s=200.0,
+                stuck_unready=True)]
+    cluster = SyntheticCluster(pods)
+    ctl = ReadinessController(stuck_deadline_s=180.0, timeout_s=900.0)
+    report = ctl.wait_for_pods_ready(cluster)
+    assert report.ready
+    # restart happens only after 180 s of Running-not-Ready, i.e. >= 380 s in
+    assert report.waited_s >= 380.0
+
+
+def test_cluster_for_testbed_rejects_oversubscription():
+    with pytest.raises(ValueError):
+        cluster_for_testbed("SN", n_crashloop=40)
