@@ -32,13 +32,17 @@ class ServiceFeatures(NamedTuple):
 
 
 FEATURES = ("lat_p99_log", "lat_p50_log", "err_rate", "log_err_rate",
-            "span_count_log", "lat_mean_log", "metric_level_log")
+            "span_count_log", "lat_mean_log", "metric_level_log",
+            "api_err_rate", "api_lat_log", "coverage_ratio")
 
 
 def extract_features(exp: Experiment,
                      services: Tuple[str, ...]) -> ServiceFeatures:
-    """[S, F] multimodal features: spans + logs + per-service metric levels."""
+    """[S, F] features over all five modalities: spans, logs, metrics, API
+    responses (per-endpoint stats attributed to the owning service via the
+    gateway route tables), and code coverage (per-service line ratio)."""
     S = len(services)
+    svc_index = {s: i for i, s in enumerate(services)}
     st = service_stats(exp.spans, services) if exp.spans is not None else None
     x = np.zeros((S, len(FEATURES)), np.float32)
     if st is not None:
@@ -48,7 +52,6 @@ def extract_features(exp: Experiment,
         x[:, 4] = np.log1p(st.count)
         x[:, 5] = np.log1p(st.lat_mean_us)
     if exp.logs is not None:
-        svc_index = {s: i for i, s in enumerate(services)}
         remap = np.array([svc_index.get(s, -1) for s in exp.logs.services] or [-1],
                          np.int32)
         svc = remap[exp.logs.service]
@@ -61,7 +64,6 @@ def extract_features(exp: Experiment,
             x[:, 3] = np.where(tot > 0, err / np.maximum(tot, 1), 0.0)
     if exp.metrics is not None and len(exp.metrics.services):
         m = exp.metrics
-        svc_index = {s: i for i, s in enumerate(services)}
         # mean log-level of all series attributed to each service
         series_to_svc = np.array(
             [svc_index.get(m.services[s] if s >= 0 else "", -1)
@@ -74,12 +76,34 @@ def extract_features(exp: Experiment,
         np.add.at(cnt, sample_svc[keep], 1)
         with np.errstate(invalid="ignore"):
             x[:, 6] = np.where(cnt > 0, tot / np.maximum(cnt, 1), 0.0)
+    if exp.api is not None and exp.api.n_records:
+        from anomod.suite import endpoint_owner
+        owner = np.array([svc_index.get(endpoint_owner(e, exp.testbed), -1)
+                          for e in exp.api.endpoints], np.int32)
+        rec_svc = owner[exp.api.endpoint]
+        keep = rec_svc >= 0
+        tot = np.zeros(S, np.int64)
+        err = np.zeros(S, np.int64)
+        lat = np.zeros(S, np.float64)
+        np.add.at(tot, rec_svc[keep], 1)
+        np.add.at(err, rec_svc[keep], (exp.api.status[keep] >= 500).astype(np.int64))
+        np.add.at(lat, rec_svc[keep], np.log1p(exp.api.latency_ms[keep]))
+        with np.errstate(invalid="ignore"):
+            x[:, 7] = np.where(tot > 0, err / np.maximum(tot, 1), 0.0)
+            x[:, 8] = np.where(tot > 0, lat / np.maximum(tot, 1), 0.0)
+    if exp.coverage is not None and len(exp.coverage.services):
+        ratio = exp.coverage.service_ratio()
+        for ci, svc in enumerate(exp.coverage.services):
+            si = svc_index.get(svc, -1)
+            if si >= 0:
+                x[si, 9] = ratio[ci]
     return ServiceFeatures(services=services, x=x)
 
 
 # Score weights: latency inflation, error-rate delta, log-error delta,
-# per-service metric level rise.
+# per-service metric level rise, API error/latency deltas, coverage shift.
 _W_LAT, _W_ERR, _W_LOG, _W_MET = 1.0, 4.0, 2.0, 0.5
+_W_API_ERR, _W_API_LAT, _W_COV = 2.0, 0.5, 1.0
 
 
 def service_scores(feat: np.ndarray, base: np.ndarray,
@@ -98,10 +122,24 @@ def service_scores(feat: np.ndarray, base: np.ndarray,
     # evidence shrinkage: a p99/err estimate from a handful of spans is noise;
     # weight by n/(n+k) using the span counts carried in feature col 4 (log1p)
     d_met = xp.clip(feat[:, 6] - base[:, 6], 0.0, None)
+    # api_lat_log and coverage_ratio are absolute levels (not rates): if the
+    # modality was collected on only one side, its delta is the raw level and
+    # would swamp every service — gate each on presence in BOTH matrices
+    # (count>0 ⇒ nonzero column; Optional modalities leave all-zero columns)
+    has_api = (xp.max(feat[:, 8]) > 0) & (xp.max(base[:, 8]) > 0)
+    has_cov = (xp.max(feat[:, 9]) > 0) & (xp.max(base[:, 9]) > 0)
+    d_api_err = xp.clip(feat[:, 7] - base[:, 7], 0.0, None) * has_api
+    d_api_lat = xp.clip(feat[:, 8] - base[:, 8], 0.0, None) * has_api
+    # injected faults shift executed paths, so coverage moves either way on
+    # the culprit (generate_coverage drops it; a real fault may also raise
+    # error-handling paths) — score the absolute shift
+    d_cov = xp.abs(feat[:, 9] - base[:, 9]) * has_cov
     n = xp.expm1(feat[:, 4])
     conf = n / (n + 20.0)
     return (conf * (_W_LAT * lat_infl + _W_ERR * d_err)
-            + _W_LOG * d_log + _W_MET * d_met)
+            + _W_LOG * d_log + _W_MET * d_met
+            + _W_API_ERR * d_api_err + _W_API_LAT * d_api_lat
+            + _W_COV * d_cov)
 
 
 def experiment_score(scores) -> float:

@@ -53,7 +53,7 @@ SN_SUITE_ENDPOINTS: Tuple[Tuple[str, str], ...] = (
 )
 
 # wrk2-api path → SN owning service (the nginx route table)
-_SN_ROUTE = {
+SN_ROUTE = _SN_ROUTE = {
     "/wrk2-api/user/register": "user-service",
     "/wrk2-api/user/follow": "social-graph-service",
     "/wrk2-api/user/unfollow": "social-graph-service",
@@ -267,3 +267,23 @@ def traces_for_run(spans: SpanBatch, run_id: str) -> np.ndarray:
     wanted = np.array([tid.startswith(run_id + "-")
                        for tid in spans.trace_ids], np.bool_)
     return np.flatnonzero(wanted)
+
+
+def endpoint_owner(endpoint: str, testbed: str) -> str:
+    """Owning service for a monitored endpoint — topology ground truth.
+
+    SN: the nginx route table over the wrk2-api surface (the monitor's
+    endpoint list, enhanced_openapi_monitor.py:36-49); full URLs are reduced
+    to their path first.  TT: endpoints are ``/api/v1/<short>service`` per
+    the gateway's path convention (atomic_queries.py), inverted back to the
+    ``ts-*-service`` name.
+    """
+    if testbed == "SN":
+        from urllib.parse import urlparse
+        path = urlparse(endpoint).path if "://" in endpoint else endpoint
+        return SN_ROUTE.get(path, "nginx-web-server")
+    for s in TT_SERVICES:
+        short = s.replace("ts-", "").replace("-service", "")
+        if endpoint.rstrip("/").endswith(f"/{short}service"):
+            return s
+    return "ts-gateway-service"
