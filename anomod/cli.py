@@ -72,6 +72,20 @@ def main(argv=None) -> int:
     p_scen.add_argument("--chaos", default=None,
                         help="experiment name to inject during the run")
 
+    p_deploy = sub.add_parser(
+        "deploy", help="render the deployment plan (helm/kubectl action "
+        "list for TT, compose lifecycle for SN)")
+    p_deploy.add_argument("--testbed", choices=["SN", "TT"], default="TT")
+    # the deploy.sh argument surface, as real flags
+    p_deploy.add_argument("--all", action="store_true", dest="deploy_all")
+    p_deploy.add_argument("--independent-db", action="store_true")
+    p_deploy.add_argument("--with-monitoring", action="store_true")
+    p_deploy.add_argument("--with-tracing", action="store_true")
+    p_deploy.add_argument("--down", action="store_true",
+                          help="SN only: render the teardown instead")
+    p_deploy.add_argument("--secrets", action="store_true",
+                          help="TT only: print the 27 per-service DB secrets")
+
     p_replay = sub.add_parser("replay", help="measure span replay throughput")
     p_replay.add_argument("--testbed", choices=["SN", "TT"], default="TT")
     p_replay.add_argument("--traces", type=int, default=2000)
@@ -221,6 +235,25 @@ def main(argv=None) -> int:
             "p99_latency_ms": round(float(np.percentile(batch.latency_ms, 99)), 2),
             "chaos": args.chaos,
         }))
+        return 0
+
+    if args.cmd == "deploy":
+        from anomod import deploy
+        if args.testbed == "SN":
+            print(deploy.render_plan(deploy.sn_compose_plan(up=not args.down)),
+                  end="")
+            return 0
+        flags = deploy.DeployFlags(
+            all=args.deploy_all, independent_db=args.independent_db,
+            with_monitoring=args.with_monitoring,
+            with_tracing=args.with_tracing)
+        if args.secrets:
+            import yaml
+            host = None if flags.independent_db else "tsdb-mysql-leader"
+            print(yaml.safe_dump_all(deploy.gen_mysql_secrets(host),
+                                     sort_keys=False), end="")
+            return 0
+        print(deploy.render_plan(deploy.tt_deploy_plan(flags)), end="")
         return 0
 
     if args.cmd == "replay":

@@ -53,7 +53,7 @@ SN_SUITE_ENDPOINTS: Tuple[Tuple[str, str], ...] = (
 )
 
 # wrk2-api path → SN owning service (the nginx route table)
-SN_ROUTE = _SN_ROUTE = {
+SN_ROUTE = {
     "/wrk2-api/user/register": "user-service",
     "/wrk2-api/user/follow": "social-graph-service",
     "/wrk2-api/user/unfollow": "social-graph-service",
@@ -158,7 +158,7 @@ class SuiteRun:
 
 def _service_of(testbed: str, spec: RequestSpec) -> str:
     if testbed == "SN":
-        return _SN_ROUTE.get(spec.template, "nginx-web-server")
+        return SN_ROUTE.get(spec.template, "nginx-web-server")
     return spec.service
 
 

@@ -25,6 +25,7 @@ injection for service/code faults, matching the reference's sanity thresholds
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import json
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -712,6 +713,17 @@ def generate_api(label: FaultLabel, n_records: int = 600,
                     latency_ms=lat, content_length=clen, endpoints=eps)
 
 
+@functools.lru_cache(maxsize=4096)
+def _file_coverage_base(svc: str, i: int) -> Tuple[int, float]:
+    """Line count + base coverage ratio of one source file.  These belong to
+    the *codebase*, not the experiment: seeded per (service, file) so coverage
+    is stable across experiments and only fault-conditioned shifts move it
+    (the reference's per-run reports differ mainly on the culprit, e.g.
+    ts-order-service under Lv_C_exception_injection)."""
+    frng = np.random.default_rng(_seed_for(f"{svc}/file_{i}", 5))
+    return int(frng.integers(50, 800)), float(frng.uniform(0.3, 0.7))
+
+
 def generate_coverage(label: FaultLabel, files_per_service: int = 6,
                       seed: Optional[int] = None) -> CoverageBatch:
     if seed is None:
@@ -721,15 +733,8 @@ def generate_coverage(label: FaultLabel, files_per_service: int = 6,
     files: List[FileCoverage] = []
     for svc in services:
         for i in range(files_per_service):
-            # line counts and base ratios belong to the *codebase*, not the
-            # experiment: seed them per (service, file) so coverage is stable
-            # across experiments and only fault-conditioned shifts move it
-            # (the reference's per-run reports differ mainly on the culprit,
-            # e.g. ts-order-service under Lv_C_exception_injection)
-            frng = np.random.default_rng(_seed_for(f"{svc}/file_{i}", 5))
-            total = int(frng.integers(50, 800))
-            ratio = float(frng.uniform(0.3, 0.7))
-            ratio += float(rng.uniform(-0.02, 0.02))    # run-to-run jitter
+            total, base_ratio = _file_coverage_base(svc, i)
+            ratio = base_ratio + float(rng.uniform(-0.02, 0.02))  # run jitter
             if label.is_anomaly and label.target_service == svc:
                 # injected faults shift executed paths on the culprit
                 ratio = max(0.05, ratio - 0.15)

@@ -1,0 +1,100 @@
+"""Deploy-plan model: flags, secret generation, action ordering."""
+
+import pytest
+
+from anomod.deploy import (
+    Action, DeployFlags, TT_DB_SERVICES, execute_plan, gen_mysql_secrets,
+    mysql_secret_doc, render_plan, sn_compose_plan, tt_deploy_plan,
+)
+
+
+def test_flags_parse():
+    f = DeployFlags.parse(["--with-tracing", "--with-monitoring"])
+    assert f.with_tracing and f.with_monitoring
+    assert not f.independent_db
+    with pytest.raises(ValueError):
+        DeployFlags.parse(["--bogus"])
+
+
+def test_27_db_services_match_reference_list():
+    assert len(TT_DB_SERVICES) == 27
+    assert "order" in TT_DB_SERVICES and "wait-order" in TT_DB_SERVICES
+
+
+def test_secret_env_prefix_convention():
+    doc = mysql_secret_doc("consign-price", "tsdb-mysql-leader",
+                           "ts", "Ts_123456", "ts")
+    assert doc["metadata"]["name"] == "ts-consign-price-mysql"
+    keys = doc["stringData"]
+    assert keys["CONSIGN_PRICE_MYSQL_HOST"] == "tsdb-mysql-leader"
+    assert keys["CONSIGN_PRICE_MYSQL_PORT"] == "3306"
+    assert set(k.rsplit("_", 1)[1] for k in keys) == {
+        "HOST", "PORT", "DATABASE", "USER", "PASSWORD"}
+
+
+def test_shared_vs_independent_hosts():
+    shared = gen_mysql_secrets(shared_host="tsdb-mysql-leader")
+    assert all(next(iter(d["stringData"].values())) == "tsdb-mysql-leader"
+               or "HOST" not in next(iter(d["stringData"]))
+               for d in shared)
+    assert {d["stringData"][k] for d in shared
+            for k in d["stringData"] if k.endswith("_HOST")} == \
+        {"tsdb-mysql-leader"}
+    per = gen_mysql_secrets()
+    hosts = {d["stringData"][k] for d in per
+             for k in d["stringData"] if k.endswith("_HOST")}
+    assert len(hosts) == 27 and "ts-order-mysql-leader" in hosts
+
+
+def test_plan_ordering_infra_before_services():
+    plan = tt_deploy_plan(DeployFlags(with_tracing=True, with_monitoring=True))
+    rendered = render_plan(plan)
+    # infra (nacosdb → nacos → rabbitmq) precedes tsdb, which precedes apply
+    order = [rendered.index(s) for s in
+             ("install nacosdb", "install nacos ", "install rabbitmq",
+              "install tsdb", "secret.yaml", "svc.yaml", "sw_deploy.yaml",
+              "sw_deploy.tcpserver.includes.yaml", "skywalking", "prometheus")]
+    assert order == sorted(order)
+    # every helm install has a rollout barrier except none (all do here)
+    helm = [a for a in plan if a.kind == "helm"]
+    assert all(a.barrier is not None for a in helm)
+
+
+def test_independent_db_plan_has_27_mysql_releases():
+    plan = tt_deploy_plan(DeployFlags(independent_db=True))
+    helm = [a for a in plan if a.kind == "helm" and "-mysql" not in a.argv[2]]
+    mysqls = [a for a in plan if a.kind == "helm"
+              and a.argv[2].startswith("ts-")]
+    assert len(mysqls) == 27
+    census = execute_plan(plan)
+    assert census["barriers"] == len([a for a in plan if a.barrier])
+
+
+def test_no_tracing_uses_plain_deploy():
+    rendered = render_plan(tt_deploy_plan(DeployFlags()))
+    assert "yamls/deploy.yaml" in rendered
+    assert "sw_deploy" not in rendered and "skywalking" not in rendered
+
+
+def test_sn_compose_lifecycle():
+    up = render_plan(sn_compose_plan(up=True))
+    down = render_plan(sn_compose_plan(up=False))
+    assert "docker-compose-gcov.yml up -d" in up
+    assert "down --remove-orphans" in down
+
+
+def test_execute_plan_advances_cluster_clock():
+    from anomod.recovery import SyntheticCluster
+    cluster = SyntheticCluster([])
+    t0 = cluster.now
+    execute_plan(tt_deploy_plan(DeployFlags(with_tracing=True)), cluster)
+    assert cluster.now > t0
+
+
+def test_all_flag_expands_to_full_stack():
+    plan = tt_deploy_plan(DeployFlags(all=True))
+    rendered = render_plan(plan)
+    mysqls = [a for a in plan if a.kind == "helm" and a.argv[2].startswith("ts-")]
+    assert len(mysqls) == 27                      # deploy_tt_mysql_each_service
+    assert "sw_deploy.yaml" in rendered           # deploy_tt_dp_sw
+    assert "skywalking" in rendered and "prometheus" in rendered
