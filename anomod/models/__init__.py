@@ -4,6 +4,7 @@ from anomod.models.gnn import GCN, GAT, GraphSAGE, normalized_adjacency
 from anomod.models.temporal import TemporalGCN
 from anomod.models.transformer import TraceTransformer
 from anomod.models.lru import TemporalLRU
+from anomod.models.moe import MoERCA
 
 __all__ = ["GCN", "GAT", "GraphSAGE", "TemporalGCN", "TemporalLRU",
-           "TraceTransformer", "normalized_adjacency"]
+           "TraceTransformer", "MoERCA", "normalized_adjacency"]

@@ -1,0 +1,90 @@
+"""Mixture-of-experts RCA scorer — the expert-parallel (ep) plane.
+
+Tokens are (service, time-window) cells of the windowed replay features
+(same tokenization as :class:`anomod.models.transformer.TraceTransformer`).
+Each block routes every token to its top-k experts with a learned softmax
+router and combines the expert MLP outputs with the renormalized gate
+weights.
+
+TPU-first design: dispatch is *dense einsum* over a fixed expert axis — no
+ragged gathers, no capacity overflow/dropping logic, one static-shape XLA
+program.  Expert kernels carry a leading ``[E, ...]`` axis; under the 2-D
+``(data, model)`` mesh the training harness shards that axis over ``model``
+(``PartitionSpec('model', None, None)``), so each device computes only its
+own experts' FLOPs and XLA inserts the psum that realizes the gate-weighted
+combine across devices.  That is expert parallelism in the pjit idiom: the
+collective is derived from sharding annotations, not hand-written all-to-alls.
+
+No reference counterpart (the reference ships no models,
+``/root/reference`` per SURVEY.md §2.4); seventh member of the RCA zoo
+trained on chaos labels by :mod:`anomod.rca`.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from anomod.models.transformer import ScoreHead, TokenEmbed
+
+
+class MoEBlock(nn.Module):
+    """Pre-LN token-wise MoE MLP with residual connection.
+
+    ``[T, d_model] -> [T, d_model]``.  All experts run on all tokens (E is
+    small and the MXU is wide); sparsity semantics come from the top-k gate
+    mask, which zeroes the combine weight of non-selected experts.
+    """
+
+    d_model: int
+    n_experts: int = 8
+    d_hidden: int = 64
+    top_k: int = 2
+
+    @nn.compact
+    def __call__(self, tokens):                        # [T, d_model]
+        h = nn.LayerNorm()(tokens)
+        gates = nn.softmax(
+            nn.Dense(self.n_experts, use_bias=False, name="router")(h))
+        # top-k mask, renormalized so selected gates sum to 1 per token
+        kth = jnp.sort(gates, axis=-1)[:, -self.top_k][:, None]
+        mask = (gates >= kth).astype(gates.dtype)
+        combine = gates * mask
+        combine = combine / jnp.maximum(
+            combine.sum(axis=-1, keepdims=True), 1e-9)   # [T, E]
+
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (self.n_experts, self.d_model, self.d_hidden))
+        b1 = self.param("b1", nn.initializers.zeros,
+                        (self.n_experts, self.d_hidden))
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (self.n_experts, self.d_hidden, self.d_model))
+        b2 = self.param("b2", nn.initializers.zeros,
+                        (self.n_experts, self.d_model))
+
+        # dense dispatch: every einsum keeps the expert axis outermost so a
+        # P('model', ...) sharding of w1/w2 partitions the FLOPs per device
+        eh = nn.gelu(jnp.einsum("td,edh->eth", h, w1) + b1[:, None, :])
+        ey = jnp.einsum("eth,ehd->etd", eh, w2) + b2[:, None, :]
+        out = jnp.einsum("etd,te->td", ey, combine)
+        return tokens + out
+
+
+class MoERCA(nn.Module):
+    """[S, W, F] windowed features + [S, S] adjacency → [S] culprit scores."""
+
+    d_model: int = 48
+    n_layers: int = 2
+    n_experts: int = 8
+    d_hidden: int = 96
+    top_k: int = 2
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x_swf, adj_counts):
+        S, W, _ = x_swf.shape
+        seq = TokenEmbed(self.d_model)(x_swf)                  # [S·W, d]
+        for _ in range(self.n_layers):
+            seq = MoEBlock(self.d_model, self.n_experts, self.d_hidden,
+                           self.top_k)(seq)
+        return ScoreHead(S, W, self.hidden)(seq, adj_counts)

@@ -33,6 +33,46 @@ def sinusoidal_positions(n: int, d: int) -> np.ndarray:
     return out
 
 
+class TokenEmbed(nn.Module):
+    """[S, W, F] windowed features → [S·W, d_model] token sequence.
+
+    Shared tokenizer for every sequence model in the zoo (transformer, MoE,
+    pipelined stack): feature projection + learned service embedding +
+    sinusoidal window position.
+    """
+    d_model: int
+
+    @nn.compact
+    def __call__(self, x_swf):
+        S, W, _ = x_swf.shape
+        tok = nn.Dense(self.d_model)(x_swf)
+        svc_emb = self.param("svc_emb", nn.initializers.normal(0.02),
+                             (S, self.d_model))
+        tok = tok + svc_emb[:, None, :] + \
+            jnp.asarray(sinusoidal_positions(W, self.d_model))[None]
+        return tok.reshape(S * W, self.d_model)
+
+
+class ScoreHead(nn.Module):
+    """[S·W, d_model] tokens + [S, S] adjacency → [S] culprit scores.
+
+    Shared head: LayerNorm, window mean-pool, one adjacency hop to mix call
+    topology into the pooled states, then a scoring MLP.
+    """
+    n_services: int
+    n_windows: int
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, seq, adj_counts):
+        h = nn.LayerNorm()(seq)
+        h = h.reshape(self.n_services, self.n_windows, -1).mean(axis=1)
+        a = normalized_adjacency(adj_counts)
+        h = jnp.concatenate([h, a @ h], axis=-1)
+        h = nn.relu(nn.Dense(self.hidden)(h))
+        return nn.Dense(1)(h)[:, 0]
+
+
 class AttentionBlock(nn.Module):
     d_model: int
     n_heads: int
@@ -66,18 +106,8 @@ class TraceTransformer(nn.Module):
     @nn.compact
     def __call__(self, x_swf, adj_counts):
         S, W, _ = x_swf.shape
-        tok = nn.Dense(self.d_model)(x_swf)                    # [S, W, d]
-        svc_emb = self.param("svc_emb", nn.initializers.normal(0.02),
-                             (S, self.d_model))
-        tok = tok + svc_emb[:, None, :] + \
-            jnp.asarray(sinusoidal_positions(W, self.d_model))[None]
-        seq = tok.reshape(S * W, self.d_model)
+        seq = TokenEmbed(self.d_model)(x_swf)                  # [S·W, d]
         for _ in range(self.n_layers):
             seq = AttentionBlock(self.d_model, self.n_heads,
                                  self.mlp_hidden)(seq)
-        h = nn.LayerNorm()(seq).reshape(S, W, self.d_model).mean(axis=1)
-        # one adjacency hop injects call topology into the pooled states
-        a = normalized_adjacency(adj_counts)
-        h = jnp.concatenate([h, a @ h], axis=-1)
-        h = nn.relu(nn.Dense(self.hidden)(h))
-        return nn.Dense(1)(h)[:, 0]
+        return ScoreHead(S, W, self.hidden)(seq, adj_counts)

@@ -1,4 +1,6 @@
-"""Device-mesh parallelism: sharded replay, collectives, mesh helpers."""
+"""Device-mesh parallelism: sharded replay, collectives, mesh helpers,
+dp×tp training, pipeline (pp) stages, expert (ep) sharding, ring attention
+(sp)."""
 
 from anomod.parallel.mesh import make_mesh, shard_chunks
 from anomod.parallel.replay import make_sharded_replay_fn, sharded_throughput

@@ -26,13 +26,24 @@ def make_mesh2d(n_devices: int, model_axis: int = 2):
     return Mesh(devs.reshape(n_devices // model, model), ("data", "model"))
 
 
-def _param_spec(path_leaf, mesh):
-    """Column-shard 2-D kernels over the model axis when divisible."""
+def _param_spec(path, arr, mesh):
+    """Sharding rule per param leaf, keyed on its tree path.
+
+    MoE expert params (``MoEBlock`` w1/b1/w2/b2, all with a leading ``[E]``
+    axis; the router stays dense) shard their expert axis over ``model`` —
+    expert parallelism: each device computes only its own experts and XLA
+    psums the gated combine.  Other 2-D kernels whose output dim divides the
+    model axis are column-sharded (tensor parallelism).  Everything else is
+    replicated."""
     from jax.sharding import PartitionSpec as P
-    arr = path_leaf
     m = mesh.shape["model"]
-    if m > 1 and hasattr(arr, "ndim") and arr.ndim == 2 and arr.shape[1] % m == 0:
-        return P(None, "model")
+    if m > 1 and hasattr(arr, "ndim"):
+        keys = "/".join(str(getattr(k, "key", k)) for k in path)
+        in_expert = "MoEBlock" in keys and "router" not in keys
+        if in_expert and arr.ndim >= 2 and arr.shape[0] % m == 0:
+            return P("model", *([None] * (arr.ndim - 1)))
+        if arr.ndim == 2 and arr.shape[1] % m == 0:
+            return P(None, "model")
     return P()
 
 
@@ -47,14 +58,14 @@ def make_distributed_train_step(model_name: str, sample_batch: dict, mesh):
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from anomod.rca import _apply_model, make_model
+    from anomod.rca import _apply_model, make_model, rca_loss
 
     model = make_model(model_name)
     sample0 = {k: v[0] for k, v in sample_batch.items()}
     rng = jax.random.PRNGKey(0)
     if model_name == "gcn":
         params = model.init(rng, sample0["x"], jnp.asarray(sample0["adj"]))
-    elif model_name in ("temporal", "lru"):
+    elif model_name in ("temporal", "lru", "transformer", "moe"):
         W = sample0["x_t"].shape[1]
         fused = np.concatenate(
             [sample0["x_t"], np.repeat(sample0["x"][:, None, :], W, axis=1)],
@@ -67,10 +78,10 @@ def make_distributed_train_step(model_name: str, sample_batch: dict, mesh):
     tx = optax.adamw(1e-3)
     opt_state = tx.init(params)
 
-    param_shardings = jax.tree_util.tree_map(
-        lambda a: NamedSharding(mesh, _param_spec(a, mesh)), params)
-    opt_shardings = jax.tree_util.tree_map(
-        lambda a: NamedSharding(mesh, _param_spec(a, mesh)), opt_state)
+    param_shardings = jax.tree_util.tree_map_with_path(
+        lambda p, a: NamedSharding(mesh, _param_spec(p, a, mesh)), params)
+    opt_shardings = jax.tree_util.tree_map_with_path(
+        lambda p, a: NamedSharding(mesh, _param_spec(p, a, mesh)), opt_state)
     batch_sharding = {k: NamedSharding(mesh, P("data"))
                       for k in sample_batch}
 
@@ -79,14 +90,7 @@ def make_distributed_train_step(model_name: str, sample_batch: dict, mesh):
 
     def loss_fn(params, batch):
         scores = _apply_model(model_name, model, params, batch)
-        has_target = batch["target"] >= 0
-        logp = jax.nn.log_softmax(scores, axis=-1)
-        tgt = jnp.clip(batch["target"], 0, scores.shape[-1] - 1)
-        ce = -jnp.take_along_axis(logp, tgt[:, None], axis=1)[:, 0]
-        rca = jnp.sum(ce * has_target) / jnp.maximum(has_target.sum(), 1)
-        det = optax.sigmoid_binary_cross_entropy(
-            scores.max(axis=-1), batch["is_anomaly"]).mean()
-        return rca + 0.3 * det
+        return rca_loss(scores, batch)
 
     @jax.jit
     def step(params, opt_state, batch):

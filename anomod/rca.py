@@ -107,7 +107,7 @@ def _apply_model(model_name: str, model, params, batch):
     if model_name in ("gcn",):
         return jax.vmap(lambda x, a: model.apply(params, x, a))(
             batch["x"], batch["adj"])
-    if model_name in ("temporal", "lru", "transformer"):
+    if model_name in ("temporal", "lru", "transformer", "moe"):
         import jax.numpy as jnp
         # fuse static multimodal features (logs etc.) into every window
         W = batch["x_t"].shape[2]
@@ -120,13 +120,30 @@ def _apply_model(model_name: str, model, params, batch):
         batch["x"], batch["edge_src"], batch["edge_dst"], batch["edge_mask"])
 
 
+def rca_loss(scores, batch):
+    """Shared training objective: CE over culprit services (where a chaos
+    label names one) + 0.3 × detection BCE on the max score.  Single source
+    of truth for the local, dp×tp, and pipeline train steps."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    has_target = batch["target"] >= 0
+    logp = jax.nn.log_softmax(scores, axis=-1)
+    tgt = jnp.clip(batch["target"], 0, scores.shape[-1] - 1)
+    ce = -jnp.take_along_axis(logp, tgt[:, None], axis=1)[:, 0]
+    rca = jnp.sum(ce * has_target) / jnp.maximum(has_target.sum(), 1)
+    det = optax.sigmoid_binary_cross_entropy(
+        scores.max(axis=-1), batch["is_anomaly"]).mean()
+    return rca + 0.3 * det
+
+
 def make_model(model_name: str):
-    from anomod.models import GAT, GCN, GraphSAGE, TemporalGCN
+    from anomod.models import GAT, GCN, GraphSAGE, MoERCA, TemporalGCN
     from anomod.models.lru import TemporalLRU
     from anomod.models.transformer import TraceTransformer
     return {"gcn": GCN(), "gat": GAT(), "sage": GraphSAGE(),
             "temporal": TemporalGCN(), "lru": TemporalLRU(),
-            "transformer": TraceTransformer()}[model_name]
+            "transformer": TraceTransformer(), "moe": MoERCA()}[model_name]
 
 
 @dataclasses.dataclass
@@ -177,7 +194,7 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
     sample0 = {k: v[0] for k, v in train.items()}
     if model_name == "gcn":
         params = model.init(rng, sample0["x"], sample0["adj"])
-    elif model_name in ("temporal", "lru", "transformer"):
+    elif model_name in ("temporal", "lru", "transformer", "moe"):
         W = sample0["x_t"].shape[1]
         fused = np.concatenate(
             [sample0["x_t"],
@@ -192,17 +209,7 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
 
     def loss_fn(params, batch):
         scores = _apply_model(model_name, model, params, batch)  # [B, S]
-        # RCA loss: CE over services where a culprit exists
-        has_target = batch["target"] >= 0
-        logp = jax.nn.log_softmax(scores, axis=-1)
-        tgt = jnp.clip(batch["target"], 0, scores.shape[-1] - 1)
-        ce = -jnp.take_along_axis(logp, tgt[:, None], axis=1)[:, 0]
-        rca_loss = jnp.sum(ce * has_target) / jnp.maximum(has_target.sum(), 1)
-        # detection loss: max-score logit vs is_anomaly
-        det_logit = scores.max(axis=-1)
-        det_loss = optax.sigmoid_binary_cross_entropy(
-            det_logit, batch["is_anomaly"]).mean()
-        return rca_loss + 0.3 * det_loss
+        return rca_loss(scores, batch)
 
     @jax.jit
     def step(params, opt_state, batch):

@@ -27,7 +27,8 @@ def test_build_dataset_shapes(sn_data):
     assert all(0 <= t < S for t in tgts)
 
 
-@pytest.mark.parametrize("name", ["gcn", "gat", "sage", "temporal", "lru"])
+@pytest.mark.parametrize("name", ["gcn", "gat", "sage", "temporal", "lru",
+                                  "transformer", "moe"])
 def test_model_forward_and_grad(name, sn_data):
     import jax
     import jax.numpy as jnp
@@ -37,7 +38,7 @@ def test_model_forward_and_grad(name, sn_data):
     rng = jax.random.PRNGKey(0)
     if name == "gcn":
         args = (jnp.asarray(s.x), jnp.asarray(s.adj, jnp.float32))
-    elif name in ("temporal", "lru"):
+    elif name in ("temporal", "lru", "transformer", "moe"):
         W = s.x_t.shape[1]
         fused = np.concatenate(
             [s.x_t, np.repeat(s.x[:, None, :], W, axis=1)], axis=-1)
