@@ -86,6 +86,12 @@ def main(argv=None) -> int:
     p_deploy.add_argument("--secrets", action="store_true",
                           help="TT only: print the 27 per-service DB secrets")
 
+    p_logscan = sub.add_parser(
+        "logscan", help="per-file log summary sweep over a directory "
+        "(collect_log.sh summary pass; native thread-pool when built)")
+    p_logscan.add_argument("dir")
+    p_logscan.add_argument("--glob", default="**/*.log")
+
     p_replay = sub.add_parser("replay", help="measure span replay throughput")
     p_replay.add_argument("--testbed", choices=["SN", "TT"], default="TT")
     p_replay.add_argument("--traces", type=int, default=2000)
@@ -254,6 +260,38 @@ def main(argv=None) -> int:
                                      sort_keys=False), end="")
             return 0
         print(deploy.render_plan(deploy.tt_deploy_plan(flags)), end="")
+        return 0
+
+    if args.cmd == "logscan":
+        from pathlib import Path
+
+        from anomod.io import native
+        from anomod.io.lfs import is_lfs_pointer
+        from anomod.io.logs import summarize_log_files
+        root = Path(args.dir)
+        if not root.is_dir():
+            print(f"not a directory: {root}", file=sys.stderr)
+            return 1
+        candidates = sorted(root.glob(args.glob))
+        paths = [p for p in candidates if not is_lfs_pointer(p)]
+        summaries = summarize_log_files(paths)
+        print(json.dumps({
+            "dir": str(root), "n_files": len(paths),
+            "n_lfs_stubs": len(candidates) - len(paths),
+            "native": native.available(),
+            "totals": {
+                "lines": sum(s.n_lines for s in summaries),
+                "errors": sum(s.n_error for s in summaries),
+                "warnings": sum(s.n_warn for s in summaries),
+                "bytes": sum(s.size_bytes for s in summaries),
+            },
+            "files": [{
+                "path": str(p.relative_to(root)), "service": s.service,
+                "lines": s.n_lines, "errors": s.n_error,
+                "warnings": s.n_warn, "info": s.n_info,
+                "bytes": s.size_bytes,
+            } for p, s in zip(paths, summaries)],
+        }, indent=2))
         return 0
 
     if args.cmd == "replay":

@@ -102,6 +102,44 @@ def parse_log_lines(text: str, service_idx: int,
     return svc, t, lvl
 
 
+def summarize_log_files(paths: List[Path],
+                        service_of=lambda p: Path(p).stem.rsplit("_", 1)[0]
+                        ) -> List[LogSummary]:
+    """Per-file log summaries without building a LogBatch — the sweep of
+    collect_log.sh:101-137 over an arbitrary file list.
+
+    Native fast path: one parallel multi-file call into the C++ runtime
+    (thread-pool executor + reusable read buffers); the Python loop below is
+    the behavioral oracle.
+    """
+    from anomod.io import native
+    paths = [Path(p) for p in paths]
+    if native.available():
+        res = native.summarize_log_files(paths)
+        if res is not None:
+            counts, _ts = res
+            return [LogSummary(service=service_of(p), n_lines=int(c[0]),
+                               n_error=int(c[3]), n_warn=int(c[2]),
+                               n_info=int(c[1]), size_bytes=int(c[4]))
+                    for p, c in zip(paths, counts)]
+    out = []
+    for p in paths:
+        text = read_text_or_none(p)
+        if text is None:
+            out.append(LogSummary(service=service_of(p), n_lines=0,
+                                  n_error=0, n_warn=0, n_info=0,
+                                  size_bytes=0))
+            continue
+        _, _, lvl = parse_log_lines(text, 0)
+        out.append(LogSummary(
+            service=service_of(p), n_lines=len(lvl),
+            n_error=int((lvl == LOG_ERROR).sum()),
+            n_warn=int((lvl == LOG_WARN).sum()),
+            n_info=int((lvl == LOG_INFO).sum()),
+            size_bytes=p.stat().st_size))
+    return out
+
+
 def load_sn_log_dir(exp_dir: Path) -> Tuple[Optional[LogBatch], Optional[List[LogSummary]]]:
     exp_dir = Path(exp_dir)
     summaries = None
@@ -110,6 +148,7 @@ def load_sn_log_dir(exp_dir: Path) -> Tuple[Optional[LogBatch], Optional[List[Lo
         summaries = parse_sn_summary(stext)
     services: Dict[str, int] = {}
     svc_col, t_col, lvl_col = [], [], []
+    derived: List[LogSummary] = []
     for p in sorted(exp_dir.glob("*.log")):
         text = read_text_or_none(p)
         if text is None:
@@ -118,6 +157,16 @@ def load_sn_log_dir(exp_dir: Path) -> Tuple[Optional[LogBatch], Optional[List[Lo
         s_idx = services.setdefault(svc_name, len(services))
         svc, t, lvl = parse_log_lines(text, s_idx)
         svc_col.append(svc); t_col.append(t); lvl_col.append(lvl)
+        derived.append(LogSummary(
+            service=svc_name, n_lines=len(lvl),
+            n_error=int((lvl == LOG_ERROR).sum()),
+            n_warn=int((lvl == LOG_WARN).sum()),
+            n_info=int((lvl == LOG_INFO).sum()),
+            size_bytes=p.stat().st_size))
+    if summaries is None and derived:
+        # no (or stub) summary.txt: regenerate it from the already-parsed
+        # lines, the way collect_log.sh:113-137 derives it at collection time
+        summaries = derived
     batch = None
     if svc_col:
         batch = LogBatch(service=np.concatenate(svc_col),

@@ -103,21 +103,38 @@ def load_sn_metric_dir(exp_dir: Path) -> Optional[MetricBatch]:
 
 
 def load_tt_metric_csv(path: Path) -> Optional[MetricBatch]:
-    """Load the TT long-format experiment CSV."""
+    """Load the TT long-format experiment CSV.
+
+    Numeric fast path: when the header is the canonical TT layout
+    (metric_name,timestamp,datetime,value,...; metric_collector.py:431-443)
+    and the native library is built, the timestamp/value columns are parsed
+    by the C++ CSV scanner; Python keeps the string columns."""
     path = Path(path)
     if not path.is_file() or is_lfs_pointer(path):
         return None
+    num = None
+    raw = path.read_bytes()
+    header = raw.split(b"\n", 1)[0].decode(errors="replace").strip().split(",")
+    if header[:4] == ["metric_name", "timestamp", "datetime", "value"]:
+        from anomod.io import native
+        if native.available():
+            num = native.scan_csv_columns(raw, [1, 3])
     rows: List[Tuple[str, float, float, Dict[str, str]]] = []
     with open(path, newline="") as f:
-        for rec in csv.DictReader(f):
+        for i, rec in enumerate(csv.DictReader(f)):
             labels = {k: v for k, v in rec.items()
                       if k not in ("metric_name", "timestamp", "datetime", "value") and v}
-            try:
-                val = float(rec["value"]) if rec.get("value") else float("nan")
-            except (TypeError, ValueError):
-                val = float("nan")
-            rows.append((rec.get("metric_name", ""), _parse_ts(rec.get("timestamp", "0")),
-                         val, labels))
+            if num is not None and i < num.shape[1]:
+                t = float(num[0, i])
+                t = 0.0 if np.isnan(t) else t
+                val = float(num[1, i])
+            else:
+                try:
+                    val = float(rec["value"]) if rec.get("value") else float("nan")
+                except (TypeError, ValueError):
+                    val = float("nan")
+                t = _parse_ts(rec.get("timestamp", "0"))
+            rows.append((rec.get("metric_name", ""), t, val, labels))
     return _build(rows) if rows else None
 
 

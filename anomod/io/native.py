@@ -25,18 +25,31 @@ def _load() -> Optional[ctypes.CDLL]:
     if _LIB is not None or _TRIED:
         return _LIB
     _TRIED = True
-    if not _SO_PATH.exists() and (_NATIVE_DIR / "Makefile").exists():
+    if (_NATIVE_DIR / "Makefile").exists():
+        # always run make: it is dependency-driven (no-op when current) and
+        # rebuilds a stale .so left over from an older source revision
         try:
             subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
                            capture_output=True, timeout=120)
         except (subprocess.SubprocessError, OSError):
-            return None
+            pass
     if not _SO_PATH.exists():
         return None
     try:
         lib = ctypes.CDLL(str(_SO_PATH))
     except OSError:
         return None
+    try:
+        _bind(lib)
+    except AttributeError:
+        # symbols missing (e.g. make failed against a stale .so): degrade to
+        # the pure-Python fallbacks rather than raising from available()
+        return None
+    _LIB = lib
+    return _LIB
+
+
+def _bind(lib: ctypes.CDLL) -> None:
     lib.anomod_scan_log_mt.restype = ctypes.c_int64
     lib.anomod_scan_log_mt.argtypes = [
         ctypes.c_char_p, ctypes.c_int64,
@@ -47,8 +60,21 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p, ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
-    _LIB = lib
-    return _LIB
+    lib.anomod_rt_create.restype = ctypes.c_void_p
+    lib.anomod_rt_create.argtypes = [ctypes.c_int32]
+    lib.anomod_rt_destroy.restype = None
+    lib.anomod_rt_destroy.argtypes = [ctypes.c_void_p]
+    lib.anomod_rt_n_threads.restype = ctypes.c_int32
+    lib.anomod_rt_n_threads.argtypes = [ctypes.c_void_p]
+    lib.anomod_rt_summarize_logs.restype = ctypes.c_int64
+    lib.anomod_rt_summarize_logs.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double)]
+    lib.anomod_scan_csv_cols.restype = ctypes.c_int64
+    lib.anomod_scan_csv_cols.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
 
 
 def available() -> bool:
@@ -69,6 +95,95 @@ def scan_log(text: bytes, n_threads: int = 4) -> Optional[Tuple[np.ndarray, np.n
         ts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         max_lines, n_threads)
     return levels[:n], ts[:n]
+
+
+class Runtime:
+    """Persistent native thread-pool executor (anomod_rt_* ABI).
+
+    One pool serves many batch submissions; per-thread read buffers are
+    reused across files.  Use as a context manager, or rely on
+    :func:`default_runtime` for a process-wide singleton.
+    """
+
+    def __init__(self, n_threads: int = 4):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._ptr = lib.anomod_rt_create(n_threads)
+
+    @property
+    def n_threads(self) -> int:
+        return int(self._lib.anomod_rt_n_threads(self._ptr))
+
+    def close(self) -> None:
+        if self._ptr:
+            self._lib.anomod_rt_destroy(self._ptr)
+            self._ptr = None
+
+    def __enter__(self) -> "Runtime":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def summarize_logs(self, paths) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Parallel per-file log summary sweep.
+
+        Returns ``(counts [N,5] int64, ts [N,2] float64, n_readable)`` where
+        counts rows are {n_lines, n_info, n_warn, n_error, size_bytes} and
+        ts rows are {min_ts, max_ts} (0 when absent).
+        """
+        enc = [str(p).encode() for p in paths]
+        arr = (ctypes.c_char_p * len(enc))(*enc)
+        counts = np.zeros((len(enc), 5), np.int64)
+        ts = np.zeros((len(enc), 2), np.float64)
+        n = self._lib.anomod_rt_summarize_logs(
+            self._ptr, arr, len(enc),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return counts, ts, int(n)
+
+
+_DEFAULT_RT: Optional[Runtime] = None
+
+
+def default_runtime() -> Optional[Runtime]:
+    """Process-wide executor (4 workers), created lazily; None if no lib."""
+    global _DEFAULT_RT
+    if _DEFAULT_RT is None and _load() is not None:
+        import atexit
+        _DEFAULT_RT = Runtime(4)
+        atexit.register(_DEFAULT_RT.close)
+    return _DEFAULT_RT
+
+
+def summarize_log_files(paths) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(counts [N,5], ts [N,2]) via the default runtime; None if native
+    unavailable.  Unreadable files yield all-zero rows."""
+    rt = default_runtime()
+    if rt is None or not paths:
+        return None
+    counts, ts, _ = rt.summarize_logs(paths)
+    return counts, ts
+
+
+def scan_csv_columns(text: bytes, cols,
+                     skip_header: bool = True) -> Optional[np.ndarray]:
+    """Parse numeric CSV columns natively: [n_cols, n_rows] float64 with NaN
+    for non-numeric fields.  None if native unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    max_rows = text.count(b"\n") + 1
+    cols_arr = np.asarray(list(cols), np.int32)
+    out = np.empty((len(cols_arr), max_rows), np.float64)
+    n = lib.anomod_scan_csv_cols(
+        text, len(text),
+        cols_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(cols_arr), int(skip_header),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), max_rows)
+    return out[:, :n]
 
 
 def scan_api_jsonl(text: bytes) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:

@@ -1,18 +1,27 @@
-// anomod native runtime: ingestion hot loops in C++.
+// anomod native runtime: ingestion hot loops + executor in C++.
 //
 // The reference's collectors shell out per artifact (docker logs, kubectl
 // logs — collect_log.sh, log_collector.py) and post-process line-by-line in
 // bash/python.  Here the per-line scanning (log level classification +
-// timestamp extraction) and JSONL field extraction run natively, exposed via
-// a C ABI consumed with ctypes (anomod/io/native.py).
+// timestamp extraction), JSONL/CSV field extraction, and the multi-file
+// collection fan-out (the reference's per-service loop,
+// collect_log.sh:84-110) run natively: a persistent thread-pool executor
+// with reusable per-thread read buffers, exposed via a C ABI consumed with
+// ctypes (anomod/io/native.py).
 //
 // Build: make -C native   (g++ -O3 -shared -fPIC -pthread)
 
 #include <cstdint>
 #include <cstring>
+#include <cstdio>
 #include <cstdlib>
 #include <cctype>
+#include <cmath>
 #include <ctime>
+#include <condition_variable>
+#include <functional>
+#include <mutex>
+#include <queue>
 #include <thread>
 #include <vector>
 
@@ -59,9 +68,226 @@ inline double parse_ts(const char* line, size_t n) {
     return 0.0;
 }
 
+// ---------------------------------------------------------------------------
+// Thread-pool executor: fixed worker set, FIFO task queue, wait-all barrier.
+// One pool outlives many batch submissions (the scheduler the bash reference
+// approximates with `&`/`wait` subshells, collect_all_data.sh:319-346).
+class Runtime {
+ public:
+    explicit Runtime(int n_threads) : stop_(false), active_(0) {
+        if (n_threads < 1) n_threads = 1;
+        for (int i = 0; i < n_threads; ++i)
+            workers_.emplace_back([this] { worker_loop(); });
+    }
+
+    ~Runtime() {
+        {
+            std::unique_lock<std::mutex> lk(mu_);
+            stop_ = true;
+        }
+        cv_.notify_all();
+        for (auto& t : workers_) t.join();
+    }
+
+    void submit(std::function<void()> fn) {
+        {
+            std::unique_lock<std::mutex> lk(mu_);
+            queue_.push(std::move(fn));
+        }
+        cv_.notify_one();
+    }
+
+    void wait_all() {
+        std::unique_lock<std::mutex> lk(mu_);
+        done_cv_.wait(lk, [this] { return queue_.empty() && active_ == 0; });
+    }
+
+    int n_threads() const { return (int)workers_.size(); }
+
+ private:
+    void worker_loop() {
+        for (;;) {
+            std::function<void()> task;
+            {
+                std::unique_lock<std::mutex> lk(mu_);
+                cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+                if (stop_ && queue_.empty()) return;
+                task = std::move(queue_.front());
+                queue_.pop();
+                ++active_;
+            }
+            task();
+            {
+                std::unique_lock<std::mutex> lk(mu_);
+                --active_;
+                if (queue_.empty() && active_ == 0) done_cv_.notify_all();
+            }
+        }
+    }
+
+    std::vector<std::thread> workers_;
+    std::queue<std::function<void()>> queue_;
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::condition_variable done_cv_;
+    bool stop_;
+    int active_;
+};
+
+// Per-thread growable read buffer, reused across files so a summarization
+// sweep over a 13-experiment tree does one allocation per worker, not one
+// per file.
+thread_local std::vector<char> tl_read_buf;
+
+// Read a whole file into the thread-local buffer; returns size or -1.
+inline int64_t read_file(const char* path) {
+    std::FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    std::fseek(f, 0, SEEK_END);
+    const long sz = std::ftell(f);
+    if (sz < 0) { std::fclose(f); return -1; }
+    std::fseek(f, 0, SEEK_SET);
+    if (tl_read_buf.size() < (size_t)sz) tl_read_buf.resize((size_t)sz);
+    const size_t got = sz ? std::fread(tl_read_buf.data(), 1, (size_t)sz, f)
+                          : 0;
+    std::fclose(f);
+    return (int64_t)got;
+}
+
 }  // namespace
 
 extern "C" {
+
+// ---- executor ABI ---------------------------------------------------------
+
+void* anomod_rt_create(int32_t n_threads) {
+    return new Runtime(n_threads);
+}
+
+void anomod_rt_destroy(void* rt) {
+    delete static_cast<Runtime*>(rt);
+}
+
+int32_t anomod_rt_n_threads(void* rt) {
+    return static_cast<Runtime*>(rt)->n_threads();
+}
+
+// Summarize N log files in parallel (the whole-experiment sweep of
+// collect_log.sh:101-137 as one call): for each file emit
+// counts_out[i*5..] = {n_lines, n_info, n_warn, n_error, size_bytes} and
+// ts_out[i*2..] = {min_ts, max_ts} (0 when no timestamp parsed).
+// Unreadable files get all-zero rows.  Returns the number of readable files.
+int64_t anomod_rt_summarize_logs(void* rt_ptr, const char* const* paths,
+                                 int64_t n_files, int64_t* counts_out,
+                                 double* ts_out) {
+    Runtime* rt = static_cast<Runtime*>(rt_ptr);
+    std::vector<int64_t> ok(n_files, 0);
+    for (int64_t i = 0; i < n_files; ++i) {
+        rt->submit([i, paths, counts_out, ts_out, &ok] {
+            int64_t* c = counts_out + i * 5;
+            double* ts = ts_out + i * 2;
+            c[0] = c[1] = c[2] = c[3] = c[4] = 0;
+            ts[0] = ts[1] = 0.0;
+            const int64_t sz = read_file(paths[i]);
+            if (sz < 0) return;
+            ok[i] = 1;
+            c[4] = sz;
+            const char* p = tl_read_buf.data();
+            const char* end = p + sz;
+            double tmin = 0.0, tmax = 0.0;
+            while (p < end) {
+                const char* nl =
+                    (const char*)memchr(p, '\n', (size_t)(end - p));
+                const size_t n = nl ? (size_t)(nl - p) : (size_t)(end - p);
+                ++c[0];
+                if (contains_ci(p, n, "error") ||
+                    contains_ci(p, n, "exception")) ++c[3];
+                else if (contains_ci(p, n, "warn")) ++c[2];
+                else if (contains_ci(p, n, "info")) ++c[1];
+                const double t = parse_ts(p, n);
+                if (t > 0.0) {
+                    if (tmin == 0.0 || t < tmin) tmin = t;
+                    if (t > tmax) tmax = t;
+                }
+                if (!nl) break;
+                p = nl + 1;
+            }
+            ts[0] = tmin;
+            ts[1] = tmax;
+        });
+    }
+    rt->wait_all();
+    int64_t readable = 0;
+    for (int64_t i = 0; i < n_files; ++i) readable += ok[i];
+    return readable;
+}
+
+// Extract numeric columns from a CSV buffer: for each row, parse the
+// requested column indices with strtod (non-numeric/missing -> NaN).
+// Double-quoted fields may contain commas (not newlines).  Output is
+// column-major: out[c * max_rows + r].  Returns the number of rows parsed.
+int64_t anomod_scan_csv_cols(const char* text, int64_t len,
+                             const int32_t* cols, int32_t n_cols,
+                             int32_t skip_header, double* out,
+                             int64_t max_rows) {
+    const double nan = std::nan("");
+    int32_t max_col = 0;
+    for (int32_t c = 0; c < n_cols; ++c)
+        if (cols[c] > max_col) max_col = cols[c];
+    std::vector<const char*> field_beg((size_t)max_col + 2);
+    std::vector<size_t> field_len((size_t)max_col + 2);
+    int64_t row = 0;
+    const char* p = text;
+    const char* end = text + len;
+    bool first = true;
+    while (p < end && row < max_rows) {
+        const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+        const char* eol = nl ? nl : end;
+        if (first && skip_header) {
+            first = false;
+            p = eol + 1;
+            continue;
+        }
+        first = false;
+        if (eol > p) {
+            // split into fields up to max_col (quote-aware)
+            int32_t nf = 0;
+            const char* q = p;
+            while (q <= eol && nf <= max_col) {
+                const char* fb = q;
+                size_t fl = 0;
+                if (q < eol && *q == '"') {
+                    fb = ++q;
+                    while (q < eol && *q != '"') ++q;
+                    fl = (size_t)(q - fb);
+                    while (q < eol && *q != ',') ++q;
+                } else {
+                    while (q < eol && *q != ',') ++q;
+                    fl = (size_t)(q - fb);
+                }
+                field_beg[nf] = fb;
+                field_len[nf] = fl;
+                ++nf;
+                if (q >= eol) break;
+                ++q;  // skip comma
+            }
+            for (int32_t c = 0; c < n_cols; ++c) {
+                double v = nan;
+                if (cols[c] < nf && field_len[cols[c]] > 0) {
+                    char* endq = nullptr;
+                    const char* fb = field_beg[cols[c]];
+                    const double parsed = std::strtod(fb, &endq);
+                    if (endq > fb) v = parsed;
+                }
+                out[(int64_t)c * max_rows + row] = v;
+            }
+            ++row;
+        }
+        if (!nl) break;
+        p = nl + 1;
+    }
+    return row;
+}
 
 // Classify lines: level 0=info 1=warn 2=error 3=other (matches
 // anomod.schemas LOG_* codes; semantics of collect_log.sh:104-106 grep -c -i).

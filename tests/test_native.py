@@ -63,3 +63,121 @@ def test_scan_api_jsonl():
     assert list(status) == [200, 500]
     np.testing.assert_allclose(lat, [12.5, 3001.75])
     assert list(clen) == [512, 0]
+
+
+def test_runtime_create_destroy():
+    with native.Runtime(3) as rt:
+        assert rt.n_threads == 3
+
+
+def test_summarize_logs_matches_python(tmp_path):
+    from anomod.io.logs import summarize_log_files
+    for i in range(6):
+        (tmp_path / f"Service{i}_x.log").write_text(SAMPLE_LOG * (i + 1))
+    paths = sorted(tmp_path.glob("*.log"))
+    got = summarize_log_files(paths)
+    # python oracle
+    orig = native.available
+    native.available = lambda: False
+    try:
+        want = summarize_log_files(paths)
+    finally:
+        native.available = orig
+    assert [s.__dict__ for s in got] == [s.__dict__ for s in want]
+    assert got[0].service == "Service0"
+    assert got[0].n_lines == 6 and got[0].n_error == 2
+    assert got[5].n_lines == 36
+
+
+def test_summarize_logs_unreadable_file(tmp_path):
+    (tmp_path / "a.log").write_text(SAMPLE_LOG)
+    counts, ts = native.summarize_log_files(
+        [tmp_path / "a.log", tmp_path / "missing.log"])
+    assert counts[0, 0] == 6
+    assert counts[1].sum() == 0 and ts[1].sum() == 0
+
+
+def test_summarize_logs_timestamps(tmp_path):
+    (tmp_path / "a.log").write_text(SAMPLE_LOG)
+    counts, ts = native.summarize_log_files([tmp_path / "a.log"])
+    assert ts[0, 0] > 1.7e9 and ts[0, 1] >= ts[0, 0]
+
+
+def test_scan_csv_columns():
+    text = b"""timestamp,value,metric,service
+1730671348,0.52,cpu,"compose-post"
+1730671363,0.61,cpu,unique-id
+1730671378,not_a_number,cpu,"a,b quoted comma"
+"""
+    out = native.scan_csv_columns(text, [0, 1])
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(out[0], [1730671348, 1730671363, 1730671378])
+    np.testing.assert_allclose(out[1][:2], [0.52, 0.61])
+    assert np.isnan(out[1][2])
+
+
+def test_scan_csv_columns_no_header():
+    out = native.scan_csv_columns(b"1,2\n3,4\n", [1], skip_header=False)
+    np.testing.assert_allclose(out[0], [2, 4])
+
+
+def test_logscan_cli(tmp_path, capsys):
+    import json
+    from anomod.cli import main
+    (tmp_path / "Svc_a.log").write_text(SAMPLE_LOG)
+    assert main(["logscan", str(tmp_path)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["n_files"] == 1
+    assert doc["totals"]["lines"] == 6
+    assert doc["totals"]["errors"] == 2
+    assert doc["files"][0]["service"] == "Svc"
+
+
+def test_sn_loader_generates_summaries_without_summary_txt(tmp_path):
+    from anomod.io.logs import load_sn_log_dir
+    (tmp_path / "ComposePost_x.log").write_text(SAMPLE_LOG)
+    (tmp_path / "UniqueId_x.log").write_text(SAMPLE_LOG * 2)
+    batch, summaries = load_sn_log_dir(tmp_path)
+    assert batch is not None and batch.n_lines == 18
+    assert summaries is not None and len(summaries) == 2
+    by_svc = {s.service: s for s in summaries}
+    assert by_svc["ComposePost"].n_lines == 6
+    assert by_svc["UniqueId"].n_error == 4
+
+
+def test_tt_metric_csv_native_fast_path(tmp_path):
+    """Native numeric-column parse must agree with the pure-Python path."""
+    from anomod.io.metrics import load_tt_metric_csv
+    csv_text = (
+        "metric_name,timestamp,datetime,value,labels\n"
+        "cpu,1730671348,2024-11-03T22:02:28,0.52,pod=ts-order-service-abc\n"
+        "cpu,1730671363,2024-11-03T22:02:43,0.61,pod=ts-order-service-abc\n"
+        "mem,1730671348,2024-11-03T22:02:28,,pod=ts-travel-service-xyz\n"
+    )
+    p = tmp_path / "Lv_X_metrics_1.csv"
+    p.write_text(csv_text)
+    got = load_tt_metric_csv(p)
+    orig = native.available
+    native.available = lambda: False
+    try:
+        want = load_tt_metric_csv(p)
+    finally:
+        native.available = orig
+    np.testing.assert_allclose(got.t_s, want.t_s)
+    np.testing.assert_allclose(got.value, want.value)
+    np.testing.assert_array_equal(got.metric, want.metric)
+    assert got.metric_names == want.metric_names
+
+
+def test_logscan_cli_skips_lfs_stubs(tmp_path, capsys):
+    import json
+    from anomod.cli import main
+    (tmp_path / "Svc_a.log").write_text(SAMPLE_LOG)
+    (tmp_path / "Stub_b.log").write_text(
+        "version https://git-lfs.github.com/spec/v1\n"
+        "oid sha256:abcd\nsize 12345\n")
+    assert main(["logscan", str(tmp_path)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["n_files"] == 1
+    assert doc["n_lfs_stubs"] == 1
+    assert doc["totals"]["lines"] == 6
