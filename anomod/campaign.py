@@ -106,27 +106,9 @@ def _materialize_sn(exp: Experiment, label, root: Path) -> None:
     (ldir / "summary.txt").write_text("\n".join(summary_lines) + "\n")
 
     # api responses (enhanced_openapi_monitor.py output family)
-    adir = root / "api_responses" / f"{base}_openapi_{ts2}"
-    adir.mkdir(parents=True, exist_ok=True)
-    write_api_jsonl(exp.api, adir / "openapi_responses.jsonl")
-    lat = exp.api.latency_ms
-    (adir / "response_summary.json").write_text(json.dumps({
-        "total_requests": int(exp.api.n_records),
-        "status_codes": {str(c): int((exp.api.status == c).sum())
-                         for c in np.unique(exp.api.status)},
-        "avg_latency_ms": float(lat.mean()),
-        "p95_latency_ms": float(np.percentile(lat, 95)),
-        "p99_latency_ms": float(np.percentile(lat, 99)),
-    }))
-    from anomod.io.api import analyze_api_batch
-    analysis = analyze_api_batch(exp.api)
-    (adir / "traffic_analysis.json").write_text(json.dumps(analysis))
-    (adir / "endpoint_performance.json").write_text(
-        json.dumps(analysis["endpoint_performance"]))
-    with open(adir / "status_code_distribution.csv", "w") as f:
-        f.write("status_code,count\n")
-        for c in np.unique(exp.api.status):
-            f.write(f"{int(c)},{int((exp.api.status == c).sum())}\n")
+    from anomod.io.api import write_api_artifact_family
+    write_api_artifact_family(
+        exp.api, root / "api_responses" / f"{base}_openapi_{ts2}")
 
     # coverage: per-service gcov text
     cdir = root / "coverage_data" / f"{base}_coverage_{ts2}"

@@ -86,6 +86,18 @@ def main(argv=None) -> int:
     p_deploy.add_argument("--secrets", action="store_true",
                           help="TT only: print the 27 per-service DB secrets")
 
+    p_mon = sub.add_parser(
+        "monitor", help="SN API-response monitor over the synthetic SUT "
+        "(active: 12 wrk2-api endpoints; passive: GET-only fallback)")
+    p_mon.add_argument("--mode", choices=["active", "passive"],
+                       default="active")
+    p_mon.add_argument("--cycles", type=int, default=10)
+    p_mon.add_argument("--seed", type=int, default=0)
+    p_mon.add_argument("--chaos", default=None,
+                       help="experiment name to inject during the capture")
+    p_mon.add_argument("--out", default=None,
+                       help="materialize the api_responses artifact family")
+
     p_logscan = sub.add_parser(
         "logscan", help="per-file log summary sweep over a directory "
         "(collect_log.sh summary pass; native thread-pool when built)")
@@ -260,6 +272,26 @@ def main(argv=None) -> int:
                                      sort_keys=False), end="")
             return 0
         print(deploy.render_plan(deploy.tt_deploy_plan(flags)), end="")
+        return 0
+
+    if args.cmd == "monitor":
+        import numpy as np
+
+        from anomod.monitor import capture_openapi_responses
+        report = capture_openapi_responses(
+            args.out, mode=args.mode, cycles=args.cycles,
+            seed=args.seed, chaos=args.chaos)
+        b = report.batch
+        print(json.dumps({
+            "mode": report.mode, "cycles": report.n_cycles,
+            "requests": b.n_records, "endpoints": len(b.endpoints),
+            "reachable": sum(report.connectivity.values()),
+            "status_codes": {str(c): int((b.status == c).sum())
+                             for c in np.unique(b.status)},
+            "error_rate": round(float((b.status >= 500).mean()), 4),
+            "p99_latency_ms": round(float(np.percentile(b.latency_ms, 99)), 2),
+            "out": args.out, "chaos": args.chaos,
+        }))
         return 0
 
     if args.cmd == "logscan":

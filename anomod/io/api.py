@@ -69,14 +69,22 @@ def find_api_artifact(exp_dir: Path) -> Optional[Path]:
     return cands[-1] if cands else None
 
 
+def _endpoint_method(endpoint: str) -> str:
+    """Endpoints recorded as "METHOD /path" carry their method; bare paths
+    default to GET (the monitor's probe default)."""
+    head = endpoint.split(" ", 1)[0]
+    return head if head.isupper() and head.isalpha() else "GET"
+
+
 def write_api_jsonl(batch: ApiBatch, path: Path) -> None:
     """Materialize an ApiBatch in the reference JSONL shape."""
+    methods = [_endpoint_method(e) for e in batch.endpoints]
     with open(path, "w") as f:
         for i in range(batch.n_records):
             f.write(json.dumps({
                 "timestamp": datetime.fromtimestamp(float(batch.t_s[i])).isoformat(),
                 "endpoint": batch.endpoints[int(batch.endpoint[i])],
-                "method": "GET",
+                "method": methods[int(batch.endpoint[i])],
                 "status_code": int(batch.status[i]),
                 "latency_ms": round(float(batch.latency_ms[i]), 2),
                 "content_length": int(batch.content_length[i]),
@@ -92,7 +100,11 @@ def analyze_api_batch(batch: ApiBatch) -> dict:
     status_counts = {int(c): int((batch.status == c).sum())
                      for c in np.unique(batch.status)}
     per_endpoint = {}
+    methods: Dict[str, int] = {}
+    counts = np.bincount(batch.endpoint, minlength=len(batch.endpoints))
     for i, ep in enumerate(batch.endpoints):
+        methods[_endpoint_method(ep)] = (
+            methods.get(_endpoint_method(ep), 0) + int(counts[i]))
         m = batch.endpoint == i
         if not m.any():
             continue
@@ -107,8 +119,36 @@ def analyze_api_batch(batch: ApiBatch) -> dict:
     return {
         "total_requests": int(batch.n_records),
         "status_distribution": status_counts,
-        "method_distribution": {"GET": int(batch.n_records)},
+        "method_distribution": methods,
         "error_rate": float((batch.status >= 400).mean()),
         "avg_latency_ms": float(lat.mean()) if len(lat) else 0.0,
         "endpoint_performance": per_endpoint,
     }
+
+
+def write_api_artifact_family(batch: ApiBatch, adir: Path) -> None:
+    """Materialize the full SN api_responses artifact family
+    (enhanced_openapi_monitor.py:272,359,364,390 + the orchestrator's
+    traffic_analysis.json, collect_openapi_response.sh:117-142):
+    openapi_responses.jsonl, response_summary.json, endpoint_performance.json,
+    status_code_distribution.csv, traffic_analysis.json."""
+    adir = Path(adir)
+    adir.mkdir(parents=True, exist_ok=True)
+    write_api_jsonl(batch, adir / "openapi_responses.jsonl")
+    lat = batch.latency_ms
+    (adir / "response_summary.json").write_text(json.dumps({
+        "total_requests": int(batch.n_records),
+        "status_codes": {str(c): int((batch.status == c).sum())
+                         for c in np.unique(batch.status)},
+        "avg_latency_ms": float(lat.mean()) if len(lat) else 0.0,
+        "p95_latency_ms": float(np.percentile(lat, 95)) if len(lat) else 0.0,
+        "p99_latency_ms": float(np.percentile(lat, 99)) if len(lat) else 0.0,
+    }))
+    analysis = analyze_api_batch(batch)
+    (adir / "traffic_analysis.json").write_text(json.dumps(analysis))
+    (adir / "endpoint_performance.json").write_text(
+        json.dumps(analysis["endpoint_performance"]))
+    with open(adir / "status_code_distribution.csv", "w") as f:
+        f.write("status_code,count\n")
+        for c in np.unique(batch.status):
+            f.write(f"{int(c)},{int((batch.status == c).sum())}\n")

@@ -1,0 +1,180 @@
+"""SN API-response monitoring subsystem — active/passive monitors + capture
+orchestrator, re-designed as deterministic request programs over the
+synthetic SUT.
+
+Reference behavior contracts (all under
+``SN_collection-scripts/Dataset/api_responses/``):
+
+- ``enhanced_openapi_monitor.py`` — the *active* monitor: probes the 12
+  wrk2-api endpoints (:36-49), POST for
+  register/login/compose/upload/follow/unfollow with per-endpoint body
+  synthesis (:104-134), connectivity pre-check before the monitoring loop
+  (:82-96), JSONL record append (:297-298), summary/p95/p99 + per-endpoint
+  reports (:318-397).
+- ``monitor_http_responses.py`` — the *passive* fallback: GET-only sampling
+  limited to the first 3 endpoints per cycle (:126-127), same record
+  contract.
+- ``collect_openapi_response.sh`` — the orchestrator: runs the monitor
+  concurrently with collection (:84-89), optionally captures gateway traffic
+  and post-processes it into ``traffic_analysis.json`` (:117-142, via
+  tshark; here the captured :class:`~anomod.schemas.ApiBatch` is analyzed
+  directly by :func:`anomod.io.api.analyze_api_batch` — same output, no
+  pcap detour).
+
+Requests execute against :class:`anomod.scenario.SyntheticGateway` (routing
+by explicit SN owner service), so an active
+:class:`~anomod.chaos.ChaosController` fault conditions monitor traffic the
+same way it conditions every other modality.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from anomod.scenario import RequestSpec, SyntheticGateway
+from anomod.schemas import ApiBatch
+
+# The 12 SN gateway endpoints (enhanced_openapi_monitor.py:36-49) with their
+# owning services (docker-compose-gcov.yml service set) and the method rule
+# of make_sample_request (POST iff register/login/compose/upload/
+# follow/unfollow, :104).
+SN_ENDPOINTS: Tuple[Tuple[str, str, str], ...] = (
+    ("POST", "/wrk2-api/user/register", "user-service"),
+    ("POST", "/wrk2-api/user/follow", "social-graph-service"),
+    ("POST", "/wrk2-api/user/unfollow", "social-graph-service"),
+    ("POST", "/wrk2-api/user/login", "user-service"),
+    ("POST", "/wrk2-api/post/compose", "compose-post-service"),
+    ("GET", "/wrk2-api/home-timeline/read", "home-timeline-service"),
+    ("GET", "/wrk2-api/user-timeline/read", "user-timeline-service"),
+    ("GET", "/wrk2-api/user/profile", "user-service"),
+    ("POST", "/wrk2-api/media/upload", "media-service"),
+    ("POST", "/wrk2-api/text/upload", "text-service"),
+    ("GET", "/wrk2-api/url/shorten", "url-shorten-service"),
+    ("POST", "/wrk2-api/user-mention/upload", "user-mention-service"),
+)
+
+
+def synthesize_body(path: str, seq: int) -> Optional[dict]:
+    """Deterministic POST-body synthesis per endpoint kind
+    (enhanced_openapi_monitor.py:104-134; time-derived uniqueness replaced
+    by the monotone ``seq`` so runs are reproducible)."""
+    if "register" in path:
+        return {"first_name": "Test", "last_name": "User",
+                "username": f"testuser_{seq}", "password": "testpass",
+                "user_id": seq % 10_000}
+    if "login" in path:
+        return {"username": "testuser", "password": "testpass"}
+    if "compose" in path:
+        return {"username": "testuser", "user_id": 1, "text": "Test post",
+                "media_ids": [], "media_types": [], "post_type": 0}
+    if path.split("/")[-1] in ("upload", "follow", "unfollow"):
+        return {}
+    return None
+
+
+def _spec(method: str, path: str, owner: str) -> RequestSpec:
+    return RequestSpec(method, path, path, flow="monitor", owner=owner)
+
+
+@dataclasses.dataclass
+class MonitorReport:
+    batch: ApiBatch
+    connectivity: Dict[str, bool]
+    n_cycles: int
+    mode: str
+
+
+class ActiveMonitor:
+    """The enhanced monitor: every cycle probes all 12 endpoints with the
+    method/body rules above."""
+
+    mode = "active"
+    endpoints = SN_ENDPOINTS
+
+    def __init__(self, seed: int = 0, controller=None) -> None:
+        self._gw = SyntheticGateway(seed=seed, controller=controller)
+        self._seq = 0
+
+    def connectivity_check(self) -> Dict[str, bool]:
+        """One GET per endpoint before monitoring
+        (enhanced_openapi_monitor.py:82-96).  Against the synthetic SUT an
+        endpoint is unreachable when its probe is *service-aborted* (503,
+        the gateway's high-error fault response) — a sporadic baseline 500
+        is an application error, not a connection failure, and the
+        reference's pre-check only trips on connection errors."""
+        out = {}
+        for _, path, owner in self.endpoints:
+            status = self._gw.execute([_spec("GET", path, owner)])[0]
+            out[path] = status != 503
+        return out
+
+    def bodies(self) -> List[Optional[dict]]:
+        """The POST bodies the next cycle would send (the reference's
+        request-data synthesis, observable for tests/tools)."""
+        out = []
+        for method, path, _ in self.endpoints:
+            out.append(synthesize_body(path, self._seq)
+                       if method == "POST" else None)
+            self._seq += 1
+        return out
+
+    def cycle(self) -> List[int]:
+        self.bodies()     # advance the request-id sequence like the reference
+        specs = [_spec(method, path, owner)
+                 for method, path, owner in self.endpoints]
+        return self._gw.execute(specs)
+
+    def run(self, cycles: int = 10) -> MonitorReport:
+        connectivity = self.connectivity_check()
+        for _ in range(cycles):
+            self.cycle()
+        return MonitorReport(self._gw.to_api_batch(), connectivity,
+                             cycles, self.mode)
+
+
+class PassiveMonitor(ActiveMonitor):
+    """The fallback sampler: GET-only, limited to the first 3 endpoints per
+    cycle (monitor_http_responses.py:126-127)."""
+
+    mode = "passive"
+
+    def cycle(self) -> List[int]:
+        specs = [_spec("GET", path, owner)
+                 for _, path, owner in self.endpoints[:3]]
+        return self._gw.execute(specs)
+
+
+def capture_openapi_responses(out_dir: Optional[Path] = None,
+                              mode: str = "active", cycles: int = 10,
+                              seed: int = 0,
+                              chaos: Optional[str] = None) -> MonitorReport:
+    """Orchestrate a monitoring capture (collect_openapi_response.sh:60-143):
+    optionally inject a fault, run the monitor, tear down (even on failure,
+    like the reference's traps), and — when ``out_dir`` is given —
+    materialize the full api_responses artifact family + collection report."""
+    controller = None
+    if chaos is not None:
+        from anomod.chaos import ChaosController
+        controller = ChaosController()
+        controller.create(chaos)
+    try:
+        cls = ActiveMonitor if mode == "active" else PassiveMonitor
+        report = cls(seed=seed, controller=controller).run(cycles)
+    finally:
+        if controller is not None:
+            controller.destroy_all()
+    if out_dir is not None:
+        from anomod.io.api import write_api_artifact_family
+        out_dir = Path(out_dir)
+        write_api_artifact_family(report.batch, out_dir)
+        (out_dir / "collection_report.json").write_text(json.dumps({
+            "mode": report.mode, "cycles": report.n_cycles,
+            "chaos": chaos,
+            "endpoints_monitored": [p for _, p, _ in SN_ENDPOINTS],
+            "connectivity": report.connectivity,
+            "total_requests": int(report.batch.n_records),
+        }, indent=2))
+    return report

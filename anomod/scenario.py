@@ -99,10 +99,11 @@ class RequestSpec:
     path: str            # instantiated path
     template: str        # canonical path template (stable endpoint vocab)
     flow: str = ""       # which scenario flow emitted it
+    owner: str = ""      # explicit owning service (SN specs); "" = TT route
 
     @property
     def service(self) -> str:
-        return route(self.path)
+        return self.owner or route(self.path)
 
     @property
     def endpoint(self) -> str:

@@ -1,0 +1,101 @@
+"""SN API monitor subsystem: endpoint surface, body synthesis, chaos
+conditioning, artifact family."""
+
+import json
+
+import numpy as np
+import pytest
+
+from anomod.io.api import load_api_jsonl
+from anomod.monitor import (SN_ENDPOINTS, ActiveMonitor, PassiveMonitor,
+                            capture_openapi_responses, synthesize_body)
+
+
+def test_endpoint_surface():
+    # the reference's 12 wrk2-api endpoints, POST iff
+    # register/login/compose/upload/follow/unfollow
+    assert len(SN_ENDPOINTS) == 12
+    for method, path, owner in SN_ENDPOINTS:
+        # the reference's method rule (enhanced_openapi_monitor.py:104)
+        is_post = any(k in path for k in ("register", "login", "compose",
+                                          "upload", "follow", "unfollow"))
+        assert (method == "POST") == is_post, path
+        assert owner.endswith("-service") or owner.endswith("-server")
+
+
+def test_body_synthesis_contract():
+    reg = synthesize_body("/wrk2-api/user/register", 7)
+    assert reg["username"] == "testuser_7" and reg["user_id"] == 7
+    login = synthesize_body("/wrk2-api/user/login", 1)
+    assert set(login) == {"username", "password"}
+    comp = synthesize_body("/wrk2-api/post/compose", 2)
+    assert comp["post_type"] == 0 and comp["media_ids"] == []
+    assert synthesize_body("/wrk2-api/media/upload", 3) == {}
+    assert synthesize_body("/wrk2-api/home-timeline/read", 4) is None
+
+
+def test_active_monitor_covers_all_endpoints():
+    report = ActiveMonitor(seed=0).run(cycles=5)
+    assert report.mode == "active"
+    # connectivity pre-check probes + 5 cycles x 12 endpoints
+    assert report.batch.n_records == 12 + 5 * 12
+    paths = {e.split(" ", 1)[1] for e in report.batch.endpoints}
+    assert paths == {p for _, p, _ in SN_ENDPOINTS}
+    assert all(report.connectivity.values())
+
+
+def test_passive_monitor_limits_to_three_gets():
+    report = PassiveMonitor(seed=0).run(cycles=4)
+    assert report.mode == "passive"
+    # pre-check covers all 12; cycles only the first 3 endpoints, GET-only
+    assert report.batch.n_records == 12 + 4 * 3
+    assert not any(e.startswith("POST ") for e in report.batch.endpoints)
+    # only the first 3 endpoints accumulate cycle traffic (the other 9 see
+    # exactly their single pre-check probe)
+    counts = np.bincount(report.batch.endpoint,
+                         minlength=len(report.batch.endpoints))
+    assert sorted(counts.tolist(), reverse=True)[:3] == [5, 5, 5]
+    assert sorted(counts.tolist(), reverse=True)[3:] == [1] * 9
+
+
+def test_monitor_determinism():
+    a = ActiveMonitor(seed=3).run(cycles=3).batch
+    b = ActiveMonitor(seed=3).run(cycles=3).batch
+    np.testing.assert_array_equal(a.status, b.status)
+    np.testing.assert_allclose(a.latency_ms, b.latency_ms)
+
+
+def test_chaos_conditions_monitor_traffic():
+    from anomod.chaos import ChaosController
+    ctl = ChaosController()
+    ctl.create("Svc_Kill_UserTimeline")  # service-level fault, SN testbed
+    try:
+        faulted = ActiveMonitor(seed=1, controller=ctl).run(cycles=30).batch
+    finally:
+        ctl.destroy_all()
+    clean = ActiveMonitor(seed=1).run(cycles=30).batch
+    assert (faulted.status >= 500).mean() > (clean.status >= 500).mean()
+
+
+def test_capture_orchestrator_artifacts(tmp_path):
+    report = capture_openapi_responses(tmp_path, mode="active", cycles=4,
+                                       seed=0, chaos=None)
+    for name in ("openapi_responses.jsonl", "response_summary.json",
+                 "endpoint_performance.json", "status_code_distribution.csv",
+                 "traffic_analysis.json", "collection_report.json"):
+        assert (tmp_path / name).exists(), name
+    batch = load_api_jsonl(tmp_path / "openapi_responses.jsonl")
+    assert batch.n_records == report.batch.n_records
+    doc = json.loads((tmp_path / "collection_report.json").read_text())
+    assert doc["mode"] == "active" and len(doc["endpoints_monitored"]) == 12
+    analysis = json.loads((tmp_path / "traffic_analysis.json").read_text())
+    assert "POST" in analysis["method_distribution"]
+    assert analysis["total_requests"] == report.batch.n_records
+
+
+def test_monitor_cli(capsys):
+    from anomod.cli import main
+    assert main(["monitor", "--mode", "passive", "--cycles", "2"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["mode"] == "passive"
+    assert doc["requests"] == 12 + 2 * 3
