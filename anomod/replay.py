@@ -82,13 +82,19 @@ def stage_columns(batch: SpanBatch, cfg: ReplayConfig, t0_us: Optional[int] = No
     return {k: v.reshape(n_chunks, cfg.chunk_size) for k, v in cols.items()}, n
 
 
-def make_replay_fn(cfg: ReplayConfig, with_hll: bool = False):
+def make_replay_fn(cfg: ReplayConfig, with_hll: bool = False,
+                   inner_repeats: int = 1):
     """Build the jitted replay: scan over chunks, one-hot matmul aggregation.
 
     ``with_hll=True`` additionally maintains per-service distinct-trace-count
     HLL registers ([S, 2^p] int32, merged exactly by max) — the streaming
     replacement for the reference's exact trace-ID sets
     (trace_collector.py:358-360).
+
+    ``inner_repeats > 1`` replays the staged chunks that many times inside one
+    dispatch (a fori_loop around the scan): device-side corpus replication for
+    throughput measurement without tiling the host arrays — the HBM working
+    set stays one copy while the counted span volume scales.
     """
     import jax
     import jax.numpy as jnp
@@ -148,7 +154,13 @@ def make_replay_fn(cfg: ReplayConfig, with_hll: bool = False):
             hist=jnp.zeros((SW, H), jnp.float32),
             hll=(jnp.zeros((cfg.n_services, M), jnp.int32)
                  if with_hll else None))
-        state, _ = jax.lax.scan(chunk_step, state, chunks)
+        if inner_repeats > 1:
+            state = jax.lax.fori_loop(
+                0, inner_repeats,
+                lambda _, st: jax.lax.scan(chunk_step, st, chunks)[0],
+                state)
+        else:
+            state, _ = jax.lax.scan(chunk_step, state, chunks)
         return state
 
     return jax.jit(replay)
@@ -200,17 +212,16 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
     Timing reads the aggregate state back to host each iteration — over a
     tunneled device, ``block_until_ready`` alone returns before execution
     finishes, so a host read-back is the only honest barrier.  ``replicate``
-    tiles the staged chunks to amortize the fixed dispatch/RPC overhead into
-    a steady-state number.
+    replays the staged chunks that many times *on device* (inner fori_loop)
+    to amortize the fixed dispatch/RPC overhead into a steady-state number
+    without inflating the host arrays or the HBM working set.
     """
     import jax
     cfg = cfg or ReplayConfig(n_services=len(batch.services))
     chunks_np, n = stage_columns(batch, cfg)
-    if replicate > 1:
-        chunks_np = {k: np.concatenate([v] * replicate) for k, v in chunks_np.items()}
-        n *= replicate
+    n *= replicate
     chunks = jax.device_put(chunks_np)
-    fn = make_replay_fn(cfg)
+    fn = make_replay_fn(cfg, inner_repeats=replicate)
     t0 = time.perf_counter()
     np.asarray(fn(chunks).agg)
     compile_s = time.perf_counter() - t0
@@ -218,9 +229,13 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
     for _ in range(repeats):
         t0 = time.perf_counter()
         out = fn(chunks)
-        total = float(np.asarray(out.agg)[:, F_COUNT].sum())  # host barrier
+        total = float(np.asarray(out.agg)[:, F_COUNT].astype(np.float64).sum())
         times.append(time.perf_counter() - t0)
-    assert int(total) == n, f"span count mismatch: {total} != {n}"
+    # Sanity check with f32 headroom: per-segment counts accumulate on device
+    # in f32 and lose exactness past 2^24 spans per (service, window) segment,
+    # so allow a small relative slack instead of demanding exact equality.
+    assert abs(total - n) <= max(8.0, 1e-6 * n), \
+        f"span count mismatch: {total} != {n}"
     wall = sorted(times)[len(times) // 2]
     return ThroughputResult(n_spans=n, wall_s=wall,
                             spans_per_sec=n / wall, compile_s=compile_s)

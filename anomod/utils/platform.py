@@ -1,0 +1,39 @@
+"""Single home for the "pin JAX to virtual CPU devices" workaround.
+
+The container's axon sitecustomize force-registers the TPU platform at
+interpreter start, so ``JAX_PLATFORMS=cpu`` in the environment alone does not
+stick — and with a dead tunnel the first backend-touching call (anything via
+``jax.devices()``) hangs forever.  The reliable recipe, used by the test
+suite, the driver dry run, and the benchmark fallback alike:
+
+  1. put ``--xla_force_host_platform_device_count=N`` into ``XLA_FLAGS``
+     (covers subprocesses that initialize on import),
+  2. pin ``jax_platforms=cpu`` + ``jax_num_cpu_devices`` via ``jax.config``
+     *before* backend init in this process,
+  3. ``clear_backends()`` first so a previously-initialized process can be
+     repointed (no-op when nothing is initialized yet).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu(n_devices: int = 1) -> None:
+    """Pin this process's JAX to ``n_devices`` virtual CPU devices.
+
+    Safe to call before or after backend init; must be called before any
+    device-touching call to avoid the dead-tunnel hang.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    from jax.extend.backend import clear_backends
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_devices)

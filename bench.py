@@ -7,43 +7,116 @@ Prints ONE JSON line:
 Baseline (BASELINE.json north star): 1,000,000 spans/sec/chip on TT_data
 replay.  The corpus is the full 13-experiment TT tree loaded via the typed
 loaders (LFS stubs fall back to the seeded synthetic generator, which is the
-shipped checkout's situation), staged to HBM and replayed with the jitted
-windowed-aggregation kernel.
+shipped checkout's situation), staged to HBM once and replayed with the
+jitted windowed-aggregation kernel; ``replicate`` loops the corpus on device
+to reach steady state (~30M spans counted per dispatch on TPU).
+
+Environment hardening (the capture path must survive a dead axon tunnel,
+where anything touching ``jax.devices()`` either raises or hangs forever):
+
+  1. The device backend is probed in a *subprocess* with a hard deadline
+     (bounded retry), so a hung tunnel cannot hang this process.
+  2. On probe failure the benchmark pins ``jax_platforms=cpu`` before backend
+     init (the same pre-init pin tests/conftest.py uses — env vars alone do
+     not override the container sitecustomize's forced axon registration) and
+     still produces a number, with ``device_note`` explaining the fallback.
+  3. Any error after that still emits the JSON line with an ``error`` field.
+
+``ANOMOD_BENCH_PLATFORM=cpu|tpu`` skips the probe and forces the platform.
 """
 
 import json
+import os
+import subprocess
 import sys
+import time
+
+_PROBE = "import jax; print(jax.devices()[0].platform)"
+
+
+def _resolve_platform(attempts=(75.0, 30.0)):
+    """Return ("default"|"cpu", diagnostic). Probes backend init out-of-process
+    with a hard deadline per attempt so a dead tunnel can't block the bench.
+    A backend that initializes but is CPU-only still resolves to "cpu" so the
+    workload is sized for the host, not for a TPU."""
+    forced = os.environ.get("ANOMOD_BENCH_PLATFORM", "").strip().lower()
+    if forced:
+        plat = "cpu" if forced == "cpu" else "default"
+        return plat, f"forced via ANOMOD_BENCH_PLATFORM={forced}"
+    last = ""
+    for t in attempts:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE], timeout=t,
+                capture_output=True)
+            if r.returncode == 0:
+                plat = r.stdout.decode(errors="replace").strip()
+                if plat == "cpu":
+                    return "cpu", "backend probe found CPU-only devices"
+                return "default", f"device backend probe ok ({plat})"
+            last = (r.stderr or b"").decode(errors="replace").strip()[-300:]
+        except subprocess.TimeoutExpired:
+            last = f"backend init probe timed out after {t:.0f}s"
+    return "cpu", f"device backend unavailable ({last or 'unknown'})"
 
 
 def main() -> int:
-    import jax
-
-    from anomod import labels, synth
-    from anomod.replay import ReplayConfig, measure_throughput
-    from anomod.schemas import concat_span_batches
-
-    # Big TT corpus: all 13 experiments, tiled to ~30M staged spans so the
-    # fixed dispatch overhead amortizes into a steady-state number.
-    n_traces = int(sys.argv[1]) if len(sys.argv) > 1 else 8_000
-    batches = [synth.generate_spans(l, n_traces=n_traces)
-               for l in labels.labels_for_testbed("TT")]
-    batch = concat_span_batches(batches)
-
-    cfg = ReplayConfig(n_services=batch.n_services)
-    result = measure_throughput(batch, cfg, repeats=3, replicate=16)
-
-    baseline = 1_000_000.0
-    print(json.dumps({
+    n_traces = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000
+    out = {
         "metric": "tt_replay_throughput",
-        "value": round(result.spans_per_sec, 1),
+        "value": 0.0,
         "unit": "spans/sec/chip",
-        "vs_baseline": round(result.spans_per_sec / baseline, 3),
-        "n_spans": result.n_spans,
-        "wall_s": round(result.wall_s, 4),
-        "compile_s": round(result.compile_s, 2),
-        "device": str(jax.devices()[0]),
-    }))
-    return 0
+        "vs_baseline": 0.0,
+    }
+    baseline = 1_000_000.0
+
+    platform, diag = _resolve_platform()
+    import jax
+    if platform == "cpu":
+        # Pre-init platform pin (conftest.py technique); must run before any
+        # backend-touching call in this process.
+        jax.config.update("jax_platforms", "cpu")
+
+    try:
+        from anomod import labels, synth
+        from anomod.replay import ReplayConfig, measure_throughput
+        from anomod.schemas import concat_span_batches
+
+        t0 = time.perf_counter()
+        batches = [synth.generate_spans(l, n_traces=n_traces)
+                   for l in labels.labels_for_testbed("TT")]
+        batch = concat_span_batches(batches)
+        prep_s = time.perf_counter() - t0
+
+        # Device-side replication: ~30M counted spans/dispatch on TPU; keep
+        # the CPU fallback fast enough to always finish within the budget.
+        replicate = 64 if platform != "cpu" else 2
+        repeats = 3 if platform != "cpu" else 2
+        cfg = ReplayConfig(n_services=batch.n_services)
+        result = measure_throughput(batch, cfg, repeats=repeats,
+                                    replicate=replicate)
+
+        out.update({
+            "value": round(result.spans_per_sec, 1),
+            "vs_baseline": round(result.spans_per_sec / baseline, 3),
+            "n_spans": result.n_spans,
+            "wall_s": round(result.wall_s, 4),
+            "compile_s": round(result.compile_s, 2),
+            "prep_s": round(prep_s, 2),
+            "device": str(jax.devices()[0]),
+        })
+        if platform == "cpu":
+            out["device_note"] = diag
+        print(json.dumps(out))
+        return 0
+    except Exception as e:  # still emit the JSON line with diagnostics
+        out.update({
+            "device": "unavailable",
+            "error": f"{type(e).__name__}: {e}",
+            "device_note": diag,
+        })
+        print(json.dumps(out))
+        return 1
 
 
 if __name__ == "__main__":

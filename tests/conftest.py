@@ -1,19 +1,10 @@
 """Test config: force JAX onto a virtual 8-device CPU mesh (no TPU needed).
 
 The container's axon sitecustomize force-registers the TPU platform at
-interpreter start (jax_platforms="axon,cpu"), so env vars alone don't stick —
-we set the XLA host-device-count flag before jax initializes and then pin the
-platform to cpu via jax.config (backends aren't initialized yet at conftest
-import time, so this takes effect cleanly).
+interpreter start, so env vars alone don't stick; the shared helper applies
+the pre-init pin (anomod.utils.platform is the single home for the recipe).
 """
 
-import os
+from anomod.utils.platform import pin_cpu
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+pin_cpu(8)

@@ -91,3 +91,21 @@ def test_replay_hll_distinct_traces(tt_batch):
         true = len(np.unique(tt_batch.trace[tt_batch.service == s]))
         if true >= 50:
             assert abs(est[s] - true) / true < 0.25, (s, true, est[s])
+
+
+def test_replay_inner_repeats_scales_state(tt_batch):
+    """Device-side replication (bench replicate) = exactly R x one pass."""
+    cfg = ReplayConfig(n_services=tt_batch.n_services, chunk_size=2048)
+    chunks, _ = stage_columns(tt_batch, cfg)
+    one = make_replay_fn(cfg)(chunks)
+    three = make_replay_fn(cfg, inner_repeats=3)(chunks)
+    np.testing.assert_allclose(np.asarray(three.agg),
+                               3.0 * np.asarray(one.agg), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(three.hist),
+                                  3.0 * np.asarray(one.hist))
+
+
+def test_measure_throughput_replicate_counts(tt_batch):
+    cfg = ReplayConfig(n_services=tt_batch.n_services, chunk_size=4096)
+    r = measure_throughput(tt_batch, cfg, repeats=1, replicate=3)
+    assert r.n_spans == 3 * tt_batch.n_spans
