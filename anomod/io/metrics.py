@@ -119,6 +119,33 @@ def load_tt_metric_csv(path: Path) -> Optional[MetricBatch]:
         from anomod.io import native
         if native.available():
             num = native.scan_csv_columns(raw, [1, 3])
+    # Validate the fast path before trusting it: the C++ scanner is
+    # line-based, so quoted fields with embedded newlines (or whitespace-only
+    # lines) desynchronize its row index from the csv module's record index —
+    # require exact record-count agreement (streaming csv.reader pass, no
+    # materialized row list) plus a first-record value/timestamp spot-check,
+    # else fall back to pure Python for the whole file.
+    if num is not None:
+        with open(path, newline="") as f:
+            n_rec = sum(1 for r in csv.reader(f) if r) - 1  # minus header
+        if num.shape[1] != n_rec:
+            num = None
+    if num is not None and num.shape[1] > 0:
+        with open(path, newline="") as f:
+            first = next(csv.DictReader(f), None)
+        if first is not None:
+            py_t = _parse_ts(first.get("timestamp", "0"))
+            try:
+                py_v = float(first["value"]) if first.get("value") \
+                    else float("nan")
+            except (TypeError, ValueError):
+                py_v = float("nan")
+            nat_t = float(num[0, 0])
+            nat_t = 0.0 if np.isnan(nat_t) else nat_t
+            nat_v = float(num[1, 0])
+            if nat_t != py_t or not (nat_v == py_v
+                                     or (np.isnan(nat_v) and np.isnan(py_v))):
+                num = None
     rows: List[Tuple[str, float, float, Dict[str, str]]] = []
     with open(path, newline="") as f:
         for i, rec in enumerate(csv.DictReader(f)):

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import ctypes
 import subprocess
+import warnings
 from pathlib import Path
 from typing import Optional, Tuple
 
@@ -20,19 +21,36 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
+def _stale() -> bool:
+    """True when the .so is missing or older than any native source."""
+    if not _SO_PATH.exists():
+        return True
+    so_mtime = _SO_PATH.stat().st_mtime
+    srcs = [_NATIVE_DIR / "Makefile", *_NATIVE_DIR.glob("*.cpp"),
+            *_NATIVE_DIR.glob("*.h")]
+    return any(s.exists() and s.stat().st_mtime > so_mtime for s in srcs)
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _LIB, _TRIED
     if _LIB is not None or _TRIED:
         return _LIB
     _TRIED = True
-    if (_NATIVE_DIR / "Makefile").exists():
-        # always run make: it is dependency-driven (no-op when current) and
-        # rebuilds a stale .so left over from an older source revision
+    # Only shell out to make when the .so is actually stale (mtime check):
+    # read-only installs and toolchain-free hosts then skip the subprocess
+    # spawn entirely, and a failed build degrades observably, not silently.
+    if (_NATIVE_DIR / "Makefile").exists() and _stale():
         try:
             subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
                            capture_output=True, timeout=120)
-        except (subprocess.SubprocessError, OSError):
-            pass
+        except (subprocess.SubprocessError, OSError) as e:
+            detail = ""
+            if isinstance(e, subprocess.CalledProcessError) and e.stderr:
+                detail = ": " + e.stderr.decode(errors="replace")[-200:]
+            warnings.warn(
+                f"anomod native build failed ({type(e).__name__}{detail}); "
+                "falling back to stale .so or pure Python",
+                RuntimeWarning, stacklevel=2)
     if not _SO_PATH.exists():
         return None
     try:

@@ -89,7 +89,17 @@ class MonitorReport:
 
 class ActiveMonitor:
     """The enhanced monitor: every cycle probes all 12 endpoints with the
-    method/body rules above."""
+    method/body rules above.
+
+    Intentional redesign vs the reference (enhanced_openapi_monitor.py):
+    the reference samples only the first 5 *reachable* endpoints per cycle
+    (:260,:279) and keeps its connectivity pre-check responses out of
+    ``openapi_responses.jsonl``; this monitor probes all 12 endpoints every
+    cycle regardless of connectivity and records the 12 pre-check probes in
+    the batch.  Deterministic full coverage beats a reachability-dependent
+    prefix for a synthetic SUT: the record count is exactly
+    ``12 + cycles*12``, so artifacts are reproducible and fault-conditioned
+    endpoint gaps can't silently shrink the sample."""
 
     mode = "active"
     endpoints = SN_ENDPOINTS

@@ -13,6 +13,20 @@ from typing import Optional, Sequence
 import numpy as np
 
 
+def pvary_compat(x, axis_names: Sequence[str]):
+    """Mark ``x`` device-varying over the named mesh axes (shard_map vma
+    checking requires loop carries to match varying outputs).  Single home
+    for the pcast/pvary API shim: ``jax.lax.pcast(..., to="varying")``
+    replaced the deprecated ``pvary``.  No-op when already varying."""
+    from jax import lax
+    vma = getattr(getattr(x, "aval", None), "vma", frozenset())
+    if all(a in vma for a in axis_names):
+        return x
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, tuple(axis_names), to="varying")
+    return lax.pvary(x, tuple(axis_names))
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "data"):
     """1-D device mesh over the first n devices (defaults to all)."""
     import jax

@@ -104,11 +104,8 @@ def make_pipeline_forward(mesh, cfg: PipelineConfig, S: int, W: int):
         return h
 
     def _varying(x):
-        if AXIS in getattr(getattr(x, "aval", None), "vma", frozenset()):
-            return x                         # already varying over the axis
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, (AXIS,), to="varying")
-        return lax.pvary(x, (AXIS,))
+        from anomod.parallel.mesh import pvary_compat
+        return pvary_compat(x, (AXIS,))
 
     def pipeline_local(stage_params, micro):
         # stage_params leading [1, lps, ...] (my shard); micro [M, mb, L, d]

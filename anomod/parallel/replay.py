@@ -26,11 +26,12 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data"):
     SW, H = cfg.sw, cfg.n_hist_buckets
 
     def shard_body(chunks):  # runs per-device on its [N/D, C] shard
-        # pvary: the carry is device-varying from step 1 on, so the initial
-        # zeros must be marked varying over the data axis too
+        # the carry is device-varying from step 1 on, so the initial zeros
+        # must be cast to varying over the data axis too
+        from anomod.parallel.mesh import pvary_compat
         state = ReplayState(
-            agg=jax.lax.pvary(jnp.zeros((SW, N_FEATS), jnp.float32), (axis,)),
-            hist=jax.lax.pvary(jnp.zeros((SW, H), jnp.float32), (axis,)))
+            agg=pvary_compat(jnp.zeros((SW, N_FEATS), jnp.float32), (axis,)),
+            hist=pvary_compat(jnp.zeros((SW, H), jnp.float32), (axis,)))
 
         def step(state, chunk):
             sid = chunk["sid"]

@@ -68,9 +68,8 @@ def ring_attention_local(q, k, v, axis_name: str):
     def _varying(x):
         # fresh constants are unvarying over the mesh axis; the loop carry
         # must match the varying outputs (shard_map vma checking)
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, (axis_name,), to="varying")
-        return lax.pvary(x, (axis_name,))
+        from anomod.parallel.mesh import pvary_compat
+        return pvary_compat(x, (axis_name,))
 
     num0 = jnp.zeros_like(q)
     den0 = _varying(jnp.zeros((Lq, H), q.dtype))

@@ -54,7 +54,8 @@ def make_seqpar_recurrence(mesh, axis: str = "data"):
     def body(xs_local, decay):
         # decay is replicated (P()) hence device-invariant; mark it varying so
         # every derived carry/aggregate has consistent vma annotations
-        decay = jax.lax.pvary(decay, (axis,))
+        from anomod.parallel.mesh import pvary_compat
+        decay = pvary_compat(decay, (axis,))
         # local block scan
         h_local = linear_recurrence(xs_local, decay)             # [T/D, ...]
         t_local = xs_local.shape[0]

@@ -69,8 +69,12 @@ def build_dataset(testbed: str, seeds: Sequence[int], n_traces: int = 80,
         base_x = detect.extract_features(normal, services).x
         base_t = _windowed_features(normal.spans, services, cfg)
         for label in labels_mod.labels_for_testbed(testbed):
-            exp = synth.generate_experiment(label, n_traces=n_traces,
-                                            seed=seed * 1000 + hash(label.experiment) % 997)
+            # process-stable per-(seed, experiment) stream: Python's hash() is
+            # salted per interpreter, which would make every build_dataset
+            # call produce different corpora across processes
+            exp = synth.generate_experiment(
+                label, n_traces=n_traces,
+                seed=seed * 1000 + synth._seed_for(label.experiment) % 997)
             x = detect.extract_features(exp, services).x - base_x
             x_t = _windowed_features(exp.spans, services, cfg) - base_t
             g = build_service_graph(exp.spans, services=services)

@@ -22,6 +22,7 @@
 #include <functional>
 #include <mutex>
 #include <queue>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -224,8 +225,11 @@ int64_t anomod_rt_summarize_logs(void* rt_ptr, const char* const* paths,
 
 // Extract numeric columns from a CSV buffer: for each row, parse the
 // requested column indices with strtod (non-numeric/missing -> NaN).
-// Double-quoted fields may contain commas (not newlines).  Output is
-// column-major: out[c * max_rows + r].  Returns the number of rows parsed.
+// Accepted dialect: double-quoted fields may contain commas but NOT
+// newlines; RFC-4180 escaped quotes ("") inside a field parse as NaN
+// (non-numeric).  Callers needing full RFC-4180 must validate row counts
+// against a real CSV parser and fall back (anomod/io/metrics.py does).
+// Output is column-major: out[c * max_rows + r].  Returns rows parsed.
 int64_t anomod_scan_csv_cols(const char* text, int64_t len,
                              const int32_t* cols, int32_t n_cols,
                              int32_t skip_header, double* out,
@@ -236,6 +240,7 @@ int64_t anomod_scan_csv_cols(const char* text, int64_t len,
         if (cols[c] > max_col) max_col = cols[c];
     std::vector<const char*> field_beg((size_t)max_col + 2);
     std::vector<size_t> field_len((size_t)max_col + 2);
+    std::string scratch;  // reused NUL-terminated field copy for strtod
     int64_t row = 0;
     const char* p = text;
     const char* end = text + len;
@@ -257,8 +262,18 @@ int64_t anomod_scan_csv_cols(const char* text, int64_t len,
                 const char* fb = q;
                 size_t fl = 0;
                 if (q < eol && *q == '"') {
+                    // quoted field: skip over RFC-4180 escaped quotes ("")
+                    // so the field span keeps them — the numeric parse below
+                    // then sees the interior '"' and yields NaN, matching
+                    // the pure-Python fallback (float('1.5"x') raises)
                     fb = ++q;
-                    while (q < eol && *q != '"') ++q;
+                    while (q < eol) {
+                        if (*q == '"') {
+                            if (q + 1 < eol && q[1] == '"') { q += 2; continue; }
+                            break;
+                        }
+                        ++q;
+                    }
                     fl = (size_t)(q - fb);
                     while (q < eol && *q != ',') ++q;
                 } else {
@@ -273,11 +288,20 @@ int64_t anomod_scan_csv_cols(const char* text, int64_t len,
             }
             for (int32_t c = 0; c < n_cols; ++c) {
                 double v = nan;
-                if (cols[c] < nf && field_len[cols[c]] > 0) {
-                    char* endq = nullptr;
+                const size_t fl = cols[c] < nf ? field_len[cols[c]] : 0;
+                // bound strtod by the field via a NUL-terminated copy into a
+                // reused buffer (the raw buffer only stops it on ',' or '"'
+                // by luck of the delimiters); an interior '"' means an
+                // RFC-4180 escaped quote -> non-numeric
+                if (fl > 0) {
                     const char* fb = field_beg[cols[c]];
-                    const double parsed = std::strtod(fb, &endq);
-                    if (endq > fb) v = parsed;
+                    if (memchr(fb, '"', fl) == nullptr) {
+                        scratch.assign(fb, fl);
+                        char* endq = nullptr;
+                        const double parsed =
+                            std::strtod(scratch.c_str(), &endq);
+                        if (endq > scratch.c_str()) v = parsed;
+                    }
                 }
                 out[(int64_t)c * max_rows + row] = v;
             }

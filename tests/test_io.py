@@ -181,3 +181,20 @@ def test_es_trace_loader_roundtrip(tmp_path):
     i = b.services.index("ts-order-service")
     assert bool(b.is_error[b.service == i][0])
     assert int(b.duration_us[b.service == i][0]) == 500_000
+
+
+def test_tt_metric_csv_embedded_newline_fallback(tmp_path):
+    """RFC-4180 quoted newlines desync the native line-based scanner; the
+    loader must detect the row-count mismatch and fall back to pure Python
+    so every row keeps its own timestamp/value."""
+    p = tmp_path / "exp_metrics_x.csv"
+    p.write_text(
+        "metric_name,timestamp,datetime,value,labels\n"
+        'node_load1,1700000000,2023-11-14T22:13:20,1.5,"pod=""a\nb"""\n'
+        "node_load1,1700000060,2023-11-14T22:14:20,2.5,x\n"
+    )
+    from anomod.io.metrics import load_tt_metric_csv
+    batch = load_tt_metric_csv(p)
+    assert batch is not None and batch.n_samples == 2
+    assert sorted(batch.value.tolist()) == [1.5, 2.5]
+    assert sorted(batch.t_s.tolist()) == [1700000000.0, 1700000060.0]

@@ -109,3 +109,36 @@ def test_measure_throughput_replicate_counts(tt_batch):
     cfg = ReplayConfig(n_services=tt_batch.n_services, chunk_size=4096)
     r = measure_throughput(tt_batch, cfg, repeats=1, replicate=3)
     assert r.n_spans == 3 * tt_batch.n_spans
+
+
+def test_replay_variance_reconstruction_low_variance():
+    """Variance from the bf16 hi/lo moment planes on a LOW-variance latency
+    distribution: pins the accepted error bound documented in chunk_step
+    (~1.5e-5 * E[x^2] / Var(x) relative after the E[x^2]-E[x]^2 cancellation).
+    """
+    from anomod import labels, synth
+    rng = np.random.default_rng(0)
+    base = synth.generate_spans(labels.label_for("Normal_case"), n_traces=400)
+    # low-variance log-latency: sigma=0.1 around ~50ms (vs synth's 0.4)
+    dur_us = np.exp(rng.normal(np.log(50_000.0), 0.1,
+                               base.n_spans)).astype(np.int64)
+    batch = base._replace(duration_us=dur_us)
+    cfg = ReplayConfig(n_services=batch.n_services, n_windows=1,
+                       chunk_size=2048, window_us=10**12)
+    chunks, _ = stage_columns(batch, cfg)
+    out = make_replay_fn(cfg)(chunks)
+    agg = np.asarray(out.agg)
+    from anomod.replay import F_LOGLAT, F_LOGLAT2
+    x = np.log1p(dur_us.astype(np.float64))
+    for s in range(batch.n_services):
+        m = batch.service == s
+        n = int(m.sum())
+        if n < 500:
+            continue
+        mean = agg[s, F_LOGLAT] / n
+        var = agg[s, F_LOGLAT2] / n - mean**2
+        true_var = x[m].var()
+        # documented bound: rel err ~ 1.5e-5 * E[x^2]/Var ~ 0.2 at sigma=0.1;
+        # assert a 30% envelope (and that var stays positive / same scale)
+        assert var > 0, (s, var)
+        assert abs(var - true_var) / true_var < 0.30, (s, var, true_var)
