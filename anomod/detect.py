@@ -33,7 +33,15 @@ class ServiceFeatures(NamedTuple):
 
 FEATURES = ("lat_p99_log", "lat_p50_log", "err_rate", "log_err_rate",
             "span_count_log", "lat_mean_log", "metric_level_log",
-            "api_err_rate", "api_lat_log", "coverage_ratio")
+            "api_err_rate", "api_lat_log", "coverage_ratio",
+            # level-keyed metric features: mean log-level of the series whose
+            # metric family belongs to each anomaly-level group — the
+            # reference keys its catalog by fault level
+            # (metric_collector.py:37-104), so the detector sees the same
+            # grouping (performance / service / database)
+            "metric_perf_log", "metric_service_log", "metric_db_log")
+
+_LEVEL_FEATURES = ("performance", "service", "database")  # cols 10..12
 
 
 def extract_features(exp: Experiment,
@@ -63,6 +71,7 @@ def extract_features(exp: Experiment,
         with np.errstate(invalid="ignore"):
             x[:, 3] = np.where(tot > 0, err / np.maximum(tot, 1), 0.0)
     if exp.metrics is not None and len(exp.metrics.services):
+        from anomod.metrics_catalog import level_metric_names
         m = exp.metrics
         # mean log-level of all series attributed to each service
         series_to_svc = np.array(
@@ -70,12 +79,25 @@ def extract_features(exp: Experiment,
              for s in m.series_service], np.int32)
         sample_svc = series_to_svc[m.series]
         keep = (sample_svc >= 0) & np.isfinite(m.value)
+        logv = np.log1p(np.abs(np.where(np.isfinite(m.value), m.value, 0.0)))
         tot = np.zeros(S, np.float64)
         cnt = np.zeros(S, np.int64)
-        np.add.at(tot, sample_svc[keep], np.log1p(np.abs(m.value[keep])))
+        np.add.at(tot, sample_svc[keep], logv[keep])
         np.add.at(cnt, sample_svc[keep], 1)
         with np.errstate(invalid="ignore"):
             x[:, 6] = np.where(cnt > 0, tot / np.maximum(cnt, 1), 0.0)
+        # level-keyed means over the catalog's anomaly-level groups
+        for li, level in enumerate(_LEVEL_FEATURES):
+            names = set(level_metric_names(exp.testbed, level))
+            in_level = np.array([n in names for n in m.metric_names], np.bool_)
+            keep_l = keep & in_level[m.metric]
+            tot_l = np.zeros(S, np.float64)
+            cnt_l = np.zeros(S, np.int64)
+            np.add.at(tot_l, sample_svc[keep_l], logv[keep_l])
+            np.add.at(cnt_l, sample_svc[keep_l], 1)
+            with np.errstate(invalid="ignore"):
+                x[:, 10 + li] = np.where(cnt_l > 0,
+                                         tot_l / np.maximum(cnt_l, 1), 0.0)
     if exp.api is not None and exp.api.n_records:
         from anomod.suite import endpoint_owner
         owner = np.array([svc_index.get(endpoint_owner(e, exp.testbed), -1)
@@ -134,10 +156,16 @@ def service_scores(feat: np.ndarray, base: np.ndarray,
     # the culprit (generate_coverage drops it; a real fault may also raise
     # error-handling paths) — score the absolute shift
     d_cov = xp.abs(feat[:, 9] - base[:, 9]) * has_cov
+    # level-keyed metric deltas (cols 10..12): same Δlog-level form as the
+    # all-metrics column, but split by the catalog's anomaly-level groups so
+    # a database fault's fd/storage movement isn't diluted by flat
+    # performance families
+    d_lvl = xp.sum(xp.clip(feat[:, 10:13] - base[:, 10:13], 0.0, None),
+                   axis=-1)
     n = xp.expm1(feat[:, 4])
     conf = n / (n + 20.0)
     return (conf * (_W_LAT * lat_infl + _W_ERR * d_err)
-            + _W_LOG * d_log + _W_MET * d_met
+            + _W_LOG * d_log + _W_MET * d_met + _W_MET * d_lvl
             + _W_API_ERR * d_api_err + _W_API_LAT * d_api_lat
             + _W_COV * d_cov)
 

@@ -537,39 +537,271 @@ def spans_to_jaeger_json(batch: SpanBatch) -> dict:
 # Metrics
 # ---------------------------------------------------------------------------
 
-SN_METRIC_FILES: Tuple[str, ...] = (
-    # observed in SN_data/metric_data/<exp>/ and collect_metric.sh:24-125
-    "system_cpu_usage", "system_memory_usage_percent", "system_load1",
-    "system_disk_usage_percent", "system_disk_io_time",
-    "system_disk_read_bytes", "system_disk_write_bytes",
-    "system_network_receive_bytes", "system_network_transmit_bytes",
-    "system_network_errors", "socialnet_container_cpu",
-    "socialnet_container_memory", "socialnet_container_network_receive",
-    "socialnet_container_network_transmit", "jaeger_spans_rate",
-    "redis_memory_used",
-)
+# Complete reference catalogs live in anomod.metrics_catalog (level-keyed);
+# re-exported here because the generator is where they become data.
+from anomod.metrics_catalog import (  # noqa: E402
+    SN_METRIC_FILES, SN_PER_SERVICE_FILES, TT_ALL_METRIC_NAMES,
+    TT_METRIC_NAMES, TT_PER_SERVICE_METRICS)
 
-TT_METRIC_NAMES: Tuple[str, ...] = (
-    # subset of the catalog at metric_collector.py:37-104
-    "node_cpu_seconds_total", "node_memory_MemAvailable_bytes",
-    "container_cpu_usage_seconds_total", "container_memory_working_set_bytes",
-    "container_network_receive_bytes_total", "container_network_transmit_bytes_total",
-    "kube_pod_status_phase", "kube_pod_container_status_restarts_total",
-    "node_disk_io_time_seconds_total", "node_load1",
-    "mysql_global_status_threads_connected", "http_server_requests_seconds_count",
-)
+
+def _host_family_values(name: str, label: FaultLabel, rng, t, in_window,
+                        lat_mult: float) -> np.ndarray:
+    """One host-scoped series for an SN/TT metric family, fault-conditioned.
+
+    Shapes follow the reference's sanity thresholds where it states them
+    (SN README.md:106: CPU fault ⇒ system_cpu_usage > 90%, Redis cache fault
+    ⇒ reduced redis_memory_used plateau); otherwise: performance faults
+    inflate their matching resource family inside the anomaly window,
+    database faults move storage/fd families, everything else is stationary
+    noise around a per-family operating point.
+    """
+    nt = t.shape[0]
+    anomaly = label.is_anomaly
+    typ = label.anomaly_type
+    lvl = label.anomaly_level
+
+    def gauge(base: float, noise: float) -> np.ndarray:
+        return base + rng.normal(0, noise, nt)
+
+    if name in ("system_cpu_usage",):
+        base = gauge(rng.uniform(15, 35), 3)
+        if anomaly and typ == "cpu_contention":
+            base = np.where(in_window, rng.uniform(91, 99, nt), base)
+        return np.clip(base, 0, 100)
+    if name == "node_cpu_seconds_total":
+        # counter: cumulative busy seconds; slope rises under CPU faults
+        rate = np.clip(gauge(rng.uniform(2, 6), 0.5), 0.1, None)
+        if anomaly and typ == "cpu_contention":
+            rate = np.where(in_window, rate * lat_mult, rate)
+        return np.cumsum(rate)
+    if name in ("system_load1", "node_load5"):
+        base = np.abs(gauge(rng.uniform(0.5, 2.0), 0.3))
+        if anomaly and typ == "cpu_contention":
+            base = np.where(in_window, base * 5.0, base)
+        return base
+    if name == "system_memory_usage_percent":
+        return np.clip(gauge(rng.uniform(35, 60), 2), 0, 100)
+    if name == "node_memory_MemTotal_bytes":
+        return np.full(nt, 16.0e9)
+    if name in ("node_memory_MemAvailable_bytes", "node_memory_MemFree_bytes"):
+        base = gauge(rng.uniform(6e9, 9e9), 2e8)
+        if anomaly and typ == "cache_limit":  # memory stress on the DB host
+            base = np.where(in_window, base * 0.4, base)
+        return np.clip(base, 1e8, None)
+    if name in ("system_disk_io_time", "node_disk_io_time_seconds_total",
+                "system_disk_read_bytes", "system_disk_write_bytes",
+                "node_disk_read_bytes_total", "node_disk_written_bytes_total"):
+        base = np.abs(gauge(rng.uniform(5, 50), 5))
+        if anomaly and typ == "disk_io_stress":
+            base = np.where(in_window, base * lat_mult, base)
+        return base
+    if name == "system_disk_usage_percent":
+        return np.clip(gauge(rng.uniform(40, 70), 0.5), 0, 100)
+    if name in ("node_filesystem_size_bytes",):
+        return np.full(nt, 200.0e9)
+    if name == "node_filesystem_avail_bytes":
+        drain = 1e5 if not (anomaly and lvl == "database") else 5e6
+        return 80.0e9 - np.cumsum(np.full(nt, drain)) + rng.normal(0, 1e6, nt)
+    if name == "volume_manager_total_volumes":
+        return np.full(nt, float(rng.integers(20, 40)))
+    if name in ("system_network_receive_bytes", "system_network_transmit_bytes",
+                "node_network_receive_bytes_total",
+                "node_network_transmit_bytes_total"):
+        base = np.abs(gauge(rng.uniform(1e6, 5e6), 2e5))
+        if anomaly and typ == "network_loss":
+            base = np.where(in_window, base * 0.3, base)  # lost throughput
+        return base
+    if name in ("system_network_errors", "node_network_receive_drop_total",
+                "node_network_transmit_drop_total",
+                "node_network_receive_errs_total",
+                "node_network_transmit_errs_total"):
+        base = np.abs(gauge(1.0, 0.5))
+        if anomaly and typ in ("network_loss", "dns_failure"):
+            base = np.where(in_window, base + rng.uniform(50, 200, nt), base)
+        return base
+    if name == "jaeger_spans_rate":
+        base = np.abs(gauge(rng.uniform(100, 300), 20))
+        if anomaly and lvl == "performance":
+            base = np.where(in_window, base / max(lat_mult / 2, 1.0), base)
+        return base
+    if name == "jaeger_sampling_rate":
+        return np.clip(gauge(1.0, 0.01), 0, 1)
+    if name in ("post_creation_rate", "timeline_read_rate"):
+        from anomod.workload import SN_REQUEST_MIX
+        mix = (SN_REQUEST_MIX["compose-post-service"]
+               if name == "post_creation_rate"
+               else SN_REQUEST_MIX["home-timeline-service"]
+               + SN_REQUEST_MIX["user-timeline-service"])
+        base = np.abs(gauge(150.0 * mix, 15.0 * mix))
+        if anomaly and lvl == "performance":  # host fault slows the workload
+            base = np.where(in_window, base / max(lat_mult / 2, 1.0), base)
+        return base
+    # stationary default for families without a fault hook
+    return np.abs(gauge(rng.uniform(1, 100), 5))
+
+
+# SN store topology: the gcov compose stack runs one Redis/Mongo instance
+# per owning service (docker-compose-gcov.yml:227-322), and the ChaosBlade
+# cache-limit fault targets ONE service's Redis — so the store-family
+# PromQL (redis_memory_used_bytes etc., no grouping) returns one series per
+# exporter instance, attributed here to the owning service.
+SN_REDIS_OWNERS: Tuple[str, ...] = (
+    "home-timeline-service", "user-timeline-service", "social-graph-service")
+SN_MONGO_OWNERS: Tuple[str, ...] = (
+    "post-storage-service", "user-timeline-service", "social-graph-service",
+    "user-service", "media-service", "url-shorten-service")
+SN_STORE_FILES: Dict[str, Tuple[str, ...]] = {
+    "mongodb_latency_p95": SN_MONGO_OWNERS,
+    "redis_memory_used": SN_REDIS_OWNERS,
+    "redis_command_rate": SN_REDIS_OWNERS,
+}
+
+
+def _store_family_values(name: str, label: FaultLabel, rng, t, in_window,
+                         lat_mult: float, is_target: bool) -> np.ndarray:
+    """One per-store-instance series (owner-service attributed)."""
+    nt = t.shape[0]
+    anomaly = label.is_anomaly and is_target
+    lvl = label.anomaly_level
+    typ = label.anomaly_type
+    if name == "mongodb_latency_p95":
+        base = np.abs(rng.uniform(0.005, 0.02) + rng.normal(0, 0.002, nt))
+        if anomaly and lvl == "database":
+            # cache limit pushes misses onto the backing store
+            base = np.where(in_window, base * lat_mult, base)
+        return base
+    if name == "redis_memory_used":
+        base = rng.uniform(4e7, 6e7) + rng.normal(0, 1e6, nt)
+        if anomaly and typ == "cache_limit":
+            base = np.where(in_window, base * 0.3, base)  # README.md:106
+        return base
+    # redis_command_rate
+    base = np.abs(rng.uniform(200, 500) + rng.normal(0, 30, nt))
+    if anomaly and typ == "cache_limit":
+        base = np.where(in_window, base * 0.5, base)
+    return base
+
+
+def _service_family_values(name: str, label: FaultLabel, rng, t, in_window,
+                           lat_mult: float, err_p: float,
+                           is_target: bool) -> np.ndarray:
+    """One per-service series, fault-conditioned on the culprit service."""
+    nt = t.shape[0]
+    anomaly = label.is_anomaly and is_target
+    typ = label.anomaly_type
+
+    def gauge(base: float, noise: float) -> np.ndarray:
+        return base + rng.normal(0, noise, nt)
+
+    if name == "up":
+        v = np.ones(nt)
+        if anomaly and typ == "kill_service_instance":
+            v = np.where(in_window & (rng.random(nt) < 0.5), 0.0, v)
+        return v
+    if name == "kube_pod_status_phase":
+        v = np.ones(nt)  # 1 == Running
+        if anomaly and typ == "kill_service_instance":
+            v = np.where(in_window & (rng.random(nt) < 0.5), 0.0, v)
+        return v
+    if name == "kube_pod_container_status_restarts_total":
+        if anomaly and typ == "kill_service_instance":
+            # Schedule+PodChaos kills every 3 s (Lv_S_KILLPOD_*.yaml:15-22)
+            return np.cumsum(in_window * rng.poisson(2.0, nt)).astype(float)
+        return np.zeros(nt)
+    if name in ("microservice_request_rate", "http_requests_total"):
+        rate = np.abs(gauge(rng.uniform(20, 80), 5))
+        if anomaly and typ in ("kill_service_instance", "dns_failure"):
+            rate = np.where(in_window, rate * 0.2, rate)  # requests not arriving
+        if name == "http_requests_total":
+            return np.cumsum(rate)  # counter
+        return rate
+    if name == "microservice_error_rate":
+        base = np.clip(gauge(0.002, 0.001), 0, 1)
+        if anomaly:
+            base = np.where(in_window, np.clip(err_p + rng.normal(0, 0.02, nt),
+                                               0, 1), base)
+        return base
+    if name == "microservice_latency_p95":
+        base = np.abs(gauge(rng.uniform(0.01, 0.06), 0.005))
+        if anomaly:
+            base = np.where(in_window, base * lat_mult, base)
+        return base
+    if name in ("socialnet_container_cpu", "container_cpu_usage_seconds_total",
+                "process_cpu_seconds_total"):
+        base = np.abs(gauge(rng.uniform(5, 20), 2))
+        if anomaly and label.anomaly_level in ("performance", "database"):
+            base = np.where(in_window, base * lat_mult, base)
+        return base
+    if name == "container_cpu_cfs_throttled_periods_total":
+        rate = np.zeros(nt)
+        if anomaly and typ == "cpu_contention":
+            rate = in_window * rng.poisson(5.0, nt).astype(float)
+        return np.cumsum(rate)
+    if name in ("socialnet_container_memory", "container_memory_usage_bytes",
+                "container_memory_working_set_bytes",
+                "process_resident_memory_bytes"):
+        base = np.abs(gauge(rng.uniform(2e8, 8e8), 2e7))
+        if anomaly and typ == "cache_limit":
+            base = np.where(in_window, base * 1.8, base)
+        return base
+    if name == "container_spec_memory_limit_bytes":
+        return np.full(nt, 2.0e9)
+    if name == "container_memory_failcnt":
+        if anomaly and typ == "cache_limit":
+            return np.cumsum(in_window * rng.poisson(1.0, nt)).astype(float)
+        return np.zeros(nt)
+    if name in ("socialnet_container_network_receive",
+                "socialnet_container_network_transmit",
+                "container_network_receive_bytes_total",
+                "container_network_transmit_bytes_total"):
+        base = np.abs(gauge(rng.uniform(1e5, 1e6), 5e4))
+        if anomaly and typ in ("network_loss", "http_abort"):
+            base = np.where(in_window, base * 0.3, base)
+        return base
+    if name in ("container_network_receive_errors_total",
+                "container_network_transmit_errors_total"):
+        base = np.abs(gauge(0.5, 0.3))
+        if anomaly and typ in ("network_loss", "dns_failure"):
+            base = np.where(in_window, base + rng.uniform(20, 80, nt), base)
+        return base
+    if name == "process_open_fds":
+        base = np.abs(gauge(rng.uniform(50, 150), 10))
+        if anomaly and typ == "connection_pool_exhaustion":
+            base = np.where(in_window, base * 8.0, base)
+        return base
+    if name == "process_max_fds":
+        return np.full(nt, 1024.0)
+    if name == "container_processes":
+        return np.abs(gauge(rng.uniform(10, 40), 1))
+    if name == "kubelet_volume_stats_used_bytes":
+        drain = 5e4 if not (anomaly and label.anomaly_level == "database") \
+            else 5e6
+        return 1.0e9 + np.cumsum(np.full(nt, drain)) + rng.normal(0, 1e5, nt)
+    # generic per-service level with target inflation
+    base = np.abs(gauge(10 * rng.uniform(0.5, 2.0), 2))
+    if anomaly:
+        base = np.where(in_window, base * lat_mult, base)
+    return base
 
 
 def generate_metrics(label: FaultLabel, duration_s: int = 1800, step_s: int = 15,
                      seed: Optional[int] = None,
                      base_time_s: float = 1.7621800e9) -> MetricBatch:
     """Fault-conditioned metric samples at the reference's 15 s step
-    (collect_metric.sh:4-5)."""
+    (collect_metric.sh:4-5), over the COMPLETE reference catalogs: all 24 SN
+    per-query families (collect_metric.sh:20-125) and all TT level-group +
+    kube-state families (metric_collector.py:37-104,283-303) — see
+    anomod.metrics_catalog."""
     if seed is None:
         seed = _seed_for(label.experiment, 2)
     rng = np.random.default_rng(seed)
     services, _, _ = _topology(label.testbed)
-    names = SN_METRIC_FILES if label.testbed == "SN" else TT_METRIC_NAMES
+    if label.testbed == "SN":
+        names: Tuple[str, ...] = SN_METRIC_FILES
+        per_service = frozenset(SN_PER_SERVICE_FILES)
+    else:
+        names = TT_ALL_METRIC_NAMES
+        per_service = frozenset(TT_PER_SERVICE_METRICS)
     t = np.arange(0, duration_s, step_s, dtype=np.float64) + base_time_s
     nt = t.shape[0]
     lat_mult, err_p = _fault_effects(label)
@@ -587,44 +819,38 @@ def generate_metrics(label: FaultLabel, duration_s: int = 1800, step_s: int = 15
         t_col.append(t)
         v_col.append(values)
 
-    # anomaly window: middle third of the experiment
+    # anomaly window: middle third of the experiment (same [600, 1200) s
+    # window generate_spans / generate_logs / generate_api use)
     in_window = (t - t[0] >= duration_s / 3) & (t - t[0] < 2 * duration_s / 3)
+    # SN host-level performance faults (ChaosBlade on the Docker host) hit
+    # every service's containers; named-target faults hit one service.
+    host_level = label.is_anomaly and label.target_service not in services
     for m_idx, name in enumerate(names):
-        if "cpu" in name and ("system" in name or "node" in name):
-            base = rng.uniform(15, 35) + rng.normal(0, 3, nt)
-            if label.is_anomaly and label.anomaly_type == "cpu_contention":
-                base = np.where(in_window, rng.uniform(91, 99, nt), base)
-            add_series(m_idx, 'instance="host"', -1, np.clip(base, 0, 100))
-        elif "container" in name or "http_server" in name:
-            # first 12 services + always the fault target (so per-service
-            # fault signal survives the truncation on the ~45-service TT list)
-            svc_set = list(range(min(len(services), 12)))
-            if (label.target_service in services
-                    and services.index(label.target_service) not in svc_set):
-                svc_set.append(services.index(label.target_service))
-            for s in svc_set:
-                scale = rng.uniform(0.5, 2.0)
-                base = np.abs(rng.normal(10 * scale, 2, nt))
-                if (label.is_anomaly and label.target_service
-                        and services[s] == label.target_service):
-                    base = np.where(in_window, base * lat_mult, base)
-                key = (f'name="{services[s]}"' if label.testbed == "SN"
-                       else f'pod="{services[s]}-0",service="{services[s]}"')
-                add_series(m_idx, key, s, base)
-        elif name == "redis_memory_used":
-            base = rng.uniform(4e7, 6e7) + rng.normal(0, 1e6, nt)
-            if label.is_anomaly and label.anomaly_type == "cache_limit":
-                base = np.where(in_window, base * 0.3, base)  # README.md:106 plateau drop
-            add_series(m_idx, 'instance="redis"', -1, base)
+        if label.testbed == "SN" and name in SN_STORE_FILES:
+            store = name.split("_")[0]  # "mongodb" | "redis"
+            for svc_name in SN_STORE_FILES[name]:
+                s = services.index(svc_name)
+                is_target = label.is_anomaly and (
+                    host_level or svc_name == label.target_service)
+                add_series(m_idx, f'instance="{svc_name}-{store}"', s,
+                           _store_family_values(name, label, rng, t,
+                                                in_window, lat_mult,
+                                                is_target))
+        elif name in per_service:
+            for s, svc_name in enumerate(services):
+                is_target = label.is_anomaly and (
+                    host_level or svc_name == label.target_service)
+                key = (f'name="{svc_name}"' if label.testbed == "SN"
+                       else f'pod="{svc_name}-0",service="{svc_name}"')
+                add_series(m_idx, key, s,
+                           _service_family_values(name, label, rng, t,
+                                                  in_window, lat_mult, err_p,
+                                                  is_target))
         else:
-            base = np.abs(rng.normal(rng.uniform(1, 100), 5, nt))
-            if label.is_anomaly and label.anomaly_level == "performance":
-                if ("disk" in name and "disk" in label.anomaly_type) or \
-                   ("network" in name and "network" in label.anomaly_type):
-                    base = np.where(in_window, base * lat_mult, base)
-            add_series(m_idx, 'instance="host"', -1, base)
+            add_series(m_idx, 'instance="host"', -1,
+                       _host_family_values(name, label, rng, t, in_window,
+                                           lat_mult))
 
-    svc_names = tuple(services)
     return MetricBatch(
         metric=np.concatenate(metric_col),
         series=np.concatenate(series_col),
@@ -633,7 +859,7 @@ def generate_metrics(label: FaultLabel, duration_s: int = 1800, step_s: int = 15
         metric_names=tuple(names),
         series_keys=tuple(series_keys),
         series_service=np.array(series_service, np.int32),
-        services=svc_names,
+        services=tuple(services),
     )
 
 

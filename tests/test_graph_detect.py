@@ -83,7 +83,7 @@ def test_api_and_coverage_features_populated():
     exp = synth.generate_experiment("Lv_C_exception_injection", n_traces=60)
     services = exp.spans.services
     x = detect.extract_features(exp, services).x
-    assert x.shape[1] == len(detect.FEATURES) == 10
+    assert x.shape[1] == len(detect.FEATURES) == 13
     assert x[:, 8].max() > 0          # api latency attributed to some service
     assert x[:, 9].max() > 0          # coverage ratios present
 
