@@ -87,8 +87,14 @@ def _materialize_sn(exp: Experiment, label, root: Path) -> None:
             for r in rows:
                 t = dt.datetime.fromtimestamp(float(m.t_s[r]))
                 f.write(f"{t},{m.value[r]},\"{m.series_keys[int(m.series[r])]}\"\n")
+    # window line follows the reference's app-start discovery + clamp
+    # semantics (metric_collector.py:480-525) — pod start = first sample
+    from anomod.metrics_catalog import experiment_window, fmt_window
+    w0, w1 = experiment_window([float(m.t_s.min())] if m.n_samples else None,
+                               float(m.t_s.max()) if m.n_samples else 0.0)
     (mdir / "metadata.txt").write_text(
-        f"experiment: {exp.name}\nqueries: {len(m.metric_names)}\nstep: 15s\n")
+        f"experiment: {exp.name}\nqueries: {len(m.metric_names)}\n"
+        f"step: 15s\nwindow: {fmt_window(w0, w1)}\n")
 
     # logs: <Service>_<ts>.log + summary.txt (collect_log.sh:113-137 shape)
     ldir = root / "log_data" / f"{base}_logs_{ts2}"

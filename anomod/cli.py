@@ -109,6 +109,24 @@ def main(argv=None) -> int:
     p_replay.add_argument("--traces", type=int, default=2000)
     p_replay.add_argument("--replicate", type=int, default=1)
 
+    p_q = sub.add_parser(
+        "quality", help="de-saturated quality sweep: degradation curves over "
+        "fault severity with noise + confounders (HardMode)")
+    p_q.add_argument("--testbed", choices=["SN", "TT"], default="TT")
+    p_q.add_argument("--models", nargs="*",
+                     default=["zscore", "gcn", "gat", "sage", "temporal",
+                              "lru", "transformer", "moe"])
+    p_q.add_argument("--severities", nargs="*", type=float,
+                     default=[1.0, 0.4, 0.2, 0.1, 0.05])
+    p_q.add_argument("--train-seeds", type=int, default=6)
+    p_q.add_argument("--eval-seeds", type=int, default=3)
+    p_q.add_argument("--traces", type=int, default=60)
+    p_q.add_argument("--epochs", type=int, default=120)
+    p_q.add_argument("--noise", type=float, default=0.5)
+    p_q.add_argument("--confounders", type=int, default=2)
+    p_q.add_argument("--json", action="store_true",
+                     help="emit one JSON object per sweep point")
+
     args = parser.parse_args(argv)
 
     if args.cmd == "list":
@@ -154,6 +172,24 @@ def main(argv=None) -> int:
                 "top3": r.ranked_services[:3],
                 "target": r.target_service} for r in s.results},
         }, indent=2))
+        return 0
+
+    if args.cmd == "quality":
+        import dataclasses as _dc
+
+        from anomod.quality import render_markdown, severity_sweep
+        pts = severity_sweep(
+            testbed=args.testbed, model_names=args.models,
+            severities=args.severities,
+            train_seeds=range(args.train_seeds),
+            eval_seeds=range(100, 100 + args.eval_seeds),
+            n_traces=args.traces, epochs=args.epochs, noise=args.noise,
+            n_confounders=args.confounders, verbose=not args.json)
+        if args.json:
+            for p in pts:
+                print(json.dumps(_dc.asdict(p)))
+        else:
+            print(render_markdown(pts))
         return 0
 
     if args.cmd == "rca":

@@ -50,10 +50,26 @@ def _windowed_features(batch, services, cfg: ReplayConfig) -> np.ndarray:
     ], axis=-1).astype(np.float32)
 
 
+def _pick_confounders(label, services: Tuple[str, ...], seed: int,
+                      n: int) -> Tuple[str, ...]:
+    """Deterministic decoy services for one (label, seed): never the culprit."""
+    cands = [s for s in services if s != label.target_service]
+    rng = np.random.default_rng(synth._seed_for(label.experiment, 13) + seed)
+    return tuple(rng.choice(cands, size=min(n, len(cands)), replace=False))
+
+
 def build_dataset(testbed: str, seeds: Sequence[int], n_traces: int = 80,
-                  n_windows: int = 8) -> Tuple[List[RCASample], Tuple[str, ...]]:
+                  n_windows: int = 8,
+                  hard: Optional["synth.HardMode"] = None,
+                  n_confounders: int = 0) -> Tuple[List[RCASample], Tuple[str, ...]]:
     """One sample per (fault label, seed), features relative to the same-seed
-    normal baseline."""
+    normal baseline.
+
+    ``hard`` applies HardMode difficulty (severity/noise) to the FAULT
+    experiments; the normal baseline stays easy (it is the healthy profile).
+    ``n_confounders`` > 0 additionally plants that many per-(label, seed)
+    decoy services into each fault experiment.
+    """
     svc_list = synth.SN_SERVICES if testbed == "SN" else synth.TT_SERVICES
     services = tuple(svc_list)
     cfg = ReplayConfig(n_services=len(services), n_windows=n_windows,
@@ -69,11 +85,16 @@ def build_dataset(testbed: str, seeds: Sequence[int], n_traces: int = 80,
         base_x = detect.extract_features(normal, services).x
         base_t = _windowed_features(normal.spans, services, cfg)
         for label in labels_mod.labels_for_testbed(testbed):
+            mode = hard or synth.HardMode()
+            if n_confounders and label.is_anomaly:
+                mode = dataclasses.replace(
+                    mode, confounders=_pick_confounders(
+                        label, services, seed, n_confounders))
             # process-stable per-(seed, experiment) stream: Python's hash() is
             # salted per interpreter, which would make every build_dataset
             # call produce different corpora across processes
             exp = synth.generate_experiment(
-                label, n_traces=n_traces,
+                label, n_traces=n_traces, hard=mode,
                 seed=seed * 1000 + synth._seed_for(label.experiment) % 997)
             x = detect.extract_features(exp, services).x - base_x
             x_t = _windowed_features(exp.spans, services, cfg) - base_t
@@ -122,6 +143,52 @@ def _apply_model(model_name: str, model, params, batch):
             x_full, batch["adj"])
     return jax.vmap(lambda x, s, d, m: model.apply(params, x, s, d, m))(
         batch["x"], batch["edge_src"], batch["edge_dst"], batch["edge_mask"])
+
+
+def init_params(model_name: str, model, sample0: Dict[str, np.ndarray], rng):
+    """Per-model-family parameter init (single source for train_rca, the
+    distributed train steps, and the quality sweep)."""
+    if model_name == "gcn":
+        return model.init(rng, sample0["x"], sample0["adj"])
+    if model_name in ("temporal", "lru", "transformer", "moe"):
+        W = sample0["x_t"].shape[1]
+        fused = np.concatenate(
+            [sample0["x_t"],
+             np.repeat(sample0["x"][:, None, :], W, axis=1)], axis=-1)
+        return model.init(rng, fused, sample0["adj"])
+    return model.init(rng, sample0["x"], sample0["edge_src"],
+                      sample0["edge_dst"], sample0["edge_mask"])
+
+
+def standardize_features(train: Dict[str, np.ndarray],
+                         evals: Sequence[Dict[str, np.ndarray]]) -> None:
+    """Standardize x/x_t on train statistics, in place (shared with eval)."""
+    for key in ("x", "x_t"):
+        axes = tuple(range(train[key].ndim - 1))  # all but the feature axis
+        mu = train[key].mean(axis=axes, keepdims=True)
+        sd = train[key].std(axis=axes, keepdims=True) + 1e-6
+        train[key] = (train[key] - mu) / sd
+        for ev in evals:
+            ev[key] = (ev[key] - mu) / sd
+
+
+def topk_eval(scores: np.ndarray,
+              batch: Dict[str, np.ndarray]) -> Tuple[float, float, float, int]:
+    """(top1, top3, detection_auc, n_rca) from [B, S] scores vs labels.
+    AUC is rank-based (max score as the experiment-level statistic)."""
+    tgt = batch["target"]
+    rca_mask = tgt >= 0
+    order = np.argsort(-scores, axis=-1)
+    rank = np.array([np.where(order[i] == tgt[i])[0][0] if rca_mask[i] else -1
+                     for i in range(len(tgt))])
+    top1 = float((rank[rca_mask] == 0).mean()) if rca_mask.any() else 0.0
+    top3 = float((rank[rca_mask] < 3).mean()) if rca_mask.any() else 0.0
+    det = scores.max(axis=-1)
+    y = batch["is_anomaly"]
+    pos, neg = det[y > 0], det[y == 0]
+    auc = float((pos[:, None] > neg[None, :]).mean()) \
+        if len(neg) and len(pos) else 1.0
+    return top1, top3, auc, int(rca_mask.sum())
 
 
 def rca_loss(scores, batch):
@@ -185,28 +252,12 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
     train = _stack([s for s in train_samples])
     evalb = _stack(eval_samples)
 
-    # standardize features on train statistics (shared with eval)
-    for key in ("x", "x_t"):
-        axes = tuple(range(train[key].ndim - 1))  # all but the feature axis
-        mu = train[key].mean(axis=axes, keepdims=True)
-        sd = train[key].std(axis=axes, keepdims=True) + 1e-6
-        train[key] = (train[key] - mu) / sd
-        evalb[key] = (evalb[key] - mu) / sd
+    standardize_features(train, [evalb])
 
     model = make_model(model_name)
     rng = jax.random.PRNGKey(0)
     sample0 = {k: v[0] for k, v in train.items()}
-    if model_name == "gcn":
-        params = model.init(rng, sample0["x"], sample0["adj"])
-    elif model_name in ("temporal", "lru", "transformer", "moe"):
-        W = sample0["x_t"].shape[1]
-        fused = np.concatenate(
-            [sample0["x_t"],
-             np.repeat(sample0["x"][:, None, :], W, axis=1)], axis=-1)
-        params = model.init(rng, fused, sample0["adj"])
-    else:
-        params = model.init(rng, sample0["x"], sample0["edge_src"],
-                            sample0["edge_dst"], sample0["edge_mask"])
+    params = init_params(model_name, model, sample0, rng)
 
     tx = optax.adamw(lr, weight_decay=1e-4)
     opt_state = tx.init(params)
@@ -230,18 +281,6 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
     # eval
     scores = np.asarray(_apply_model(model_name, model, params,
                                      {k: jnp.asarray(v) for k, v in evalb.items()}))
-    tgt = evalb["target"]
-    rca_mask = tgt >= 0
-    order = np.argsort(-scores, axis=-1)
-    rank = np.array([np.where(order[i] == tgt[i])[0][0] if rca_mask[i] else -1
-                     for i in range(len(tgt))])
-    top1 = float((rank[rca_mask] == 0).mean()) if rca_mask.any() else 0.0
-    top3 = float((rank[rca_mask] < 3).mean()) if rca_mask.any() else 0.0
-    # detection AUC (rank-based)
-    det = scores.max(axis=-1)
-    y = evalb["is_anomaly"]
-    pos, neg = det[y > 0], det[y == 0]
-    auc = float((pos[:, None] > neg[None, :]).mean()) if len(neg) else 1.0
+    top1, top3, auc, n_eval = topk_eval(scores, evalb)
     return TrainResult(model_name=model_name, top1=top1, top3=top3,
-                       detection_auc=auc, n_eval=int(rca_mask.sum()),
-                       params=params)
+                       detection_auc=auc, n_eval=n_eval, params=params)

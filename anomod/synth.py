@@ -25,6 +25,7 @@ injection for service/code faults, matching the reference's sanity thresholds
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import hashlib
 import json
@@ -237,30 +238,69 @@ def build_templates(testbed: str, n_templates: int = 24, max_depth: int = 5,
     return templates
 
 
+@dataclasses.dataclass(frozen=True)
+class HardMode:
+    """Difficulty knobs for de-saturated evaluation corpora.
+
+    The full-strength fault effects (6-20x latency, 0.5-0.7 error rates) make
+    every detector score 1.0; these knobs produce the regimes where models
+    actually separate:
+
+    - ``severity`` interpolates every fault effect toward baseline
+      (0.05 => ~1.25x latency / ~2.5% error on a service fault — the
+      1.2-2x / 2-5% operating band).
+    - ``noise`` widens the baseline distributions (log-latency sigma scales
+      by 1+noise, baseline error jitter grows), shrinking the fault SNR.
+    - ``confounders`` names decoy services that also degrade (fixed mild
+      1.5x latency / 2% errors in the same anomaly window, independent of
+      severity) — the ranking must still put the labeled culprit first.
+    """
+    severity: float = 1.0
+    noise: float = 0.0
+    confounders: Tuple[str, ...] = ()
+
+
+_EASY = HardMode()
+
+# Fixed confounder effect (NOT scaled by severity: decoys stay at this level
+# while the true fault shrinks, so low severity is genuinely confusable).
+_CONFOUND_LAT, _CONFOUND_ERR = 1.5, 0.02
+
+
+def scale_mult(mult: float, severity: float) -> float:
+    """Interpolate a fault multiplier toward 1.0 (works for <1 drops too)."""
+    return 1.0 + (mult - 1.0) * severity
+
+
 # Per-(level,type) effect multipliers applied to the target service.
-def _fault_effects(label: FaultLabel) -> Tuple[float, float]:
-    """Return (latency_multiplier, error_probability) for the culprit service."""
+def _fault_effects(label: FaultLabel,
+                   severity: float = 1.0) -> Tuple[float, float]:
+    """Return (latency_multiplier, error_probability) for the culprit
+    service, interpolated toward baseline by ``severity``."""
     if not label.is_anomaly:
         return 1.0, 0.002
     lvl, typ = label.anomaly_level, label.anomaly_type
     if lvl == "performance":
-        return {"cpu_contention": 6.0, "disk_io_stress": 4.0,
-                "network_loss": 8.0}.get(typ, 5.0), 0.02
-    if lvl == "service":
-        return ({"kill_service_instance": 2.0, "http_abort": 1.5,
-                 "dns_failure": 3.0}.get(typ, 2.0),
-                {"http_abort": 0.7, "kill_service_instance": 0.5,
-                 "dns_failure": 0.6}.get(typ, 0.5))
-    if lvl == "database":
-        return {"transaction_timeout": 20.0, "connection_pool_exhaustion": 12.0,
-                "cache_limit": 5.0}.get(typ, 8.0), 0.10
-    # code-level: immediate failure responses / exceptions
-    return 1.2, 0.6
+        lat, err = {"cpu_contention": 6.0, "disk_io_stress": 4.0,
+                    "network_loss": 8.0}.get(typ, 5.0), 0.02
+    elif lvl == "service":
+        lat, err = ({"kill_service_instance": 2.0, "http_abort": 1.5,
+                     "dns_failure": 3.0}.get(typ, 2.0),
+                    {"http_abort": 0.7, "kill_service_instance": 0.5,
+                     "dns_failure": 0.6}.get(typ, 0.5))
+    elif lvl == "database":
+        lat, err = {"transaction_timeout": 20.0,
+                    "connection_pool_exhaustion": 12.0,
+                    "cache_limit": 5.0}.get(typ, 8.0), 0.10
+    else:  # code-level: immediate failure responses / exceptions
+        lat, err = 1.2, 0.6
+    return scale_mult(lat, severity), 0.002 + (err - 0.002) * severity
 
 
 def generate_spans(label: FaultLabel, n_traces: int = 200,
                    seed: Optional[int] = None,
-                   base_time_us: int = 1_762_180_000_000_000) -> SpanBatch:
+                   base_time_us: int = 1_762_180_000_000_000,
+                   hard: HardMode = _EASY) -> SpanBatch:
     """Generate a fault-conditioned SpanBatch for one experiment."""
     services, _, _ = _topology(label.testbed)
     if n_traces <= 0:
@@ -274,7 +314,9 @@ def generate_spans(label: FaultLabel, n_traces: int = 200,
     templates = build_templates(label.testbed, seed=_seed_for(label.testbed, 11))
     rng = np.random.default_rng(seed)
 
-    lat_mult, err_p = _fault_effects(label)
+    lat_mult, err_p = _fault_effects(label, hard.severity)
+    sigma = 0.4 * (1.0 + hard.noise)
+    decoy_set = frozenset(hard.confounders)
     target = label.target_service
     target_idx = services.index(target) if target in services else -1
     # SN host-level performance faults hit every service.
@@ -336,9 +378,15 @@ def generate_spans(label: FaultLabel, n_traces: int = 200,
                    else (svc == target_idx))  # (L,)
         active = label.is_anomaly & (tw[:, None] & culprit[None, :])  # (m, L)
         mult = np.where(active, lat_mult, 1.0)
-        dur_ms = rng.lognormal(mean=np.log(base_ms[svc][None, :] * mult),
-                               sigma=0.4, size=(m, L))
         err_prob = np.where(active, err_p, 0.005 if label.is_anomaly else 0.002)
+        if decoy_set:
+            # confounders degrade mildly in the same window (HardMode)
+            decoy = np.array([services[s] in decoy_set for s in svc])  # (L,)
+            decoy_active = (tw[:, None] & decoy[None, :]) & ~active
+            mult = np.where(decoy_active, _CONFOUND_LAT, mult)
+            err_prob = np.where(decoy_active, _CONFOUND_ERR, err_prob)
+        dur_ms = rng.lognormal(mean=np.log(base_ms[svc][None, :] * mult),
+                               sigma=sigma, size=(m, L))
         errors = rng.random((m, L)) < err_prob
         # Entry spans of parents of failed spans also error (propagation).
         prop = errors.copy()
@@ -545,7 +593,7 @@ from anomod.metrics_catalog import (  # noqa: E402
 
 
 def _host_family_values(name: str, label: FaultLabel, rng, t, in_window,
-                        lat_mult: float) -> np.ndarray:
+                        lat_mult: float, sev: float = 1.0) -> np.ndarray:
     """One host-scoped series for an SN/TT metric family, fault-conditioned.
 
     Shapes follow the reference's sanity thresholds where it states them
@@ -566,7 +614,8 @@ def _host_family_values(name: str, label: FaultLabel, rng, t, in_window,
     if name in ("system_cpu_usage",):
         base = gauge(rng.uniform(15, 35), 3)
         if anomaly and typ == "cpu_contention":
-            base = np.where(in_window, rng.uniform(91, 99, nt), base)
+            spike = rng.uniform(91, 99, nt)
+            base = np.where(in_window, base + (spike - base) * sev, base)
         return np.clip(base, 0, 100)
     if name == "node_cpu_seconds_total":
         # counter: cumulative busy seconds; slope rises under CPU faults
@@ -577,7 +626,7 @@ def _host_family_values(name: str, label: FaultLabel, rng, t, in_window,
     if name in ("system_load1", "node_load5"):
         base = np.abs(gauge(rng.uniform(0.5, 2.0), 0.3))
         if anomaly and typ == "cpu_contention":
-            base = np.where(in_window, base * 5.0, base)
+            base = np.where(in_window, base * scale_mult(5.0, sev), base)
         return base
     if name == "system_memory_usage_percent":
         return np.clip(gauge(rng.uniform(35, 60), 2), 0, 100)
@@ -586,7 +635,7 @@ def _host_family_values(name: str, label: FaultLabel, rng, t, in_window,
     if name in ("node_memory_MemAvailable_bytes", "node_memory_MemFree_bytes"):
         base = gauge(rng.uniform(6e9, 9e9), 2e8)
         if anomaly and typ == "cache_limit":  # memory stress on the DB host
-            base = np.where(in_window, base * 0.4, base)
+            base = np.where(in_window, base * scale_mult(0.4, sev), base)
         return np.clip(base, 1e8, None)
     if name in ("system_disk_io_time", "node_disk_io_time_seconds_total",
                 "system_disk_read_bytes", "system_disk_write_bytes",
@@ -600,7 +649,7 @@ def _host_family_values(name: str, label: FaultLabel, rng, t, in_window,
     if name in ("node_filesystem_size_bytes",):
         return np.full(nt, 200.0e9)
     if name == "node_filesystem_avail_bytes":
-        drain = 1e5 if not (anomaly and lvl == "database") else 5e6
+        drain = 1e5 if not (anomaly and lvl == "database") else 1e5 + 4.9e6 * sev
         return 80.0e9 - np.cumsum(np.full(nt, drain)) + rng.normal(0, 1e6, nt)
     if name == "volume_manager_total_volumes":
         return np.full(nt, float(rng.integers(20, 40)))
@@ -609,7 +658,8 @@ def _host_family_values(name: str, label: FaultLabel, rng, t, in_window,
                 "node_network_transmit_bytes_total"):
         base = np.abs(gauge(rng.uniform(1e6, 5e6), 2e5))
         if anomaly and typ == "network_loss":
-            base = np.where(in_window, base * 0.3, base)  # lost throughput
+            # lost throughput
+            base = np.where(in_window, base * scale_mult(0.3, sev), base)
         return base
     if name in ("system_network_errors", "node_network_receive_drop_total",
                 "node_network_transmit_drop_total",
@@ -617,7 +667,8 @@ def _host_family_values(name: str, label: FaultLabel, rng, t, in_window,
                 "node_network_transmit_errs_total"):
         base = np.abs(gauge(1.0, 0.5))
         if anomaly and typ in ("network_loss", "dns_failure"):
-            base = np.where(in_window, base + rng.uniform(50, 200, nt), base)
+            base = np.where(in_window, base + rng.uniform(50, 200, nt) * sev,
+                            base)
         return base
     if name == "jaeger_spans_rate":
         base = np.abs(gauge(rng.uniform(100, 300), 20))
@@ -658,7 +709,8 @@ SN_STORE_FILES: Dict[str, Tuple[str, ...]] = {
 
 
 def _store_family_values(name: str, label: FaultLabel, rng, t, in_window,
-                         lat_mult: float, is_target: bool) -> np.ndarray:
+                         lat_mult: float, is_target: bool,
+                         sev: float = 1.0) -> np.ndarray:
     """One per-store-instance series (owner-service attributed)."""
     nt = t.shape[0]
     anomaly = label.is_anomaly and is_target
@@ -673,18 +725,19 @@ def _store_family_values(name: str, label: FaultLabel, rng, t, in_window,
     if name == "redis_memory_used":
         base = rng.uniform(4e7, 6e7) + rng.normal(0, 1e6, nt)
         if anomaly and typ == "cache_limit":
-            base = np.where(in_window, base * 0.3, base)  # README.md:106
+            # README.md:106 plateau drop
+            base = np.where(in_window, base * scale_mult(0.3, sev), base)
         return base
     # redis_command_rate
     base = np.abs(rng.uniform(200, 500) + rng.normal(0, 30, nt))
     if anomaly and typ == "cache_limit":
-        base = np.where(in_window, base * 0.5, base)
+        base = np.where(in_window, base * scale_mult(0.5, sev), base)
     return base
 
 
 def _service_family_values(name: str, label: FaultLabel, rng, t, in_window,
                            lat_mult: float, err_p: float,
-                           is_target: bool) -> np.ndarray:
+                           is_target: bool, sev: float = 1.0) -> np.ndarray:
     """One per-service series, fault-conditioned on the culprit service."""
     nt = t.shape[0]
     anomaly = label.is_anomaly and is_target
@@ -696,22 +749,23 @@ def _service_family_values(name: str, label: FaultLabel, rng, t, in_window,
     if name == "up":
         v = np.ones(nt)
         if anomaly and typ == "kill_service_instance":
-            v = np.where(in_window & (rng.random(nt) < 0.5), 0.0, v)
+            v = np.where(in_window & (rng.random(nt) < 0.5 * sev), 0.0, v)
         return v
     if name == "kube_pod_status_phase":
         v = np.ones(nt)  # 1 == Running
         if anomaly and typ == "kill_service_instance":
-            v = np.where(in_window & (rng.random(nt) < 0.5), 0.0, v)
+            v = np.where(in_window & (rng.random(nt) < 0.5 * sev), 0.0, v)
         return v
     if name == "kube_pod_container_status_restarts_total":
         if anomaly and typ == "kill_service_instance":
             # Schedule+PodChaos kills every 3 s (Lv_S_KILLPOD_*.yaml:15-22)
-            return np.cumsum(in_window * rng.poisson(2.0, nt)).astype(float)
+            return np.cumsum(in_window * rng.poisson(2.0 * sev, nt)).astype(float)
         return np.zeros(nt)
     if name in ("microservice_request_rate", "http_requests_total"):
         rate = np.abs(gauge(rng.uniform(20, 80), 5))
         if anomaly and typ in ("kill_service_instance", "dns_failure"):
-            rate = np.where(in_window, rate * 0.2, rate)  # requests not arriving
+            # requests not arriving
+            rate = np.where(in_window, rate * scale_mult(0.2, sev), rate)
         if name == "http_requests_total":
             return np.cumsum(rate)  # counter
         return rate
@@ -735,20 +789,20 @@ def _service_family_values(name: str, label: FaultLabel, rng, t, in_window,
     if name == "container_cpu_cfs_throttled_periods_total":
         rate = np.zeros(nt)
         if anomaly and typ == "cpu_contention":
-            rate = in_window * rng.poisson(5.0, nt).astype(float)
+            rate = in_window * rng.poisson(5.0 * sev, nt).astype(float)
         return np.cumsum(rate)
     if name in ("socialnet_container_memory", "container_memory_usage_bytes",
                 "container_memory_working_set_bytes",
                 "process_resident_memory_bytes"):
         base = np.abs(gauge(rng.uniform(2e8, 8e8), 2e7))
         if anomaly and typ == "cache_limit":
-            base = np.where(in_window, base * 1.8, base)
+            base = np.where(in_window, base * scale_mult(1.8, sev), base)
         return base
     if name == "container_spec_memory_limit_bytes":
         return np.full(nt, 2.0e9)
     if name == "container_memory_failcnt":
         if anomaly and typ == "cache_limit":
-            return np.cumsum(in_window * rng.poisson(1.0, nt)).astype(float)
+            return np.cumsum(in_window * rng.poisson(1.0 * sev, nt)).astype(float)
         return np.zeros(nt)
     if name in ("socialnet_container_network_receive",
                 "socialnet_container_network_transmit",
@@ -756,18 +810,19 @@ def _service_family_values(name: str, label: FaultLabel, rng, t, in_window,
                 "container_network_transmit_bytes_total"):
         base = np.abs(gauge(rng.uniform(1e5, 1e6), 5e4))
         if anomaly and typ in ("network_loss", "http_abort"):
-            base = np.where(in_window, base * 0.3, base)
+            base = np.where(in_window, base * scale_mult(0.3, sev), base)
         return base
     if name in ("container_network_receive_errors_total",
                 "container_network_transmit_errors_total"):
         base = np.abs(gauge(0.5, 0.3))
         if anomaly and typ in ("network_loss", "dns_failure"):
-            base = np.where(in_window, base + rng.uniform(20, 80, nt), base)
+            base = np.where(in_window, base + rng.uniform(20, 80, nt) * sev,
+                            base)
         return base
     if name == "process_open_fds":
         base = np.abs(gauge(rng.uniform(50, 150), 10))
         if anomaly and typ == "connection_pool_exhaustion":
-            base = np.where(in_window, base * 8.0, base)
+            base = np.where(in_window, base * scale_mult(8.0, sev), base)
         return base
     if name == "process_max_fds":
         return np.full(nt, 1024.0)
@@ -775,7 +830,7 @@ def _service_family_values(name: str, label: FaultLabel, rng, t, in_window,
         return np.abs(gauge(rng.uniform(10, 40), 1))
     if name == "kubelet_volume_stats_used_bytes":
         drain = 5e4 if not (anomaly and label.anomaly_level == "database") \
-            else 5e6
+            else 5e4 + (5e6 - 5e4) * sev
         return 1.0e9 + np.cumsum(np.full(nt, drain)) + rng.normal(0, 1e5, nt)
     # generic per-service level with target inflation
     base = np.abs(gauge(10 * rng.uniform(0.5, 2.0), 2))
@@ -786,7 +841,8 @@ def _service_family_values(name: str, label: FaultLabel, rng, t, in_window,
 
 def generate_metrics(label: FaultLabel, duration_s: int = 1800, step_s: int = 15,
                      seed: Optional[int] = None,
-                     base_time_s: float = 1.7621800e9) -> MetricBatch:
+                     base_time_s: float = 1.7621800e9,
+                     hard: HardMode = _EASY) -> MetricBatch:
     """Fault-conditioned metric samples at the reference's 15 s step
     (collect_metric.sh:4-5), over the COMPLETE reference catalogs: all 24 SN
     per-query families (collect_metric.sh:20-125) and all TT level-group +
@@ -804,7 +860,8 @@ def generate_metrics(label: FaultLabel, duration_s: int = 1800, step_s: int = 15
         per_service = frozenset(TT_PER_SERVICE_METRICS)
     t = np.arange(0, duration_s, step_s, dtype=np.float64) + base_time_s
     nt = t.shape[0]
-    lat_mult, err_p = _fault_effects(label)
+    sev = hard.severity
+    lat_mult, err_p = _fault_effects(label, sev)
 
     metric_col, series_col, t_col, v_col = [], [], [], []
     series_keys: List[str] = []
@@ -835,7 +892,7 @@ def generate_metrics(label: FaultLabel, duration_s: int = 1800, step_s: int = 15
                 add_series(m_idx, f'instance="{svc_name}-{store}"', s,
                            _store_family_values(name, label, rng, t,
                                                 in_window, lat_mult,
-                                                is_target))
+                                                is_target, sev))
         elif name in per_service:
             for s, svc_name in enumerate(services):
                 is_target = label.is_anomaly and (
@@ -845,11 +902,11 @@ def generate_metrics(label: FaultLabel, duration_s: int = 1800, step_s: int = 15
                 add_series(m_idx, key, s,
                            _service_family_values(name, label, rng, t,
                                                   in_window, lat_mult, err_p,
-                                                  is_target))
+                                                  is_target, sev))
         else:
             add_series(m_idx, 'instance="host"', -1,
                        _host_family_values(name, label, rng, t, in_window,
-                                           lat_mult))
+                                           lat_mult, sev))
 
     return MetricBatch(
         metric=np.concatenate(metric_col),
@@ -869,7 +926,8 @@ def generate_metrics(label: FaultLabel, duration_s: int = 1800, step_s: int = 15
 
 def generate_logs(label: FaultLabel, lines_per_service: int = 400,
                   seed: Optional[int] = None,
-                  base_time_s: float = 1.7621800e9) -> Tuple[LogBatch, List[LogSummary]]:
+                  base_time_s: float = 1.7621800e9,
+                  hard: HardMode = _EASY) -> Tuple[LogBatch, List[LogSummary]]:
     if seed is None:
         seed = _seed_for(label.experiment, 3)
     rng = np.random.default_rng(seed)
@@ -877,13 +935,17 @@ def generate_logs(label: FaultLabel, lines_per_service: int = 400,
     svc_col, t_col, lvl_col = [], [], []
     summaries = []
     host_level = label.is_anomaly and label.target_service not in services
+    sev = hard.severity
+    p_culprit = 0.01 + ((0.35 if not host_level else 0.12) - 0.01) * sev
     for s, svc in enumerate(services):
         n = int(lines_per_service * rng.uniform(0.5, 2.0))
         tt = base_time_s + np.sort(rng.uniform(0, 1800, n))
         culprit = label.is_anomaly and (host_level or label.target_service == svc)
         # elevated error rate only inside the shared anomaly window [600,1200)s
         in_window = (tt - base_time_s >= 600) & (tt - base_time_s < 1200)
-        p_err = np.where(culprit & in_window, 0.35 if not host_level else 0.12, 0.01)
+        p_err = np.where(culprit & in_window, p_culprit, 0.01)
+        if svc in hard.confounders and not culprit:
+            p_err = np.where(in_window, 0.03, p_err)
         r = rng.random(n)
         lvl = np.where(r < p_err, LOG_ERROR,
                        np.where(r < p_err + 0.05, LOG_WARN, LOG_INFO)).astype(np.int8)
@@ -904,7 +966,8 @@ def generate_logs(label: FaultLabel, lines_per_service: int = 400,
 
 def generate_api(label: FaultLabel, n_records: int = 600,
                  seed: Optional[int] = None,
-                 base_time_s: float = 1.7621800e9) -> ApiBatch:
+                 base_time_s: float = 1.7621800e9,
+                 hard: HardMode = _EASY) -> ApiBatch:
     if seed is None:
         seed = _seed_for(label.experiment, 4)
     rng = np.random.default_rng(seed)
@@ -913,10 +976,11 @@ def generate_api(label: FaultLabel, n_records: int = 600,
     else:
         eps = tuple(f"/api/v1/{s.replace('ts-', '').replace('-service', '')}service"
                     for s in TT_SERVICES[:20])
-    lat_mult, err_p = _fault_effects(label)
+    lat_mult, err_p = _fault_effects(label, hard.severity)
     ep = rng.integers(0, len(eps), n_records).astype(np.int32)
     t = base_time_s + np.sort(rng.uniform(0, 1800, n_records))
-    lat = rng.lognormal(np.log(40.0), 0.5, n_records).astype(np.float32)
+    lat = rng.lognormal(np.log(40.0), 0.5 * (1.0 + hard.noise),
+                        n_records).astype(np.float32)
     status = np.full(n_records, 200, np.int16)
     if label.is_anomaly:
         # endpoints routed through the culprit service bear the brunt; a
@@ -951,7 +1015,8 @@ def _file_coverage_base(svc: str, i: int) -> Tuple[int, float]:
 
 
 def generate_coverage(label: FaultLabel, files_per_service: int = 6,
-                      seed: Optional[int] = None) -> CoverageBatch:
+                      seed: Optional[int] = None,
+                      hard: HardMode = _EASY) -> CoverageBatch:
     if seed is None:
         seed = _seed_for(label.experiment, 5)
     rng = np.random.default_rng(seed)
@@ -963,7 +1028,7 @@ def generate_coverage(label: FaultLabel, files_per_service: int = 6,
             ratio = base_ratio + float(rng.uniform(-0.02, 0.02))  # run jitter
             if label.is_anomaly and label.target_service == svc:
                 # injected faults shift executed paths on the culprit
-                ratio = max(0.05, ratio - 0.15)
+                ratio = max(0.05, ratio - 0.15 * hard.severity)
             ext = "cpp" if label.testbed == "SN" else "java"
             files.append(FileCoverage(
                 service=svc, path=f"src/{svc}/file_{i}.{ext}",
@@ -972,22 +1037,30 @@ def generate_coverage(label: FaultLabel, files_per_service: int = 6,
 
 
 def generate_experiment(label_or_name, n_traces: int = 200,
-                        seed: Optional[int] = None) -> Experiment:
-    """Generate a full five-modality experiment bundle."""
+                        seed: Optional[int] = None,
+                        hard: HardMode = _EASY) -> Experiment:
+    """Generate a full five-modality experiment bundle.
+
+    ``hard`` tunes corpus difficulty (severity / noise / confounders) for
+    de-saturated evaluation — see :class:`HardMode`.  Confounders degrade
+    spans and logs only: a decoy slowdown plausibly moves latency and log
+    errors but not kube-state counters, so the metric modality is the
+    disambiguating evidence, as it would be for a real operator.
+    """
     if isinstance(label_or_name, str):
         label = labels_mod.label_for(label_or_name)
         if label is None:
             raise KeyError(f"unknown experiment: {label_or_name}")
     else:
         label = label_or_name
-    logs, summaries = generate_logs(label, seed=seed)
+    logs, summaries = generate_logs(label, seed=seed, hard=hard)
     return Experiment(
         name=label.experiment, testbed=label.testbed,
-        spans=generate_spans(label, n_traces=n_traces, seed=seed),
-        metrics=generate_metrics(label, seed=seed),
+        spans=generate_spans(label, n_traces=n_traces, seed=seed, hard=hard),
+        metrics=generate_metrics(label, seed=seed, hard=hard),
         logs=logs, log_summaries=summaries,
-        api=generate_api(label, seed=seed),
-        coverage=generate_coverage(label, seed=seed),
+        api=generate_api(label, seed=seed, hard=hard),
+        coverage=generate_coverage(label, seed=seed, hard=hard),
         synthetic=True,
     )
 

@@ -169,3 +169,54 @@ def test_pallas_hll_kernel_interpret():
     np.testing.assert_array_equal(out, ref)
     est = hll_estimate(out)
     assert abs(est - 8192) / 8192 < 0.1
+
+
+def test_pallas_tdigest_matches_numpy_oracle():
+    """Pallas build kernel (interpret on CPU mesh) == numpy tdigest_build."""
+    from anomod.ops.pallas_tdigest import tdigest_build_pallas
+    from anomod.ops.tdigest import tdigest_build, tdigest_quantile
+    rng = np.random.default_rng(0)
+    vals = rng.lognormal(3.0, 1.0, size=(5, 256)).astype(np.float32)
+    ref = tdigest_build(vals, k=32)
+    out = tdigest_build_pallas(vals, k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out.weight), ref.weight, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.mean), ref.mean,
+                               rtol=1e-4, atol=1e-4)
+    # quantiles through the shared query path agree too
+    for q in (0.5, 0.9, 0.99):
+        np.testing.assert_allclose(
+            tdigest_quantile(
+                type(ref)(np.asarray(out.mean), np.asarray(out.weight)), q),
+            tdigest_quantile(ref, q), rtol=1e-4)
+
+
+def test_pallas_tdigest_merge_matches_numpy():
+    from anomod.ops.pallas_tdigest import (tdigest_build_pallas,
+                                           tdigest_merge_pallas)
+    from anomod.ops.tdigest import TDigest, tdigest_build, tdigest_merge
+    rng = np.random.default_rng(1)
+    a_vals = rng.normal(10, 2, size=(3, 128)).astype(np.float32)
+    b_vals = rng.normal(14, 3, size=(3, 128)).astype(np.float32)
+    ref = tdigest_merge(tdigest_build(a_vals, k=32),
+                        tdigest_build(b_vals, k=32))
+    pa = tdigest_build_pallas(a_vals, k=32, interpret=True)
+    pb = tdigest_build_pallas(b_vals, k=32, interpret=True)
+    out = tdigest_merge_pallas(pa, pb, interpret=True)
+    np.testing.assert_allclose(np.asarray(out.weight), ref.weight, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.mean), ref.mean,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_pallas_tdigest_weighted_and_padded():
+    """Zero-weight padding slots must not disturb the digest."""
+    from anomod.ops.pallas_tdigest import tdigest_build_pallas
+    from anomod.ops.tdigest import tdigest_build
+    rng = np.random.default_rng(2)
+    vals = rng.uniform(0, 100, size=(2, 64)).astype(np.float32)
+    w = np.ones_like(vals)
+    w[:, 48:] = 0.0  # padding tail
+    ref = tdigest_build(vals, k=16, weights=w)
+    out = tdigest_build_pallas(vals, k=16, weights=w, interpret=True)
+    np.testing.assert_allclose(np.asarray(out.weight), ref.weight, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.mean), ref.mean,
+                               rtol=1e-4, atol=1e-4)
