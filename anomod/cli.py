@@ -97,6 +97,9 @@ def main(argv=None) -> int:
                        help="experiment name to inject during the capture")
     p_mon.add_argument("--out", default=None,
                        help="materialize the api_responses artifact family")
+    p_mon.add_argument("--wrk2-requests", type=int, default=0,
+                       help="interleave N wrk2 mixed-workload requests "
+                            "(full compose content model) with the capture")
 
     p_logscan = sub.add_parser(
         "logscan", help="per-file log summary sweep over a directory "
@@ -316,7 +319,8 @@ def main(argv=None) -> int:
         from anomod.monitor import capture_openapi_responses
         report = capture_openapi_responses(
             args.out, mode=args.mode, cycles=args.cycles,
-            seed=args.seed, chaos=args.chaos)
+            seed=args.seed, chaos=args.chaos,
+            wrk2_requests=args.wrk2_requests)
         b = report.batch
         print(json.dumps({
             "mode": report.mode, "cycles": report.n_cycles,

@@ -34,8 +34,11 @@ import json
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from anomod.scenario import RequestSpec, SyntheticGateway
 from anomod.schemas import ApiBatch
+from anomod.workload import sample_wrk2_request
 
 # The 12 SN gateway endpoints (enhanced_openapi_monitor.py:36-49) with their
 # owning services (docker-compose-gcov.yml service set) and the method rule
@@ -75,8 +78,44 @@ def synthesize_body(path: str, seq: int) -> Optional[dict]:
     return None
 
 
-def _spec(method: str, path: str, owner: str) -> RequestSpec:
-    return RequestSpec(method, path, path, flow="monitor", owner=owner)
+def _form_encode(body: Optional[dict]) -> Optional[str]:
+    """Flat ``k=v&k=v`` encoding of a synthesized probe body (the monitor
+    sends form/JSON payloads; the gateway records the encoded length)."""
+    if not body:
+        return None
+    return "&".join(f"{k}={v}" for k, v in body.items())
+
+
+def _spec(method: str, path: str, owner: str,
+          body: Optional[str] = None) -> RequestSpec:
+    return RequestSpec(method, path, path, flow="monitor", owner=owner,
+                       body=body)
+
+
+SN_OWNER_BY_TEMPLATE = {path: owner for _, path, owner in (
+    ("POST", "/wrk2-api/post/compose", "compose-post-service"),
+    ("GET", "/wrk2-api/home-timeline/read", "home-timeline-service"),
+    ("GET", "/wrk2-api/user-timeline/read", "user-timeline-service"),
+)}
+
+
+def run_wrk2_workload(gateway: SyntheticGateway, n_requests: int,
+                      seed: int = 0) -> List[int]:
+    """Drive ``n_requests`` wrk2 mixed-workload requests (60/30/10 mix with
+    the full compose content model, mixed-workload.lua:111-125) through the
+    gateway.  In the reference the wrk2 generator runs concurrently with the
+    monitor against the same SUT (collect_all_data.sh:319-346); here both
+    share one gateway so the captured batch interleaves probe and workload
+    traffic with the workload's method/content-length distributions."""
+    rng = np.random.default_rng(seed)
+    statuses: List[int] = []
+    for _ in range(n_requests):
+        req = sample_wrk2_request(rng)
+        owner = SN_OWNER_BY_TEMPLATE[req.template]
+        spec = RequestSpec(req.method, req.path, req.template,
+                           flow="wrk2", owner=owner, body=req.body)
+        statuses += gateway.execute([spec])
+    return statuses
 
 
 @dataclasses.dataclass
@@ -132,9 +171,10 @@ class ActiveMonitor:
         return out
 
     def cycle(self) -> List[int]:
-        self.bodies()     # advance the request-id sequence like the reference
-        specs = [_spec(method, path, owner)
-                 for method, path, owner in self.endpoints]
+        bodies = self.bodies()    # advances the request-id sequence
+        specs = [_spec(method, path, owner, body=_form_encode(body))
+                 for (method, path, owner), body
+                 in zip(self.endpoints, bodies)]
         return self._gw.execute(specs)
 
     def run(self, cycles: int = 10) -> MonitorReport:
@@ -160,9 +200,12 @@ class PassiveMonitor(ActiveMonitor):
 def capture_openapi_responses(out_dir: Optional[Path] = None,
                               mode: str = "active", cycles: int = 10,
                               seed: int = 0,
-                              chaos: Optional[str] = None) -> MonitorReport:
+                              chaos: Optional[str] = None,
+                              wrk2_requests: int = 0) -> MonitorReport:
     """Orchestrate a monitoring capture (collect_openapi_response.sh:60-143):
-    optionally inject a fault, run the monitor, tear down (even on failure,
+    optionally inject a fault, run the monitor (with ``wrk2_requests`` of
+    concurrent mixed-workload traffic through the same gateway, the
+    reference's monitor-plus-wrk2 arrangement), tear down (even on failure,
     like the reference's traps), and — when ``out_dir`` is given —
     materialize the full api_responses artifact family + collection report."""
     controller = None
@@ -172,7 +215,10 @@ def capture_openapi_responses(out_dir: Optional[Path] = None,
         controller.create(chaos)
     try:
         cls = ActiveMonitor if mode == "active" else PassiveMonitor
-        report = cls(seed=seed, controller=controller).run(cycles)
+        monitor = cls(seed=seed, controller=controller)
+        if wrk2_requests:
+            run_wrk2_workload(monitor._gw, wrk2_requests, seed=seed)
+        report = monitor.run(cycles)
     finally:
         if controller is not None:
             controller.destroy_all()

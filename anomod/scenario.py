@@ -100,6 +100,7 @@ class RequestSpec:
     template: str        # canonical path template (stable endpoint vocab)
     flow: str = ""       # which scenario flow emitted it
     owner: str = ""      # explicit owning service (SN specs); "" = TT route
+    body: Optional[str] = None   # synthesized request body (wrk2 model)
 
     @property
     def service(self) -> str:
@@ -424,8 +425,17 @@ class SyntheticGateway:
             if fail:
                 status = 503 if err_p >= 0.5 else 500
             self._t += lat / 1e3 + float(self._rng.exponential(0.05))
-            self._rows.append((s.endpoint, self._t, status, lat,
-                               0 if fail else int(self._rng.integers(64, 2048))))
+            # content_length records the dominant byte flow of the exchange:
+            # the synthesized request body for POSTs that carry one (so the
+            # artifact histogram reflects the wrk2 content model), else the
+            # synthetic response payload.
+            if fail:
+                nbytes = 0
+            elif s.body is not None:
+                nbytes = len(s.body)
+            else:
+                nbytes = int(self._rng.integers(64, 2048))
+            self._rows.append((s.endpoint, self._t, status, lat, nbytes))
             statuses.append(status)
         return statuses
 

@@ -999,6 +999,14 @@ def generate_api(label: FaultLabel, n_records: int = 600,
         lat = np.where(affected, lat * lat_mult, lat).astype(np.float32)
         status = np.where(affected & (rng.random(n_records) < err_p), 500, status)
     clen = rng.integers(64, 4096, n_records).astype(np.int32)
+    if label.testbed == "SN":
+        # compose-post records carry the wrk2 content model's body-length
+        # distribution (mixed-workload.lua:33-83) instead of the generic
+        # response-size draw.
+        from anomod.workload import sample_compose_lengths
+        compose = np.array(["post/compose" in e for e in eps])[ep]
+        if compose.any():
+            clen[compose] = sample_compose_lengths(rng, int(compose.sum()))
     return ApiBatch(endpoint=ep, t_s=t, status=status.astype(np.int16),
                     latency_ms=lat, content_length=clen, endpoints=eps)
 

@@ -1,0 +1,145 @@
+"""wrk2 content-model tests — the synthesized request distributions must
+match the reference workload's parameters (mixed-workload.lua:33-125):
+60/30/10 endpoint mix, 256-char base text, 1-6 mentions / 1-6 urls / 1-5
+media (Lua's inclusive `for i = 0, n` runs n+1 times), 64-char urls,
+18-digit media ids, user ids below 962."""
+
+import re
+
+import numpy as np
+import pytest
+
+from anomod.monitor import ActiveMonitor, capture_openapi_responses, \
+    run_wrk2_workload
+from anomod.scenario import SyntheticGateway
+from anomod.workload import (WRK2_MAX_USER_INDEX, WRK2_MEDIA_RANGE,
+                             WRK2_MENTION_RANGE, WRK2_TEXT_LEN,
+                             WRK2_URL_LEN, WRK2_URL_RANGE,
+                             compose_length_bounds, compose_post_body,
+                             sample_compose_lengths, sample_wrk2_request,
+                             timeline_query)
+
+N = 400
+
+
+def _bodies(seed=0, n=N):
+    rng = np.random.default_rng(seed)
+    return [compose_post_body(rng) for _ in range(n)]
+
+
+def test_compose_body_field_layout():
+    body = _bodies(n=1)[0]
+    fields = dict(p.split("=", 1) for p in body.split("&"))
+    assert set(fields) == {"username", "user_id", "text", "media_ids",
+                           "media_types", "post_type"}
+    assert fields["post_type"] == "0"
+    assert fields["username"] == f"username_{fields['user_id']}"
+    assert int(fields["user_id"]) < WRK2_MAX_USER_INDEX
+    # media_types is the bracketed "png" list (mixed-workload.lua:61-66)
+    assert re.fullmatch(r'\[("png",)*"png"\]', fields["media_types"])
+    assert re.fullmatch(r'\[("\d{18}",)*"\d{18}"\]', fields["media_ids"])
+
+
+def test_compose_content_distributions_match_lua_parameters():
+    mentions, urls, media, sizes = [], [], [], []
+    for body in _bodies():
+        text = dict(p.split("=", 1) for p in body.split("&"))["text"]
+        m = len(re.findall(r" @username_\d+", text))
+        u = len(re.findall(r" http://[0-9A-Za-z]+", text))
+        k = body.count('"png"')
+        base = (len(text) - sum(len(s) for s in
+                                re.findall(r" @username_\d+", text))
+                - u * (8 + WRK2_URL_LEN))
+        assert base == WRK2_TEXT_LEN
+        assert WRK2_MENTION_RANGE[0] <= m <= WRK2_MENTION_RANGE[1]
+        assert WRK2_URL_RANGE[0] <= u <= WRK2_URL_RANGE[1]
+        assert WRK2_MEDIA_RANGE[0] <= k <= WRK2_MEDIA_RANGE[1]
+        mentions.append(m)
+        urls.append(u)
+        media.append(k)
+        sizes.append(len(body))
+    # uniform-count means: mentions/urls 3.5, media 3
+    assert np.mean(mentions) == pytest.approx(3.5, abs=0.3)
+    assert np.mean(urls) == pytest.approx(3.5, abs=0.3)
+    assert np.mean(media) == pytest.approx(3.0, abs=0.3)
+    lo, hi = compose_length_bounds()
+    assert min(sizes) >= lo and max(sizes) <= hi
+
+
+def test_vectorized_lengths_match_string_model():
+    sizes = np.array([len(b) for b in _bodies(seed=1, n=600)])
+    fast = sample_compose_lengths(np.random.default_rng(2), 600)
+    lo, hi = compose_length_bounds()
+    assert fast.min() >= lo and fast.max() <= hi
+    # same distribution: means within a couple of url-lengths
+    assert abs(float(sizes.mean()) - float(fast.mean())) < 30
+    assert abs(float(sizes.std()) - float(fast.std())) < 30
+
+
+def test_request_mix_and_timeline_args():
+    rng = np.random.default_rng(3)
+    reqs = [sample_wrk2_request(rng) for _ in range(2000)]
+    frac = {t: sum(r.template == t for r in reqs) / len(reqs)
+            for t in {r.template for r in reqs}}
+    assert frac["/wrk2-api/home-timeline/read"] == pytest.approx(0.60, abs=0.05)
+    assert frac["/wrk2-api/user-timeline/read"] == pytest.approx(0.30, abs=0.05)
+    assert frac["/wrk2-api/post/compose"] == pytest.approx(0.10, abs=0.03)
+    for r in reqs:
+        if r.method == "GET":
+            assert r.body is None and r.content_length == 0
+            q = dict(p.split("=") for p in r.path.split("?")[1].split("&"))
+            assert int(q["stop"]) == int(q["start"]) + 10
+            assert int(q["user_id"]) < WRK2_MAX_USER_INDEX
+        else:
+            assert r.content_length == len(r.body)
+    assert timeline_query(np.random.default_rng(0)) == \
+        timeline_query(np.random.default_rng(0))
+
+
+def test_gateway_records_wrk2_content_lengths():
+    gw = SyntheticGateway(seed=0)
+    run_wrk2_workload(gw, 300, seed=4)
+    batch = gw.to_api_batch()
+    eps = list(batch.endpoints)
+    compose_idx = eps.index("POST /wrk2-api/post/compose")
+    mask = (batch.endpoint == compose_idx) & (batch.status == 200)
+    assert mask.sum() > 10
+    lo, hi = compose_length_bounds()
+    clen = batch.content_length[mask]
+    assert clen.min() >= lo and clen.max() <= hi
+    # GET reads keep the synthetic response-size draw (< 2048 bytes)
+    get_mask = (batch.endpoint != compose_idx) & (batch.status == 200)
+    assert batch.content_length[get_mask].max() < 2048
+
+
+def test_capture_interleaves_wrk2_traffic(tmp_path):
+    report = capture_openapi_responses(out_dir=tmp_path, cycles=2,
+                                      wrk2_requests=50)
+    # 50 workload requests + 12 pre-check + 2*12 monitor probes
+    assert report.batch.n_records == 50 + 12 + 2 * 12
+    assert (tmp_path / "openapi_responses.jsonl").exists()
+
+
+def test_monitor_post_probes_carry_encoded_bodies():
+    report = ActiveMonitor(seed=0).run(cycles=1)
+    batch = report.batch
+    eps = list(batch.endpoints)
+    reg = eps.index("POST /wrk2-api/user/register")
+    mask = (batch.endpoint == reg) & (batch.status == 200)
+    if mask.any():
+        # register body ~ "first_name=Test&...": deterministic small length
+        assert 60 < batch.content_length[mask].max() < 140
+
+
+def test_synth_api_compose_lengths():
+    from anomod.labels import labels_for_testbed
+    from anomod.synth import generate_api
+    label = labels_for_testbed("SN")[0]
+    batch = generate_api(label, n_records=800)
+    compose = [i for i, e in enumerate(batch.endpoints)
+               if "post/compose" in e]
+    assert len(compose) == 1
+    mask = batch.endpoint == compose[0]
+    lo, hi = compose_length_bounds()
+    clen = batch.content_length[mask]
+    assert clen.min() >= lo and clen.max() <= hi
