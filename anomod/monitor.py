@@ -100,14 +100,14 @@ SN_OWNER_BY_TEMPLATE = {path: owner for _, path, owner in (
 
 
 def run_wrk2_workload(gateway: SyntheticGateway, n_requests: int,
-                      seed: int = 0) -> List[int]:
+                      seed: int = 0,
+                      rng: Optional[np.random.Generator] = None) -> List[int]:
     """Drive ``n_requests`` wrk2 mixed-workload requests (60/30/10 mix with
     the full compose content model, mixed-workload.lua:111-125) through the
-    gateway.  In the reference the wrk2 generator runs concurrently with the
-    monitor against the same SUT (collect_all_data.sh:319-346); here both
-    share one gateway so the captured batch interleaves probe and workload
-    traffic with the workload's method/content-length distributions."""
-    rng = np.random.default_rng(seed)
+    gateway.  Pass ``rng`` to continue one workload stream across several
+    calls (the capture orchestrator drives a chunk between monitor cycles)."""
+    if rng is None:
+        rng = np.random.default_rng(seed)
     statuses: List[int] = []
     for _ in range(n_requests):
         req = sample_wrk2_request(rng)
@@ -217,8 +217,25 @@ def capture_openapi_responses(out_dir: Optional[Path] = None,
         cls = ActiveMonitor if mode == "active" else PassiveMonitor
         monitor = cls(seed=seed, controller=controller)
         if wrk2_requests:
-            run_wrk2_workload(monitor._gw, wrk2_requests, seed=seed)
-        report = monitor.run(cycles)
+            # interleave the workload with the probe cycles — the
+            # reference's monitor-plus-wrk2 concurrency (collect_all_data.sh
+            # :319-346) rendered as a deterministic round-robin: a chunk of
+            # workload traffic lands on the shared gateway before every
+            # monitor cycle, so artifact timestamps mix the two flows.
+            connectivity = monitor.connectivity_check()
+            wrk2_rng = np.random.default_rng(seed)
+            per = wrk2_requests // max(cycles, 1)
+            extra = wrk2_requests - per * max(cycles, 1)
+            for c in range(max(cycles, 1)):
+                run_wrk2_workload(monitor._gw,
+                                  per + (extra if c == 0 else 0),
+                                  rng=wrk2_rng)
+                if c < cycles:
+                    monitor.cycle()
+            report = MonitorReport(monitor._gw.to_api_batch(), connectivity,
+                                   cycles, monitor.mode)
+        else:
+            report = monitor.run(cycles)
     finally:
         if controller is not None:
             controller.destroy_all()

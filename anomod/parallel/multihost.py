@@ -8,9 +8,10 @@ on the CPU test mesh drive ICI collectives within a slice and DCN transfers
 across, which is the whole point of keeping the replay/train paths expressed
 as shardings + psum instead of explicit sends.
 
-This module is exercised single-process in CI (n_processes=1 falls through to
-local meshes); the multi-process path follows JAX's standard contract and is
-validated by the driver's virtual-device dry run.
+The multi-process path is exercised for real by ``tests/test_multihost.py``,
+which launches two coordinator-connected CPU processes (4 virtual devices
+each), builds the hybrid (dcn=2, data=4) mesh, and runs psum + HLL
+register-merge collectives across the process boundary.
 """
 
 from __future__ import annotations
@@ -53,3 +54,21 @@ def dcn_data_parallel_spec(mesh):
     gradient/state psums then reduce over ICI first, DCN once per host."""
     from jax.sharding import PartitionSpec as P
     return P(tuple(mesh.axis_names))
+
+
+def process_local_array(mesh, spec, local):
+    """Assemble a global sharded array from this process's local shard
+    (each host stages only its slice of the corpus into its own devices;
+    the mesh makes it one logical array)."""
+    import jax
+    from jax.sharding import NamedSharding
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local)
+
+
+def replicated_value(out):
+    """Host value of a fully-replicated global array.  Multi-process global
+    arrays are not fully addressable, so plain ``np.asarray`` raises; every
+    process holds a replica, so the first addressable shard is the value."""
+    import numpy as np
+    return np.asarray(out.addressable_data(0))

@@ -54,6 +54,27 @@ WRK2_TIMELINE_START_MAX = 100   # :85 math.random(0, 100)
 _MENTION_PREFIX = " @username_"  # :52
 _URL_PREFIX = " http://"         # :56
 
+# Byte-length decomposition shared by the analytic bounds and the vectorized
+# sampler (kept in one place so they can't drift from compose_post_body).
+_FORM_OVERHEAD = len("username=username_&user_id=&text=&media_ids="
+                     "&media_types=&post_type=0")
+_PNG_LEN = len('"png"')
+
+
+def _media_lists_len(k):
+    """len(media_ids) + len(media_types) for ``k`` media entries: each is
+    '[' + k quoted items + (k-1) commas + ']'.  Works elementwise on numpy
+    arrays."""
+    return (2 + k * (WRK2_MEDIA_ID_LEN + 2) + (k - 1)) \
+        + (2 + k * _PNG_LEN + (k - 1))
+
+
+def _text_len(m, mention_digits, u):
+    """len(text): base + mentions + urls; elementwise-safe."""
+    return (WRK2_TEXT_LEN
+            + m * len(_MENTION_PREFIX) + mention_digits
+            + u * (len(_URL_PREFIX) + WRK2_URL_LEN))
+
 
 def _rand_string(rng: np.random.Generator, length: int,
                  charset: str = WRK2_CHARSET) -> str:
@@ -129,16 +150,9 @@ def compose_length_bounds() -> Tuple[int, int]:
     """Analytic (min, max) compose-body length implied by the lua
     parameters — used by tests and the synthetic generator to validate
     sampled content-length histograms."""
-    fixed = len("username=username_&user_id=&text=&media_ids="
-                "&media_types=&post_type=0")
-
     def total(idx_d: int, m: int, mention_d: int, u: int, k: int) -> int:
-        text = (WRK2_TEXT_LEN
-                + m * (len(_MENTION_PREFIX) + mention_d)
-                + u * (len(_URL_PREFIX) + WRK2_URL_LEN))
-        media = (2 + k * (WRK2_MEDIA_ID_LEN + 2) + (k - 1)) \
-            + (2 + k * len('"png"') + (k - 1))
-        return fixed + 2 * idx_d + text + media
+        return (_FORM_OVERHEAD + 2 * idx_d
+                + _text_len(m, m * mention_d, u) + _media_lists_len(k))
 
     lo = total(1, WRK2_MENTION_RANGE[0], 1, WRK2_URL_RANGE[0],
                WRK2_MEDIA_RANGE[0])
@@ -151,8 +165,6 @@ def sample_compose_lengths(rng: np.random.Generator, n: int) -> np.ndarray:
     """Vectorized draw of ``n`` compose content-lengths from the analytic
     length decomposition (same distribution as ``len(compose_post_body)``
     without string materialization — used for bulk synthesis)."""
-    fixed = len("username=username_&user_id=&text=&media_ids="
-                "&media_types=&post_type=0")
     idx = rng.integers(0, WRK2_MAX_USER_INDEX, n)
     idx_d = np.char.str_len(idx.astype(str))
     m = rng.integers(WRK2_MENTION_RANGE[0], WRK2_MENTION_RANGE[1] + 1, n)
@@ -161,13 +173,12 @@ def sample_compose_lengths(rng: np.random.Generator, n: int) -> np.ndarray:
                                (n, WRK2_MENTION_RANGE[1]))
     mention_d = np.char.str_len(mention_ids.astype(str))
     mask = np.arange(WRK2_MENTION_RANGE[1])[None, :] < m[:, None]
-    mention_len = ((len(_MENTION_PREFIX) + mention_d) * mask).sum(axis=1)
+    mention_digits = (mention_d * mask).sum(axis=1)
     u = rng.integers(WRK2_URL_RANGE[0], WRK2_URL_RANGE[1] + 1, n)
     k = rng.integers(WRK2_MEDIA_RANGE[0], WRK2_MEDIA_RANGE[1] + 1, n)
-    text = WRK2_TEXT_LEN + mention_len + u * (len(_URL_PREFIX) + WRK2_URL_LEN)
-    media = (2 + k * (WRK2_MEDIA_ID_LEN + 2) + (k - 1)) \
-        + (2 + k * 5 + (k - 1))
-    return (fixed + 2 * idx_d + text + media).astype(np.int32)
+    return (_FORM_OVERHEAD + 2 * idx_d
+            + _text_len(m, mention_digits, u)
+            + _media_lists_len(k)).astype(np.int32)
 
 
 def resolve_location(location_header: str, expected_template: str) -> str:

@@ -113,11 +113,24 @@ def test_gateway_records_wrk2_content_lengths():
 
 
 def test_capture_interleaves_wrk2_traffic(tmp_path):
+    from anomod.workload import compose_length_bounds
     report = capture_openapi_responses(out_dir=tmp_path, cycles=2,
                                       wrk2_requests=50)
     # 50 workload requests + 12 pre-check + 2*12 monitor probes
-    assert report.batch.n_records == 50 + 12 + 2 * 12
+    batch = report.batch
+    assert batch.n_records == 50 + 12 + 2 * 12
     assert (tmp_path / "openapi_responses.jsonl").exists()
+    # genuinely interleaved: wrk2 compose records (compose endpoint with a
+    # full-body content length — the monitor's own compose probe bodies are
+    # ~100 bytes, far below the wrk2 band) must appear both before and
+    # after the first monitor cycle, not as one initial burst
+    lo, _ = compose_length_bounds()
+    compose_idx = list(batch.endpoints).index("POST /wrk2-api/post/compose")
+    wrk2_pos = np.flatnonzero((batch.endpoint == compose_idx)
+                              & (batch.content_length >= lo))
+    assert wrk2_pos.size > 0
+    first_block_end = 12 + 25 + 12   # pre-check + chunk 1 + cycle 1
+    assert wrk2_pos.min() < first_block_end < wrk2_pos.max()
 
 
 def test_monitor_post_probes_carry_encoded_bodies():
