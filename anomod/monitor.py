@@ -177,9 +177,14 @@ class ActiveMonitor:
                  in zip(self.endpoints, bodies)]
         return self._gw.execute(specs)
 
-    def run(self, cycles: int = 10) -> MonitorReport:
+    def run(self, cycles: int = 10, before_cycle=None) -> MonitorReport:
+        """Pre-check + probe cycles.  ``before_cycle(i)`` (when given) runs
+        ahead of each cycle — the capture orchestrator uses it to land a
+        chunk of wrk2 workload traffic on the shared gateway."""
         connectivity = self.connectivity_check()
-        for _ in range(cycles):
+        for c in range(cycles):
+            if before_cycle is not None:
+                before_cycle(c)
             self.cycle()
         return MonitorReport(self._gw.to_api_batch(), connectivity,
                              cycles, self.mode)
@@ -216,26 +221,24 @@ def capture_openapi_responses(out_dir: Optional[Path] = None,
     try:
         cls = ActiveMonitor if mode == "active" else PassiveMonitor
         monitor = cls(seed=seed, controller=controller)
+        before_cycle = None
         if wrk2_requests:
             # interleave the workload with the probe cycles — the
             # reference's monitor-plus-wrk2 concurrency (collect_all_data.sh
             # :319-346) rendered as a deterministic round-robin: a chunk of
             # workload traffic lands on the shared gateway before every
             # monitor cycle, so artifact timestamps mix the two flows.
-            connectivity = monitor.connectivity_check()
             wrk2_rng = np.random.default_rng(seed)
             per = wrk2_requests // max(cycles, 1)
             extra = wrk2_requests - per * max(cycles, 1)
-            for c in range(max(cycles, 1)):
+
+            def before_cycle(c):
                 run_wrk2_workload(monitor._gw,
                                   per + (extra if c == 0 else 0),
                                   rng=wrk2_rng)
-                if c < cycles:
-                    monitor.cycle()
-            report = MonitorReport(monitor._gw.to_api_batch(), connectivity,
-                                   cycles, monitor.mode)
-        else:
-            report = monitor.run(cycles)
+            if cycles == 0:     # workload-only capture
+                before_cycle(0)
+        report = monitor.run(cycles, before_cycle=before_cycle)
     finally:
         if controller is not None:
             controller.destroy_all()

@@ -203,31 +203,73 @@ def percentile_from_hist(hist: np.ndarray, q: float) -> np.ndarray:
     return idx.astype(np.float32)  # bucket index ≈ log1p(duration_us)
 
 
+def stage_pallas_planes(chunks_np) -> Tuple[np.ndarray, np.ndarray]:
+    """Flatten staged chunk columns into the fused pallas kernel's layout:
+    sid [N] plus the feature-major [6, N] plane stack (anomod.ops.
+    pallas_replay.PLANES order; dur² is materialized host-side once so the
+    kernel reads every plane in its natural layout)."""
+    sid = chunks_np["sid"].reshape(-1)
+    dur = chunks_np["dur"].reshape(-1)
+    planes = np.stack([
+        chunks_np["valid"].reshape(-1),
+        chunks_np["err"].reshape(-1),
+        chunks_np["s5"].reshape(-1),
+        chunks_np["dur_raw"].reshape(-1),
+        dur,
+        dur * dur,
+    ])
+    return sid, planes
+
+
 @dataclasses.dataclass
 class ThroughputResult:
     n_spans: int
     wall_s: float
     spans_per_sec: float
     compile_s: float
+    kernel: str = "xla"
 
 
 def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
-                       repeats: int = 3, replicate: int = 1) -> ThroughputResult:
+                       repeats: int = 3, replicate: int = 1,
+                       kernel: str = "xla") -> ThroughputResult:
     """Compile, warm up, then time the replay over the staged corpus.
 
     Timing reads the aggregate state back to host each iteration — over a
     tunneled device, ``block_until_ready`` alone returns before execution
     finishes, so a host read-back is the only honest barrier.  ``replicate``
-    replays the staged chunks that many times *on device* (inner fori_loop)
-    to amortize the fixed dispatch/RPC overhead into a steady-state number
-    without inflating the host arrays or the HBM working set.
+    replays the staged chunks that many times *on device* (inner fori_loop /
+    outer grid dimension) to amortize the fixed dispatch/RPC overhead into a
+    steady-state number without inflating the host arrays or the HBM
+    working set.  ``kernel`` selects the aggregation path: "xla" (scan +
+    one-hot matmuls) or "pallas" (the fused anomod.ops.pallas_replay
+    kernel).
     """
     import jax
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(f"unknown replay kernel {kernel!r} "
+                         "(expected 'xla' or 'pallas')")
     cfg = cfg or ReplayConfig(n_services=len(batch.services))
     chunks_np, n = stage_columns(batch, cfg)
     n *= replicate
-    chunks = jax.device_put(chunks_np)
-    fn = make_replay_fn(cfg, inner_repeats=replicate)
+    if kernel == "pallas":
+        from anomod.ops.pallas_replay import make_pallas_replay_fn
+        sid_np, planes_np = stage_pallas_planes(chunks_np)
+        sid, planes = jax.device_put(sid_np), jax.device_put(planes_np)
+        # off-TPU backends can't execute Mosaic — run the kernel's
+        # interpret path so this branch stays testable on the CPU mesh
+        interpret = jax.devices()[0].platform != "tpu"
+        pfn = make_pallas_replay_fn(cfg.sw, cfg.n_hist_buckets,
+                                    inner_repeats=replicate,
+                                    block=min(4096, cfg.chunk_size),
+                                    interpret=interpret)
+        def fn(_):
+            agg = pfn(sid, planes)
+            return ReplayState(agg=agg[:, :N_FEATS], hist=agg[:, N_FEATS:])
+        chunks = None
+    else:
+        chunks = jax.device_put(chunks_np)
+        fn = make_replay_fn(cfg, inner_repeats=replicate)
     t0 = time.perf_counter()
     np.asarray(fn(chunks).agg)
     compile_s = time.perf_counter() - t0
@@ -244,4 +286,5 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
         f"span count mismatch: {total} != {n}"
     wall = sorted(times)[len(times) // 2]
     return ThroughputResult(n_spans=n, wall_s=wall,
-                            spans_per_sec=n / wall, compile_s=compile_s)
+                            spans_per_sec=n / wall, compile_s=compile_s,
+                            kernel=kernel)

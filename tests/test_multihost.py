@@ -25,7 +25,9 @@ def test_two_process_hybrid_mesh_collectives():
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    env["PYTHONPATH"] = str(_WORKER.parent.parent)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(_WORKER.parent.parent)]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
     procs = [subprocess.Popen(
         [sys.executable, str(_WORKER), str(pid), "2", str(port)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)

@@ -120,15 +120,53 @@ def test_pallas_replay_kernel_interpret():
     from anomod.ops.pallas_replay import (make_pallas_replay_fn,
                                           pallas_replay_numpy)
     rng = np.random.default_rng(7)
-    n, S, F, H, B = 2048, 93, 6, 16, 256
+    n, S, H, B = 2048, 93, 16, 256
     sid = rng.integers(0, S + 1, n).astype(np.int32)
-    feats = rng.random((F, n)).astype(np.float32)
-    feats[0] = (sid < S).astype(np.float32)
-    bucket = rng.integers(0, H, n).astype(np.int32)
-    ref = pallas_replay_numpy(sid, feats, bucket, S, F, H)
-    fn = make_pallas_replay_fn(S, F, H, block=B, interpret=True)
-    out = np.asarray(fn(sid, feats, bucket))
-    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+    # planes: valid / err / s5 exact 0/1, then dur_raw / dur / dur^2
+    valid = (sid < S).astype(np.float32)
+    err = (rng.random(n) < 0.1).astype(np.float32) * valid
+    s5 = (rng.random(n) < 0.05).astype(np.float32) * valid
+    dur_raw = rng.lognormal(10.0, 1.0, n).astype(np.float32)
+    dur = np.log1p(dur_raw)
+    planes = np.stack([valid, err, s5, dur_raw, dur, dur * dur])
+    ref = pallas_replay_numpy(sid, planes, S, H)
+    fn = make_pallas_replay_fn(S, H, block=B, interpret=True)
+    out = np.asarray(fn(sid, planes))
+    # 0/1 planes and histogram are bf16-exact; moments carry the hi/lo
+    # split's ~1.5e-5 relative error (same bound as the XLA path)
+    np.testing.assert_allclose(out[:, :3], ref[:, :3], rtol=0, atol=0)
+    np.testing.assert_allclose(out[:, 6:], ref[:, 6:], rtol=0, atol=0)
+    np.testing.assert_allclose(out[:, 3:6], ref[:, 3:6], rtol=1e-3)
+
+
+def test_pallas_replay_matches_xla_replay_path():
+    """Kernel parity with the staged-column oracle, plus the full
+    measure_throughput(kernel='pallas') branch (which auto-selects the
+    interpret path on non-TPU backends) against a real synthetic corpus."""
+    from anomod.ops.pallas_replay import make_pallas_replay_fn
+    from anomod.replay import (ReplayConfig, measure_throughput,
+                               replay_numpy, stage_columns,
+                               stage_pallas_planes)
+    from anomod.labels import labels_for_testbed
+    from anomod.synth import generate_spans
+    import pytest
+    label = labels_for_testbed("TT")[1]
+    batch = generate_spans(label, n_traces=40)
+    cfg = ReplayConfig(n_services=len(batch.services), chunk_size=2048)
+    chunks, _ = stage_columns(batch, cfg)
+    sid, planes = stage_pallas_planes(chunks)
+    fn = make_pallas_replay_fn(cfg.sw, cfg.n_hist_buckets, block=256,
+                               interpret=True)
+    out = np.asarray(fn(sid, planes))
+    ref = replay_numpy(chunks, cfg)
+    np.testing.assert_allclose(out[:, :6], ref.agg, rtol=2e-3, atol=1e-2)
+    np.testing.assert_allclose(out[:, 6:], ref.hist, rtol=0, atol=0)
+    # the throughput harness's pallas branch end-to-end (staging, repack,
+    # span-count sanity check) on the CPU backend's interpret path
+    res = measure_throughput(batch, cfg, repeats=1, kernel="pallas")
+    assert res.kernel == "pallas" and res.n_spans == batch.n_spans
+    with pytest.raises(ValueError, match="unknown replay kernel"):
+        measure_throughput(batch, cfg, repeats=1, kernel="fused")
 
 
 def test_tdigest_by_segment_matches_per_service_quantiles():
