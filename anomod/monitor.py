@@ -180,8 +180,12 @@ class ActiveMonitor:
     def run(self, cycles: int = 10, before_cycle=None) -> MonitorReport:
         """Pre-check + probe cycles.  ``before_cycle(i)`` (when given) runs
         ahead of each cycle — the capture orchestrator uses it to land a
-        chunk of wrk2 workload traffic on the shared gateway."""
+        chunk of wrk2 workload traffic on the shared gateway.  The
+        connectivity pre-check always runs first (even for a workload-only
+        cycles=0 capture) so the probe's RNG draws are position-stable."""
         connectivity = self.connectivity_check()
+        if cycles == 0 and before_cycle is not None:
+            before_cycle(0)
         for c in range(cycles):
             if before_cycle is not None:
                 before_cycle(c)
@@ -236,8 +240,6 @@ def capture_openapi_responses(out_dir: Optional[Path] = None,
                 run_wrk2_workload(monitor._gw,
                                   per + (extra if c == 0 else 0),
                                   rng=wrk2_rng)
-            if cycles == 0:     # workload-only capture
-                before_cycle(0)
         report = monitor.run(cycles, before_cycle=before_cycle)
     finally:
         if controller is not None:

@@ -82,9 +82,34 @@ def tdigest_merge_many(digests, xp=np) -> TDigest:
     return tdigest_build(mean, k=digests[0].capacity, weights=weight, xp=xp)
 
 
+def _fill_empty_means(mean, weight, xp):
+    """Replace empty centroids' placeholder mean (0, from _segment_mean)
+    with the nearest populated centroid's mean so CDF interpolation can
+    never land on a bogus 0.  The k1 scale leaves empty buckets interleaved
+    with populated ones whenever n < k or the distribution is peaked —
+    without this fill, a quantile whose bracketing index hits an empty
+    bucket interpolates toward 0 (observed: per-segment p99 below p50).
+    Populated means are non-decreasing (buckets of a sorted stream), so a
+    running max forward-fills and a reverse running min backfills."""
+    if xp is np:
+        cummax = lambda a: np.maximum.accumulate(a, axis=-1)
+        cummin = lambda a: np.minimum.accumulate(a, axis=-1)
+    else:
+        import jax
+        cummax = lambda a: jax.lax.cummax(a, axis=a.ndim - 1)
+        cummin = lambda a: jax.lax.cummin(a, axis=a.ndim - 1)
+    pop = weight > 0
+    ffill = cummax(xp.where(pop, mean, -xp.inf))
+    bfill = cummin(xp.where(pop, mean, xp.inf)[..., ::-1])[..., ::-1]
+    filled = xp.where(xp.isfinite(ffill), ffill, bfill)
+    # all-empty rows: keep the 0 placeholder
+    return xp.where(xp.isfinite(filled), filled, 0.0)
+
+
 def tdigest_quantile(d: TDigest, q, xp=np):
     """Approximate quantile(s) by interpolating the centroid CDF."""
     w = d.weight
+    mean = _fill_empty_means(d.mean, w, xp)
     total = xp.sum(w, axis=-1, keepdims=True)
     cum = xp.cumsum(w, axis=-1) - 0.5 * w
     qq = xp.asarray(q, dtype=d.mean.dtype)
@@ -95,8 +120,8 @@ def tdigest_quantile(d: TDigest, q, xp=np):
     idx0 = xp.clip(idx - 1, 0, d.mean.shape[-1] - 1)
     c0 = xp.take_along_axis(cum, idx0[..., None], axis=-1)[..., 0]
     c1 = xp.take_along_axis(cum, idx[..., None], axis=-1)[..., 0]
-    m0 = xp.take_along_axis(d.mean, idx0[..., None], axis=-1)[..., 0]
-    m1 = xp.take_along_axis(d.mean, idx[..., None], axis=-1)[..., 0]
+    m0 = xp.take_along_axis(mean, idx0[..., None], axis=-1)[..., 0]
+    m1 = xp.take_along_axis(mean, idx[..., None], axis=-1)[..., 0]
     t = xp.where(c1 > c0, (target - c0) / xp.where(c1 > c0, c1 - c0, 1.0), 0.0)
     t = xp.clip(t, 0.0, 1.0)
     return m0 + t * (m1 - m0)

@@ -194,13 +194,54 @@ def replay_numpy(chunks, cfg: ReplayConfig) -> ReplayState:
     return ReplayState(agg=agg, hist=hist)
 
 
-def percentile_from_hist(hist: np.ndarray, q: float) -> np.ndarray:
-    """Approx per-row percentile (in log1p-µs units) from the histogram."""
+def percentile_from_hist(hist: np.ndarray, q: float,
+                         as_us: bool = False) -> np.ndarray:
+    """Per-row percentile from the log-latency histogram, linearly
+    interpolated within the winning bucket (continuous log1p-µs value
+    instead of a bare bucket index; ``as_us`` converts back to µs).
+
+    Detection deltas only need bucket resolution, but a reported "p99"
+    should not quantize to 16 levels.  For reporting-grade accuracy use
+    :func:`replay_percentiles`, which runs the t-digest plane over the same
+    segments."""
     cum = np.cumsum(hist, axis=-1)
     total = cum[..., -1:]
-    target = q * total
-    idx = (cum < target).sum(axis=-1)
-    return idx.astype(np.float32)  # bucket index ≈ log1p(duration_us)
+    target = q * np.maximum(total, 1e-30)
+    idx = np.minimum((cum < target).sum(axis=-1), hist.shape[-1] - 1)
+    in_bucket = np.take_along_axis(hist, idx[..., None], axis=-1)[..., 0]
+    below = np.take_along_axis(np.concatenate(
+        [np.zeros_like(cum[..., :1]), cum], axis=-1),
+        idx[..., None], axis=-1)[..., 0]
+    frac = np.where(in_bucket > 0,
+                    (target[..., 0] - below) / np.maximum(in_bucket, 1e-30),
+                    0.5)
+    p = idx.astype(np.float32) + np.clip(frac, 0.0, 1.0).astype(np.float32)
+    p = np.where(total[..., 0] > 0, p, 0.0).astype(np.float32)  # empty row = 0
+    return np.expm1(p).astype(np.float32) if as_us else p
+
+
+def replay_percentiles(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
+                       qs: Tuple[float, ...] = (0.5, 0.95, 0.99),
+                       k: int = 64) -> np.ndarray:
+    """Reporting-grade per-(service, window) latency percentiles in µs:
+    the t-digest plane over the exact segments the replay aggregates.
+
+    Returns [S*W, len(qs)] float32.  The streaming digests bound quantile
+    error by centroid capacity instead of the histogram's 16-bucket
+    quantization — this wires the t-digest plane into the replay path for
+    every consumer that reports percentiles rather than detection deltas.
+    Digests are built in log1p domain (service latencies are heavy-tailed;
+    linear-domain centroids smear the p99 tail) and converted back to µs."""
+    from anomod.ops.tdigest import tdigest_by_segment, tdigest_quantile
+    cfg = cfg or ReplayConfig(n_services=len(batch.services))
+    chunks, n = stage_columns(batch, cfg)
+    sid = chunks["sid"].reshape(-1)
+    dur = chunks["dur"].reshape(-1)       # log1p(duration_us), staged
+    real = sid < cfg.sw
+    digests = tdigest_by_segment(dur[real], sid[real], cfg.sw, k=k)
+    out = np.stack([np.expm1(np.asarray(tdigest_quantile(digests, q)))
+                    for q in qs], axis=-1)
+    return out.astype(np.float32)
 
 
 def stage_pallas_planes(chunks_np) -> Tuple[np.ndarray, np.ndarray]:
@@ -259,10 +300,17 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
         # off-TPU backends can't execute Mosaic — run the kernel's
         # interpret path so this branch stays testable on the CPU mesh
         interpret = jax.devices()[0].platform != "tpu"
+        # block must divide the staged span count (a chunk_size multiple):
+        # use chunk_size's largest power-of-2 factor, capped at the
+        # VMEM-tuned 4096
+        block = min(4096, cfg.chunk_size & -cfg.chunk_size)
+        if block < 128:
+            raise ValueError(
+                "pallas replay kernel needs chunk_size with a power-of-2 "
+                f"factor >= 128; got chunk_size={cfg.chunk_size}")
         pfn = make_pallas_replay_fn(cfg.sw, cfg.n_hist_buckets,
                                     inner_repeats=replicate,
-                                    block=min(4096, cfg.chunk_size),
-                                    interpret=interpret)
+                                    block=block, interpret=interpret)
         def fn(_):
             agg = pfn(sid, planes)
             return ReplayState(agg=agg[:, :N_FEATS], hist=agg[:, N_FEATS:])

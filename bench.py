@@ -93,10 +93,13 @@ def main() -> int:
         replicate = 64 if platform != "cpu" else 2
         repeats = 3 if platform != "cpu" else 2
         # The fused pallas kernel is the fast path on TPU (3.0e8 vs 2.5e8
-        # spans/sec for the XLA scan on v5e); pallas_call doesn't execute on
-        # the CPU backend, so the fallback stays on the XLA path.
+        # spans/sec for the XLA scan on v5e).  Mosaic only executes on real
+        # TPU devices — everything else (CPU fallback, any non-TPU
+        # accelerator) must take the XLA path or measure_throughput would
+        # drop the kernel into never-finishing interpret mode.
+        on_tpu = platform != "cpu" and jax.devices()[0].platform == "tpu"
         kernel = os.environ.get("ANOMOD_BENCH_KERNEL", "").strip().lower() \
-            or ("pallas" if platform != "cpu" else "xla")
+            or ("pallas" if on_tpu else "xla")
         cfg = ReplayConfig(n_services=batch.n_services)
         result = measure_throughput(batch, cfg, repeats=repeats,
                                     replicate=replicate, kernel=kernel)

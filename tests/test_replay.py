@@ -67,6 +67,74 @@ def test_percentile_from_hist_monotone(tt_batch):
     p50 = percentile_from_hist(st.hist, 0.5)
     p99 = percentile_from_hist(st.hist, 0.99)
     assert (p99 >= p50).all()
+    # interpolated values are continuous, not bare bucket indices: occupied
+    # rows should mostly land strictly inside buckets
+    occupied = st.hist.sum(axis=-1) > 4
+    frac = p50[occupied] - np.floor(p50[occupied])
+    assert (frac > 0).mean() > 0.5
+
+
+def test_percentile_interpolation_accuracy():
+    """Interpolated histogram percentile approaches the exact log-latency
+    percentile much closer than the ±1-bucket quantization of the old
+    bucket-index form."""
+    rng = np.random.default_rng(0)
+    dur_log = np.clip(rng.lognormal(1.6, 0.35, 20_000), 0, 15.999)
+    hist = np.bincount(dur_log.astype(np.int64), minlength=16).astype(
+        np.float32)[None, :]
+    for q in (0.5, 0.9, 0.99):
+        exact = np.quantile(dur_log, q)
+        interp = float(percentile_from_hist(hist, q)[0])
+        assert abs(interp - exact) < 0.35, (q, interp, exact)
+    us = percentile_from_hist(hist, 0.99, as_us=True)
+    assert np.allclose(us, np.expm1(percentile_from_hist(hist, 0.99)))
+    # empty histogram rows report 0, not the max bucket
+    empty = np.zeros((3, 16), np.float32)
+    assert (percentile_from_hist(empty, 0.99) == 0).all()
+    assert (percentile_from_hist(empty, 0.99, as_us=True) == 0).all()
+
+
+def test_pallas_kernel_block_follows_chunk_size():
+    """The throughput harness must pick a block that divides the staged
+    span count for any power-of-2-factor chunk_size, and reject chunk
+    sizes with no usable factor."""
+    from anomod.replay import measure_throughput
+    from anomod import labels, synth
+    label = labels.labels_for_testbed("TT")[0]
+    batch = synth.generate_spans(label, n_traces=10)
+    cfg = ReplayConfig(n_services=batch.n_services, chunk_size=1536)  # 3*512
+    res = measure_throughput(batch, cfg, repeats=1, kernel="pallas")
+    assert res.n_spans == batch.n_spans
+    bad = ReplayConfig(n_services=batch.n_services, chunk_size=1000)
+    with pytest.raises(ValueError, match="power-of-2"):
+        measure_throughput(batch, bad, repeats=1, kernel="pallas")
+
+
+def test_replay_percentiles_tdigest_plane(tt_batch):
+    """replay_percentiles (t-digest over the replay segments) tracks exact
+    per-segment quantiles within the sketch's error bound."""
+    from anomod.replay import replay_percentiles
+    cfg = ReplayConfig(n_services=tt_batch.n_services, chunk_size=2048)
+    out = replay_percentiles(tt_batch, cfg, qs=(0.5, 0.99))
+    assert out.shape == (cfg.sw, 2)
+    chunks, _ = stage_columns(tt_batch, cfg)
+    sid = chunks["sid"].reshape(-1)
+    dur = chunks["dur_raw"].reshape(-1)
+    real = sid < cfg.sw
+    sid, dur = sid[real], dur[real]
+    # exact quantiles on the five most-populated segments; the p99 of a
+    # ~70-sample segment rides the top order statistics, so its µs-domain
+    # tolerance is wider than the median's
+    counts = np.bincount(sid, minlength=cfg.sw)
+    for seg in np.argsort(counts)[-5:]:
+        vals = dur[sid == seg]
+        assert abs(out[seg, 0] - np.quantile(vals, 0.5)) \
+            <= 0.08 * max(np.quantile(vals, 0.5), 1.0)
+        assert abs(out[seg, 1] - np.quantile(vals, 0.99)) \
+            <= 0.20 * max(np.quantile(vals, 0.99), 1.0)
+        # and the tail must actually be a tail (the pre-fix empty-centroid
+        # bug returned p99 below p50)
+        assert out[seg, 1] > out[seg, 0]
 
 
 def test_measure_throughput_smoke(tt_batch):

@@ -115,6 +115,23 @@ def test_hll_jax_matches_numpy():
     np.testing.assert_array_equal(np.asarray(rjl), rnl)
 
 
+def test_tdigest_quantile_skips_empty_centroids():
+    """Regression: when n < k the k1 scale interleaves empty (weight 0,
+    mean 0) buckets with populated ones; quantiles bracketing an empty
+    bucket used to interpolate toward the 0 placeholder (p99 below p50)."""
+    import jax.numpy as jnp
+    from anomod.ops.tdigest import tdigest_build, tdigest_quantile
+    rng = np.random.default_rng(0)
+    vals = np.log1p(rng.lognormal(9.8, 0.5, 70).astype(np.float32))
+    d = tdigest_build(vals, k=64)
+    for xp, dd in ((np, d), (jnp, type(d)(mean=jnp.asarray(d.mean),
+                                          weight=jnp.asarray(d.weight)))):
+        p50 = float(tdigest_quantile(dd, 0.5, xp=xp))
+        p99 = float(tdigest_quantile(dd, 0.99, xp=xp))
+        assert p99 > p50
+        assert abs(p99 - np.quantile(vals, 0.99)) < 0.05 * np.quantile(vals, 0.99)
+
+
 def test_pallas_replay_kernel_interpret():
     """Fused pallas aggregation kernel vs numpy oracle (interpret mode on CPU)."""
     from anomod.ops.pallas_replay import (make_pallas_replay_fn,
