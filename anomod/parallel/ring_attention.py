@@ -78,12 +78,11 @@ def ring_attention_local(q, k, v, axis_name: str):
     return num / den[..., None]
 
 
-def make_ring_attention(mesh, axis: str = "data"):
-    """Jitted global-array form: q/k/v [L, H, D] sharded on L over ``axis``.
-
-    L must divide evenly by the mesh axis size (pad upstream; static shapes
-    keep XLA on one compiled program).
-    """
+def make_sharded_attention(local_fn, mesh, axis: str = "data"):
+    """Shared jit/shard_map wrapper for every sequence-parallel attention
+    plane: q/k/v [L, H, D] sharded on L over ``axis``, output sharded the
+    same way, ``local_fn(q, k, v, axis_name)`` runs on the local blocks.
+    One copy so a shard_map/sharding API migration lands everywhere."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -92,8 +91,17 @@ def make_ring_attention(mesh, axis: str = "data"):
     @functools.partial(jax.jit, out_shardings=NamedSharding(mesh, spec))
     def attend(q, k, v):
         fn = jax.shard_map(
-            functools.partial(ring_attention_local, axis_name=axis),
+            functools.partial(local_fn, axis_name=axis),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)
 
     return attend
+
+
+def make_ring_attention(mesh, axis: str = "data"):
+    """Jitted global-array form: q/k/v [L, H, D] sharded on L over ``axis``.
+
+    L must divide evenly by the mesh axis size (pad upstream; static shapes
+    keep XLA on one compiled program).
+    """
+    return make_sharded_attention(ring_attention_local, mesh, axis)

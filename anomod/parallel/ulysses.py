@@ -1,0 +1,60 @@
+"""Ulysses-style all-to-all sequence parallelism — the second long-context
+plane, complementing ring attention.
+
+Where ring attention keeps the sequence sharded and rotates K/V blocks
+around the ICI ring in P ``ppermute`` steps (compute/transfer overlap,
+per-chip memory O((L/P)²)), the all-to-all layout swap redistributes
+activations exactly twice per attention call:
+
+  [L/P, H, D]  --all_to_all-->  [L, H/P, D]   (heads sharded, sequence whole)
+       ... dense per-head attention locally ...
+  [L, H/P, D]  --all_to_all-->  [L/P, H, D]
+
+Each device then runs *unmodified* dense attention over the full sequence
+for its head subset — trivially exact, two collective hops regardless of
+ring size, but it requires n_heads % P == 0 and holds full-L scores
+locally, so it suits moderate L with many heads while the ring suits
+extreme L.  Both planes ride the same (data,) mesh axis and compose with
+the dp/tp/pp/ep shardings in anomod.parallel.train.
+
+No reference counterpart (SURVEY.md §5: long-context parallelism absent
+there); the layout-swap recipe is the public DeepSpeed-Ulysses pattern on
+XLA's ``all_to_all`` instead of NCCL.
+"""
+
+from __future__ import annotations
+
+
+def ulysses_attention_local(q, k, v, axis_name: str):
+    """Exact attention via head-scatter/sequence-gather — call inside
+    shard_map.  Args are local sequence blocks [L/P, H, D]; requires
+    H % P == 0.  Returns the local output block [L/P, H, D]."""
+    from jax import lax
+
+    from anomod.parallel.ring_attention import full_attention
+
+    n = lax.psum(1, axis_name)
+    if q.shape[1] % n:
+        raise ValueError(
+            f"ulysses attention needs n_heads divisible by the mesh axis: "
+            f"{q.shape[1]} heads over {n} devices")
+
+    def seq_gather(x):      # [L/P, H, D] -> [L, H/P, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0,
+                              tiled=True)
+
+    out = full_attention(seq_gather(q), seq_gather(k), seq_gather(v))
+    # head-gather / sequence-scatter back to the resident layout
+    return lax.all_to_all(out, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)
+
+
+def make_ulysses_attention(mesh, axis: str = "data"):
+    """Jitted global-array form: q/k/v [L, H, D] sharded on L over ``axis``.
+
+    L and H must both divide by the mesh axis size (static shapes; pad
+    upstream).  Output sharding matches the inputs, so ring and ulysses
+    are drop-in interchangeable per layer.
+    """
+    from anomod.parallel.ring_attention import make_sharded_attention
+    return make_sharded_attention(ulysses_attention_local, mesh, axis)

@@ -8,3 +8,14 @@ the pre-init pin (anomod.utils.platform is the single home for the recipe).
 from anomod.utils.platform import pin_cpu
 
 pin_cpu(8)
+
+
+def make_qkv(L, H, D, seed=0):
+    """Shared random q/k/v blocks for the sequence-parallel attention tests
+    (one generator so cross-plane equivalence tests compare identical
+    tensors)."""
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(L, H, D)).astype(np.float32))
+                 for _ in range(3))

@@ -4,17 +4,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from conftest import make_qkv as _qkv
 
 from anomod.parallel.mesh import make_mesh
 from anomod.parallel.ring_attention import (full_attention,
                                             make_ring_attention,
                                             ring_attention_local)
-
-
-def _qkv(L, H, D, seed=0):
-    rng = np.random.default_rng(seed)
-    return tuple(jnp.asarray(rng.normal(size=(L, H, D)).astype(np.float32))
-                 for _ in range(3))
 
 
 def test_ring_matches_full_attention_8dev():
