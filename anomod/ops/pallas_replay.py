@@ -52,6 +52,11 @@ def make_pallas_replay_fn(n_segments: int, n_hist: int = 16,
     ``planes`` rows follow :data:`PLANES`; the histogram bucket is computed
     in-kernel from the log-latency row (``clip(int(dur), 0, H-1)``), and the
     histogram occupies the trailing H columns of the output.
+
+    When invoked inside ``shard_map``, the enclosing shard_map must pass
+    ``check_vma=False``: the kernel's internal constants don't carry mesh
+    varying-axes metadata, and the static checker rejects the mix whether
+    or not the output declares a vma (see make_sharded_replay_fn).
     """
     import jax
     import jax.numpy as jnp

@@ -14,62 +14,79 @@ from typing import Optional
 
 import numpy as np
 
-from anomod.replay import (N_FEATS, ReplayConfig, ReplayState, ThroughputResult)
+from anomod.replay import (N_FEATS, ReplayConfig, ReplayState,
+                           ThroughputResult, make_chunk_step, pallas_block)
 from anomod.schemas import SpanBatch
 
 
-def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data"):
+def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
+                           kernel: str = "xla"):
+    """Pod-sharded replay over the mesh's data axis.
+
+    ``kernel`` selects the per-shard aggregation: "xla" scans chunks with
+    the shared :func:`anomod.replay.make_chunk_step` (identical
+    split-precision scheme to the single-chip path), "pallas" flattens the
+    shard and runs the fused kernel (anomod.ops.pallas_replay — the
+    single-chip fast path, composed with shard_map + psum; interpret mode
+    off-TPU).  Both merge shard states over ICI with one psum.
+    """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(f"unknown replay kernel {kernel!r}")
     SW, H = cfg.sw, cfg.n_hist_buckets
+    if kernel == "pallas":
+        from anomod.ops.pallas_replay import make_pallas_replay_fn
+        interpret = mesh.devices.ravel()[0].platform != "tpu"
+        pfn = make_pallas_replay_fn(cfg.sw, cfg.n_hist_buckets,
+                                    block=pallas_block(cfg.chunk_size),
+                                    interpret=interpret)
 
     def shard_body(chunks):  # runs per-device on its [N/D, C] shard
-        # the carry is device-varying from step 1 on, so the initial zeros
-        # must be cast to varying over the data axis too
-        from anomod.parallel.mesh import pvary_compat
-        state = ReplayState(
-            agg=pvary_compat(jnp.zeros((SW, N_FEATS), jnp.float32), (axis,)),
-            hist=pvary_compat(jnp.zeros((SW, H), jnp.float32), (axis,)))
-
-        def step(state, chunk):
-            sid = chunk["sid"]
-            # same split-precision pattern as the single-chip kernel
-            onehot16 = jax.nn.one_hot(sid, SW + 1, dtype=jnp.bfloat16)
-            exact = jnp.stack([chunk["valid"], chunk["err"], chunk["s5"]],
-                              axis=1).astype(jnp.bfloat16)
-            durs = jnp.stack([chunk["dur_raw"], chunk["dur"],
-                              chunk["dur"] * chunk["dur"]], axis=1)
-            a_exact = jnp.matmul(onehot16.T, exact,
-                                 preferred_element_type=jnp.float32)[:SW]
-            a_dur = jnp.matmul(onehot16.astype(jnp.float32).T, durs,
-                               precision=jax.lax.Precision.HIGHEST)[:SW]
-            agg = state.agg + jnp.concatenate([a_exact, a_dur], axis=1)
-            bucket = jnp.clip(chunk["dur"].astype(jnp.int32), 0, H - 1)
-            bucket_oh = (jax.nn.one_hot(bucket, H, dtype=jnp.bfloat16)
-                         * chunk["valid"][:, None].astype(jnp.bfloat16))
-            hist = state.hist + jnp.matmul(
-                onehot16.T, bucket_oh, preferred_element_type=jnp.float32)[:SW]
-            return ReplayState(agg=agg, hist=hist), None
-
-        state, _ = jax.lax.scan(step, state, chunks)
+        if kernel == "pallas":
+            sid = chunks["sid"].reshape(-1)
+            dur = chunks["dur"].reshape(-1)
+            planes = jnp.stack([
+                chunks["valid"].reshape(-1), chunks["err"].reshape(-1),
+                chunks["s5"].reshape(-1), chunks["dur_raw"].reshape(-1),
+                dur, dur * dur])
+            acc = pfn(sid, planes)
+            state = ReplayState(agg=acc[:, :N_FEATS], hist=acc[:, N_FEATS:])
+        else:
+            # the carry is device-varying from step 1 on, so the initial
+            # zeros must be cast to varying over the data axis too
+            from anomod.parallel.mesh import pvary_compat
+            state = ReplayState(
+                agg=pvary_compat(jnp.zeros((SW, N_FEATS), jnp.float32),
+                                 (axis,)),
+                hist=pvary_compat(jnp.zeros((SW, H), jnp.float32), (axis,)))
+            state, _ = jax.lax.scan(make_chunk_step(cfg), state, chunks)
         # merge shard states over ICI
         return ReplayState(agg=jax.lax.psum(state.agg, axis),
                            hist=jax.lax.psum(state.hist, axis))
 
     from jax import shard_map
+    # the pallas kernel's internal constants (iota tiles, zero-init) carry
+    # no mesh varying-axes metadata, so shard_map's static vma checker
+    # rejects the mix unconditionally (interpret or compiled, with or
+    # without a declared output vma); JAX's documented workaround is
+    # check_vma=False — psum merge semantics are unchanged, only the
+    # static checker is off for this variant
+    kwargs = {"check_vma": False} if kernel == "pallas" else {}
     fn = shard_map(shard_body, mesh=mesh,
                    in_specs=({k: P(axis) for k in
                               ("sid", "dur", "dur_raw", "err", "s5", "valid",
                                "tid")},),
-                   out_specs=ReplayState(agg=P(), hist=P()))
+                   out_specs=ReplayState(agg=P(), hist=P()), **kwargs)
     return jax.jit(fn)
 
 
 def sharded_throughput(batch: SpanBatch, mesh,
                        cfg: Optional[ReplayConfig] = None,
-                       repeats: int = 3) -> ThroughputResult:
+                       repeats: int = 3,
+                       kernel: str = "xla") -> ThroughputResult:
     """Stage, shard, compile, and time the multi-chip replay."""
     import jax
     from anomod.replay import stage_columns
@@ -84,7 +101,7 @@ def sharded_throughput(batch: SpanBatch, mesh,
     from jax.sharding import NamedSharding, PartitionSpec as P
     sharding = NamedSharding(mesh, P("data"))
     dev_chunks = {k: jax.device_put(v, sharding) for k, v in flat.items()}
-    fn = make_sharded_replay_fn(cfg, mesh)
+    fn = make_sharded_replay_fn(cfg, mesh, kernel=kernel)
     t0 = time.perf_counter()
     out = fn(dev_chunks)
     jax.block_until_ready(out)
@@ -96,4 +113,5 @@ def sharded_throughput(batch: SpanBatch, mesh,
         jax.block_until_ready(out)
         best = min(best, time.perf_counter() - t0)
     return ThroughputResult(n_spans=n, wall_s=best,
-                            spans_per_sec=n / best, compile_s=compile_s)
+                            spans_per_sec=n / best, compile_s=compile_s,
+                            kernel=kernel)

@@ -82,20 +82,11 @@ def stage_columns(batch: SpanBatch, cfg: ReplayConfig, t0_us: Optional[int] = No
     return {k: v.reshape(n_chunks, cfg.chunk_size) for k, v in cols.items()}, n
 
 
-def make_replay_fn(cfg: ReplayConfig, with_hll: bool = False,
-                   inner_repeats: int = 1):
-    """Build the jitted replay: scan over chunks, one-hot matmul aggregation.
-
-    ``with_hll=True`` additionally maintains per-service distinct-trace-count
-    HLL registers ([S, 2^p] int32, merged exactly by max) — the streaming
-    replacement for the reference's exact trace-ID sets
-    (trace_collector.py:358-360).
-
-    ``inner_repeats > 1`` replays the staged chunks that many times inside one
-    dispatch (a fori_loop around the scan): device-side corpus replication for
-    throughput measurement without tiling the host arrays — the HBM working
-    set stays one copy while the counted span volume scales.
-    """
+def make_chunk_step(cfg: ReplayConfig, with_hll: bool = False):
+    """The per-chunk aggregation step shared by the single-chip scan and the
+    pod-sharded replay (one definition so the split-precision scheme can't
+    diverge between them).  Returns ``step(state, chunk) -> (state, None)``
+    for ``lax.scan``."""
     import jax
     import jax.numpy as jnp
 
@@ -153,6 +144,29 @@ def make_replay_fn(cfg: ReplayConfig, with_hll: bool = False,
         hist = state.hist + acc[:, 9:]
         hll = hll_update(state.hll, chunk) if with_hll else None
         return ReplayState(agg=agg, hist=hist, hll=hll), None
+
+    return chunk_step
+
+
+def make_replay_fn(cfg: ReplayConfig, with_hll: bool = False,
+                   inner_repeats: int = 1):
+    """Build the jitted replay: scan over chunks, one-hot matmul aggregation.
+
+    ``with_hll=True`` additionally maintains per-service distinct-trace-count
+    HLL registers ([S, 2^p] int32, merged exactly by max) — the streaming
+    replacement for the reference's exact trace-ID sets
+    (trace_collector.py:358-360).
+
+    ``inner_repeats > 1`` replays the staged chunks that many times inside one
+    dispatch (a fori_loop around the scan): device-side corpus replication for
+    throughput measurement without tiling the host arrays — the HBM working
+    set stays one copy while the counted span volume scales.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    SW, H, M = cfg.sw, cfg.n_hist_buckets, cfg.hll_m
+    chunk_step = make_chunk_step(cfg, with_hll=with_hll)
 
     def replay(chunks):
         state = ReplayState(
@@ -262,6 +276,18 @@ def stage_pallas_planes(chunks_np) -> Tuple[np.ndarray, np.ndarray]:
     return sid, planes
 
 
+def pallas_block(chunk_size: int) -> int:
+    """Pallas kernel block size for a staged corpus: must divide the span
+    count (a chunk_size multiple) — chunk_size's largest power-of-2 factor,
+    capped at the VMEM-tuned 4096."""
+    block = min(4096, chunk_size & -chunk_size)
+    if block < 128:
+        raise ValueError(
+            "pallas replay kernel needs chunk_size with a power-of-2 "
+            f"factor >= 128; got chunk_size={chunk_size}")
+    return block
+
+
 @dataclasses.dataclass
 class ThroughputResult:
     n_spans: int
@@ -300,17 +326,10 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
         # off-TPU backends can't execute Mosaic — run the kernel's
         # interpret path so this branch stays testable on the CPU mesh
         interpret = jax.devices()[0].platform != "tpu"
-        # block must divide the staged span count (a chunk_size multiple):
-        # use chunk_size's largest power-of-2 factor, capped at the
-        # VMEM-tuned 4096
-        block = min(4096, cfg.chunk_size & -cfg.chunk_size)
-        if block < 128:
-            raise ValueError(
-                "pallas replay kernel needs chunk_size with a power-of-2 "
-                f"factor >= 128; got chunk_size={cfg.chunk_size}")
         pfn = make_pallas_replay_fn(cfg.sw, cfg.n_hist_buckets,
                                     inner_repeats=replicate,
-                                    block=block, interpret=interpret)
+                                    block=pallas_block(cfg.chunk_size),
+                                    interpret=interpret)
         def fn(_):
             agg = pfn(sid, planes)
             return ReplayState(agg=agg[:, :N_FEATS], hist=agg[:, N_FEATS:])

@@ -51,6 +51,11 @@ def test_sharded_replay_matches_numpy(batch):
     np.testing.assert_allclose(np.asarray(out.agg), ref.agg, rtol=1e-3)
     np.testing.assert_allclose(np.asarray(out.hist), ref.hist, rtol=1e-6)
     assert int(np.asarray(out.agg)[:, 0].sum()) == batch.n_spans
+    # the fused pallas kernel composed with shard_map + psum agrees too
+    # (interpret path on the CPU mesh)
+    pout = make_sharded_replay_fn(cfg, mesh, kernel="pallas")(dev)
+    np.testing.assert_allclose(np.asarray(pout.agg), ref.agg, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(pout.hist), ref.hist, rtol=1e-6)
 
 
 def test_graft_entry_dryrun():
