@@ -43,6 +43,12 @@ def main(argv=None) -> int:
     p_rca.add_argument("--epochs", type=int, default=300)
     p_rca.add_argument("--train-seeds", type=int, default=6)
     p_rca.add_argument("--eval-seeds", type=int, default=2)
+    p_rca.add_argument("--checkpoint-dir", default=None,
+                       help="persist params/opt_state every 50 epochs "
+                            "(orbax, pickle fallback)")
+    p_rca.add_argument("--resume", action="store_true",
+                       help="continue from the epoch saved in "
+                            "--checkpoint-dir")
 
     p_camp = sub.add_parser(
         "campaign", help="run the full 13-experiment collection campaign "
@@ -200,7 +206,9 @@ def main(argv=None) -> int:
         r = train_rca(args.testbed, args.model,
                       train_seeds=range(args.train_seeds),
                       eval_seeds=range(100, 100 + args.eval_seeds),
-                      epochs=args.epochs)
+                      epochs=args.epochs,
+                      checkpoint_dir=args.checkpoint_dir,
+                      resume=args.resume)
         print(json.dumps({
             "testbed": args.testbed, "model": r.model_name,
             "top1": r.top1, "top3": r.top3,

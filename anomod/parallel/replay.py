@@ -46,12 +46,8 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
 
     def shard_body(chunks):  # runs per-device on its [N/D, C] shard
         if kernel == "pallas":
-            sid = chunks["sid"].reshape(-1)
-            dur = chunks["dur"].reshape(-1)
-            planes = jnp.stack([
-                chunks["valid"].reshape(-1), chunks["err"].reshape(-1),
-                chunks["s5"].reshape(-1), chunks["dur_raw"].reshape(-1),
-                dur, dur * dur])
+            from anomod.replay import stage_pallas_planes
+            sid, planes = stage_pallas_planes(chunks, xp=jnp)
             acc = pfn(sid, planes)
             state = ReplayState(agg=acc[:, :N_FEATS], hist=acc[:, N_FEATS:])
         else:

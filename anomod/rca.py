@@ -231,8 +231,16 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
               train_seeds: Sequence[int] = range(8),
               eval_seeds: Sequence[int] = range(100, 104),
               epochs: int = 150, lr: float = 3e-3,
-              n_traces: int = 80, verbose: bool = False) -> TrainResult:
-    """Train a GNN RCA scorer on chaos labels; report held-out top-k."""
+              n_traces: int = 80, verbose: bool = False,
+              checkpoint_dir=None, resume: bool = False) -> TrainResult:
+    """Train a GNN RCA scorer on chaos labels; report held-out top-k.
+
+    ``checkpoint_dir`` persists params + opt_state + epoch counter
+    (anomod.utils.checkpoint) every 50 epochs and at the end; with
+    ``resume=True`` training continues from the saved epoch — the
+    checkpoint/resume plane the reference lacks (SURVEY.md §5), wired into
+    the training entry point so an interrupted run loses at most 50
+    epochs."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -272,11 +280,35 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    start_ep = 0
+    if checkpoint_dir is not None and resume:
+        from anomod.utils.checkpoint import restore_train_state
+        params, opt_state, start_ep, meta = restore_train_state(checkpoint_dir)
+        for key, want in (("model", model_name), ("testbed", testbed)):
+            if meta.get(key) not in (None, want):
+                raise ValueError(
+                    f"checkpoint at {checkpoint_dir} was trained with "
+                    f"{key}={meta.get(key)!r}, not {want!r}")
+        if verbose:
+            print(f"resumed from epoch {start_ep}")
+
+    def _save(completed: int):
+        """Persist with step = number of COMPLETED epochs, so resume's
+        range(start_ep, epochs) never re-applies a baked-in update."""
+        if checkpoint_dir is not None:
+            from anomod.utils.checkpoint import save_train_state
+            save_train_state(checkpoint_dir, params, opt_state, completed,
+                             meta={"model": model_name, "testbed": testbed})
+
     batch = {k: jnp.asarray(v) for k, v in train.items()}
-    for ep in range(epochs):
+    for ep in range(start_ep, epochs):
         params, opt_state, loss = step(params, opt_state, batch)
         if verbose and ep % 20 == 0:
             print(f"epoch {ep}: loss {float(loss):.4f}")
+        if (ep + 1) % 50 == 0:
+            _save(ep + 1)
+    if start_ep < epochs:   # a no-op resume must not rewind the counter
+        _save(epochs)
 
     # eval
     scores = np.asarray(_apply_model(model_name, model, params,

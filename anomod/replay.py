@@ -258,18 +258,20 @@ def replay_percentiles(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
     return out.astype(np.float32)
 
 
-def stage_pallas_planes(chunks_np) -> Tuple[np.ndarray, np.ndarray]:
+def stage_pallas_planes(chunks, xp=np):
     """Flatten staged chunk columns into the fused pallas kernel's layout:
     sid [N] plus the feature-major [6, N] plane stack (anomod.ops.
-    pallas_replay.PLANES order; dur² is materialized host-side once so the
-    kernel reads every plane in its natural layout)."""
-    sid = chunks_np["sid"].reshape(-1)
-    dur = chunks_np["dur"].reshape(-1)
-    planes = np.stack([
-        chunks_np["valid"].reshape(-1),
-        chunks_np["err"].reshape(-1),
-        chunks_np["s5"].reshape(-1),
-        chunks_np["dur_raw"].reshape(-1),
+    pallas_replay.PLANES order; dur² is materialized once so the kernel
+    reads every plane in its natural layout).  The single definition of
+    the row order — host staging (xp=np) and the sharded replay's
+    on-device path (xp=jnp) both use it."""
+    sid = chunks["sid"].reshape(-1)
+    dur = chunks["dur"].reshape(-1)
+    planes = xp.stack([
+        chunks["valid"].reshape(-1),
+        chunks["err"].reshape(-1),
+        chunks["s5"].reshape(-1),
+        chunks["dur_raw"].reshape(-1),
         dur,
         dur * dur,
     ])

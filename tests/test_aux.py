@@ -133,3 +133,37 @@ def test_tdigest_allgather_merge_across_shards():
     for q in (0.5, 0.99):
         exact = np.quantile(vals.reshape(-1), q)
         assert abs(tdigest_quantile(d, q) - exact) / exact < 0.05
+
+
+def test_train_rca_checkpoint_resume(tmp_path):
+    """An interrupted training run resumes from its checkpoint: train N
+    epochs with a checkpoint dir, then 'resume' a fresh call which must
+    (a) load the saved epoch instead of restarting, (b) produce a valid
+    eval, and (c) refuse a checkpoint from a different model."""
+    import pytest
+
+    from anomod.rca import train_rca
+
+    ck = tmp_path / "ck"
+    kwargs = dict(testbed="TT", model_name="gcn", train_seeds=range(2),
+                  eval_seeds=range(100, 101), n_traces=12)
+    train_rca(epochs=60, checkpoint_dir=ck, **kwargs)
+    # saved at epoch 50 (periodic) and 60 (final); final wins
+    import json
+    assert json.loads((ck / "meta.json").read_text())["step"] == 60
+    r = train_rca(epochs=80, checkpoint_dir=ck, resume=True, **kwargs)
+    assert json.loads((ck / "meta.json").read_text())["step"] == 80
+    assert 0.0 <= r.top1 <= 1.0
+    # a no-op resume (target epochs already reached) must not rewind the
+    # completed-epoch counter
+    train_rca(epochs=60, checkpoint_dir=ck, resume=True, **kwargs)
+    assert json.loads((ck / "meta.json").read_text())["step"] == 80
+    # testbed mismatch is rejected like model mismatch
+    with pytest.raises(ValueError, match="testbed"):
+        train_rca(epochs=80, model_name="gcn", testbed="SN",
+                  train_seeds=range(2), eval_seeds=range(100, 101),
+                  n_traces=12, checkpoint_dir=ck, resume=True)
+    with pytest.raises(ValueError, match="model"):
+        train_rca(epochs=80, model_name="gat", testbed="TT",
+                  train_seeds=range(2), eval_seeds=range(100, 101),
+                  n_traces=12, checkpoint_dir=ck, resume=True)
