@@ -117,6 +117,11 @@ def main(argv=None) -> int:
     p_replay.add_argument("--testbed", choices=["SN", "TT"], default="TT")
     p_replay.add_argument("--traces", type=int, default=2000)
     p_replay.add_argument("--replicate", type=int, default=1)
+    p_replay.add_argument("--kernel", choices=["xla", "pallas"],
+                          default="xla",
+                          help="aggregation path: XLA scan (default; runs "
+                               "anywhere) or the fused pallas kernel (the "
+                               "TPU fast path; interpret-mode off-TPU)")
 
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
@@ -202,6 +207,8 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd == "rca":
+        if args.resume and not args.checkpoint_dir:
+            parser.error("--resume requires --checkpoint-dir")
         from anomod.rca import train_rca
         r = train_rca(args.testbed, args.model,
                       train_seeds=range(args.train_seeds),
@@ -382,11 +389,13 @@ def main(argv=None) -> int:
             synth.generate_spans(l, n_traces=args.traces)
             for l in labels.labels_for_testbed(args.testbed)])
         cfg = ReplayConfig(n_services=batch.n_services)
-        r = measure_throughput(batch, cfg, replicate=args.replicate)
+        r = measure_throughput(batch, cfg, replicate=args.replicate,
+                               kernel=args.kernel)
         print(json.dumps({
             "n_spans": r.n_spans, "wall_s": round(r.wall_s, 4),
             "spans_per_sec": round(r.spans_per_sec, 1),
             "compile_s": round(r.compile_s, 2),
+            "kernel": r.kernel,
         }))
         return 0
 

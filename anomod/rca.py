@@ -282,15 +282,22 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
 
     start_ep = 0
     if checkpoint_dir is not None and resume:
-        from anomod.utils.checkpoint import restore_train_state
-        params, opt_state, start_ep, meta = restore_train_state(checkpoint_dir)
-        for key, want in (("model", model_name), ("testbed", testbed)):
-            if meta.get(key) not in (None, want):
-                raise ValueError(
-                    f"checkpoint at {checkpoint_dir} was trained with "
-                    f"{key}={meta.get(key)!r}, not {want!r}")
-        if verbose:
-            print(f"resumed from epoch {start_ep}")
+        from anomod.utils.checkpoint import (has_checkpoint,
+                                             restore_train_state)
+        # no checkpoint yet = first attempt of an always-pass-resume job:
+        # start fresh instead of crashing
+        if has_checkpoint(checkpoint_dir):
+            params, opt_state, start_ep, meta = \
+                restore_train_state(checkpoint_dir)
+            for key, want in (("model", model_name), ("testbed", testbed)):
+                if meta.get(key) not in (None, want):
+                    raise ValueError(
+                        f"checkpoint at {checkpoint_dir} was trained with "
+                        f"{key}={meta.get(key)!r}, not {want!r}")
+            if verbose:
+                print(f"resumed from epoch {start_ep}")
+        elif verbose:
+            print(f"no checkpoint at {checkpoint_dir} yet; starting fresh")
 
     def _save(completed: int):
         """Persist with step = number of COMPLETED epochs, so resume's
@@ -301,13 +308,17 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
                              meta={"model": model_name, "testbed": testbed})
 
     batch = {k: jnp.asarray(v) for k, v in train.items()}
+    last_saved = start_ep
     for ep in range(start_ep, epochs):
         params, opt_state, loss = step(params, opt_state, batch)
         if verbose and ep % 20 == 0:
             print(f"epoch {ep}: loss {float(loss):.4f}")
         if (ep + 1) % 50 == 0:
             _save(ep + 1)
-    if start_ep < epochs:   # a no-op resume must not rewind the counter
+            last_saved = ep + 1
+    if start_ep < epochs and last_saved != epochs:
+        # final save, unless the periodic save just wrote this exact state;
+        # a no-op resume must not rewind the counter either
         _save(epochs)
 
     # eval

@@ -158,12 +158,49 @@ def test_train_rca_checkpoint_resume(tmp_path):
     # completed-epoch counter
     train_rca(epochs=60, checkpoint_dir=ck, resume=True, **kwargs)
     assert json.loads((ck / "meta.json").read_text())["step"] == 80
-    # testbed mismatch is rejected like model mismatch
-    with pytest.raises(ValueError, match="testbed"):
-        train_rca(epochs=80, model_name="gcn", testbed="SN",
-                  train_seeds=range(2), eval_seeds=range(100, 101),
-                  n_traces=12, checkpoint_dir=ck, resume=True)
+    # model / testbed mismatches are rejected
     with pytest.raises(ValueError, match="model"):
         train_rca(epochs=80, model_name="gat", testbed="TT",
                   train_seeds=range(2), eval_seeds=range(100, 101),
                   n_traces=12, checkpoint_dir=ck, resume=True)
+    with pytest.raises(ValueError, match="testbed"):
+        train_rca(epochs=80, model_name="gcn", testbed="SN",
+                  train_seeds=range(2), eval_seeds=range(100, 101),
+                  n_traces=12, checkpoint_dir=ck, resume=True)
+    # resume with no checkpoint yet starts fresh instead of crashing
+    # (always-pass-resume job scripts)
+    fresh = tmp_path / "fresh"
+    r2 = train_rca(epochs=2, checkpoint_dir=fresh, resume=True, **kwargs)
+    assert json.loads((fresh / "meta.json").read_text())["step"] == 2
+
+
+def test_checkpoint_versioned_publish(tmp_path):
+    """Crash-safety layout: state lives in a v<step> dir named by meta.json
+    (written last, atomically); superseded versions are GC'd; the legacy
+    flat layout still restores."""
+    import numpy as np
+
+    from anomod.utils.checkpoint import (has_checkpoint, restore_train_state,
+                                         save_train_state)
+
+    ck = tmp_path / "ck"
+    assert not has_checkpoint(ck)
+    params = {"w": np.arange(4, dtype=np.float32)}
+    save_train_state(ck, params, {"m": np.zeros(4, np.float32)}, step=10)
+    assert has_checkpoint(ck)
+    meta = __import__("json").loads((ck / "meta.json").read_text())
+    assert meta["version"] == "v10" and (ck / "v10").is_dir()
+    save_train_state(ck, params, {"m": np.ones(4, np.float32)}, step=20)
+    assert not (ck / "v10").exists()        # GC'd after publish
+    p, o, step, _ = restore_train_state(ck)
+    assert step == 20 and float(o["m"][0]) == 1.0
+    # legacy flat layout (pre-versioning checkpoints) still restores
+    legacy = tmp_path / "legacy"
+    legacy.mkdir()
+    import json as _json
+    import pickle
+    with open(legacy / "state.pkl", "wb") as f:
+        pickle.dump((params, {"m": np.full(4, 7.0, np.float32)}), f)
+    (legacy / "meta.json").write_text(_json.dumps({"step": 5}))
+    p, o, step, _ = restore_train_state(legacy)
+    assert step == 5 and float(o["m"][0]) == 7.0

@@ -210,3 +210,15 @@ def test_replay_variance_reconstruction_low_variance():
         # assert a 30% envelope (and that var stays positive / same scale)
         assert var > 0, (s, var)
         assert abs(var - true_var) / true_var < 0.30, (s, var, true_var)
+
+
+def test_replay_cli_kernel_flag(capsys):
+    """`anomod replay --kernel pallas` runs the fused kernel end to end
+    (interpret path on the CPU mesh) and reports which kernel ran."""
+    import json
+
+    from anomod.cli import main
+
+    assert main(["replay", "--traces", "10", "--kernel", "pallas"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["kernel"] == "pallas" and out["n_spans"] > 0
