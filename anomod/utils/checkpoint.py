@@ -113,5 +113,20 @@ def restore_train_state(path: Path) -> Tuple[Any, Any, int, dict]:
 
 
 def has_checkpoint(path) -> bool:
-    """True when a published (restorable) checkpoint exists at ``path``."""
-    return (Path(path) / "meta.json").exists()
+    """True when a published AND restorable checkpoint exists at ``path``.
+
+    A meta.json alone is not enough: a legacy (pre-versioning) save killed
+    between its meta and state writes leaves a torn checkpoint, and an
+    always-pass-resume job must start fresh on it rather than crash in
+    restore."""
+    path = Path(path)
+    meta_file = path / "meta.json"
+    if not meta_file.exists():
+        return False
+    try:
+        meta = json.loads(meta_file.read_text())
+    except (OSError, ValueError):
+        return False
+    state_dir = path / meta["version"] if "version" in meta else path
+    return (state_dir / "state.orbax").exists() \
+        or (state_dir / "state.pkl").exists()

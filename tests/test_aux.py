@@ -170,7 +170,7 @@ def test_train_rca_checkpoint_resume(tmp_path):
     # resume with no checkpoint yet starts fresh instead of crashing
     # (always-pass-resume job scripts)
     fresh = tmp_path / "fresh"
-    r2 = train_rca(epochs=2, checkpoint_dir=fresh, resume=True, **kwargs)
+    train_rca(epochs=2, checkpoint_dir=fresh, resume=True, **kwargs)
     assert json.loads((fresh / "meta.json").read_text())["step"] == 2
 
 
@@ -178,6 +178,9 @@ def test_checkpoint_versioned_publish(tmp_path):
     """Crash-safety layout: state lives in a v<step> dir named by meta.json
     (written last, atomically); superseded versions are GC'd; the legacy
     flat layout still restores."""
+    import json
+    import pickle
+
     import numpy as np
 
     from anomod.utils.checkpoint import (has_checkpoint, restore_train_state,
@@ -188,7 +191,7 @@ def test_checkpoint_versioned_publish(tmp_path):
     params = {"w": np.arange(4, dtype=np.float32)}
     save_train_state(ck, params, {"m": np.zeros(4, np.float32)}, step=10)
     assert has_checkpoint(ck)
-    meta = __import__("json").loads((ck / "meta.json").read_text())
+    meta = json.loads((ck / "meta.json").read_text())
     assert meta["version"] == "v10" and (ck / "v10").is_dir()
     save_train_state(ck, params, {"m": np.ones(4, np.float32)}, step=20)
     assert not (ck / "v10").exists()        # GC'd after publish
@@ -197,10 +200,15 @@ def test_checkpoint_versioned_publish(tmp_path):
     # legacy flat layout (pre-versioning checkpoints) still restores
     legacy = tmp_path / "legacy"
     legacy.mkdir()
-    import json as _json
-    import pickle
     with open(legacy / "state.pkl", "wb") as f:
         pickle.dump((params, {"m": np.full(4, 7.0, np.float32)}), f)
-    (legacy / "meta.json").write_text(_json.dumps({"step": 5}))
+    (legacy / "meta.json").write_text(json.dumps({"step": 5}))
     p, o, step, _ = restore_train_state(legacy)
     assert step == 5 and float(o["m"][0]) == 7.0
+    assert has_checkpoint(legacy)
+    # a torn legacy checkpoint (meta written, state never landed) is NOT
+    # restorable and must read as no-checkpoint so resume starts fresh
+    torn = tmp_path / "torn"
+    torn.mkdir()
+    (torn / "meta.json").write_text(json.dumps({"step": 50}))
+    assert not has_checkpoint(torn)
