@@ -128,5 +128,7 @@ def has_checkpoint(path) -> bool:
     except (OSError, ValueError):
         return False
     state_dir = path / meta["version"] if "version" in meta else path
-    return (state_dir / "state.orbax").exists() \
+    # an orbax state needs its treedef companion to be restorable
+    return ((state_dir / "state.orbax").exists()
+            and (state_dir / "treedef.pkl").exists()) \
         or (state_dir / "state.pkl").exists()

@@ -212,3 +212,9 @@ def test_checkpoint_versioned_publish(tmp_path):
     torn.mkdir()
     (torn / "meta.json").write_text(json.dumps({"step": 50}))
     assert not has_checkpoint(torn)
+    # orbax state without its treedef companion is equally unrestorable
+    torn2 = tmp_path / "torn2"
+    (torn2 / "v9" / "state.orbax").mkdir(parents=True)
+    (torn2 / "meta.json").write_text(json.dumps({"step": 9,
+                                                 "version": "v9"}))
+    assert not has_checkpoint(torn2)

@@ -13,6 +13,9 @@ from pathlib import Path
 def test_bench_cpu_fallback_contract():
     env = dict(os.environ)
     env["ANOMOD_BENCH_PLATFORM"] = "cpu"
+    # hermetic: an inherited kernel override could force the pallas
+    # interpret path off-TPU (never finishes at bench scale)
+    env.pop("ANOMOD_BENCH_KERNEL", None)
     # small corpus keeps the fallback fast; the platform pin bypasses the
     # subprocess backend probe entirely
     r = subprocess.run(
