@@ -143,6 +143,10 @@ def _materialize_tt(exp: Experiment, label, root: Path) -> None:
     doc = synth.spans_to_skywalking_json(exp.spans, base)
     stamp = ts.replace("T", "_").replace("Z", "")
     (tdir / f"{base}_skywalking_traces_{stamp}.json").write_text(json.dumps(doc))
+    # ES-collector analysis artifact alongside the raw traces
+    # (enhanced_trace_collector.py's collect-and-analyze pipeline)
+    from anomod.io.tt_traces_es import write_trace_analysis
+    write_trace_analysis(exp.spans, tdir, timestamp=stamp)
 
     mdir = root / "metric_data" / base
     mdir.mkdir(parents=True, exist_ok=True)

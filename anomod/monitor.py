@@ -92,11 +92,13 @@ def _spec(method: str, path: str, owner: str,
                        body=body)
 
 
-SN_OWNER_BY_TEMPLATE = {path: owner for _, path, owner in (
-    ("POST", "/wrk2-api/post/compose", "compose-post-service"),
-    ("GET", "/wrk2-api/home-timeline/read", "home-timeline-service"),
-    ("GET", "/wrk2-api/user-timeline/read", "user-timeline-service"),
-)}
+# The three wrk2 mixed-workload templates (mixed-workload.lua:111-125),
+# owner-resolved from the single SN_ENDPOINTS catalog so the two tables
+# cannot drift.
+_WRK2_TEMPLATES = ("/wrk2-api/post/compose", "/wrk2-api/home-timeline/read",
+                   "/wrk2-api/user-timeline/read")
+SN_OWNER_BY_TEMPLATE = {path: owner for _, path, owner in SN_ENDPOINTS
+                        if path in _WRK2_TEMPLATES}
 
 
 def run_wrk2_workload(gateway: SyntheticGateway, n_requests: int,
@@ -138,7 +140,18 @@ class ActiveMonitor:
     the batch.  Deterministic full coverage beats a reachability-dependent
     prefix for a synthetic SUT: the record count is exactly
     ``12 + cycles*12``, so artifacts are reproducible and fault-conditioned
-    endpoint gaps can't silently shrink the sample."""
+    endpoint gaps can't silently shrink the sample.
+
+    A second intentional deviation rides the gateway's record schema: the
+    artifact ``content_length`` is the *request-body* length for POSTs that
+    carry one (the synthesized wrk2/monitor body) and a synthetic
+    *response* size otherwise, whereas the reference records the response
+    Content-Length header for every exchange
+    (enhanced_openapi_monitor.py:165).  Consumers of the api_responses
+    artifact family should treat content_length as "dominant byte flow of
+    the exchange", not strictly response size — chosen so the artifact's
+    byte histogram reflects the wrk2 content model the corpus is built
+    around (scenario.SyntheticGateway.execute)."""
 
     mode = "active"
     endpoints = SN_ENDPOINTS
@@ -233,12 +246,15 @@ def capture_openapi_responses(out_dir: Optional[Path] = None,
             # workload traffic lands on the shared gateway before every
             # monitor cycle, so artifact timestamps mix the two flows.
             wrk2_rng = np.random.default_rng(seed)
-            per = wrk2_requests // max(cycles, 1)
-            extra = wrk2_requests - per * max(cycles, 1)
+            n_cycles = max(cycles, 1)
+            per = wrk2_requests // n_cycles
+            extra = wrk2_requests - per * n_cycles
 
             def before_cycle(c):
+                # remainder spread one-per-cycle (not lumped into cycle 0)
+                # so small request counts still interleave with the probes
                 run_wrk2_workload(monitor._gw,
-                                  per + (extra if c == 0 else 0),
+                                  per + (1 if c < extra else 0),
                                   rng=wrk2_rng)
         report = monitor.run(cycles, before_cycle=before_cycle)
     finally:

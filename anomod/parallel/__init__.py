@@ -3,10 +3,11 @@ dp×tp training, pipeline (pp) stages, expert (ep) sharding, and the two
 sequence-parallel attention planes (ring + Ulysses all-to-all)."""
 
 from anomod.parallel.mesh import make_mesh, shard_chunks
-from anomod.parallel.replay import make_sharded_replay_fn, sharded_throughput
+from anomod.parallel.replay import (make_sharded_replay_fn, stage_sharded,
+                                    sharded_throughput)
 from anomod.parallel.ring_attention import make_ring_attention
 from anomod.parallel.ulysses import make_ulysses_attention
 
 __all__ = ["make_mesh", "shard_chunks", "make_sharded_replay_fn",
-           "sharded_throughput", "make_ring_attention",
+           "stage_sharded", "sharded_throughput", "make_ring_attention",
            "make_ulysses_attention"]

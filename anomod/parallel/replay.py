@@ -79,16 +79,13 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
     return jax.jit(fn)
 
 
-def sharded_throughput(batch: SpanBatch, mesh,
-                       cfg: Optional[ReplayConfig] = None,
-                       repeats: int = 3,
-                       kernel: str = "xla") -> ThroughputResult:
-    """Stage, shard, compile, and time the multi-chip replay."""
+def stage_sharded(batch: SpanBatch, mesh, cfg: ReplayConfig):
+    """Stage + device-put the span columns sharded over the mesh's data
+    axis; returns (dev_chunks, n_real_spans)."""
     import jax
     from anomod.replay import stage_columns
     from anomod.parallel.mesh import shard_chunks
 
-    cfg = cfg or ReplayConfig(n_services=len(batch.services))
     n_dev = mesh.devices.size
     chunks_np, n = stage_columns(batch, cfg)
     sharded = shard_chunks(chunks_np, n_dev)
@@ -96,18 +93,32 @@ def sharded_throughput(batch: SpanBatch, mesh,
     flat = {k: v.reshape(-1, v.shape[-1]) for k, v in sharded.items()}
     from jax.sharding import NamedSharding, PartitionSpec as P
     sharding = NamedSharding(mesh, P("data"))
-    dev_chunks = {k: jax.device_put(v, sharding) for k, v in flat.items()}
+    return {k: jax.device_put(v, sharding) for k, v in flat.items()}, n
+
+
+def sharded_throughput(batch: SpanBatch, mesh,
+                       cfg: Optional[ReplayConfig] = None,
+                       repeats: int = 3,
+                       kernel: str = "xla") -> ThroughputResult:
+    """Stage, shard, compile, and time the multi-chip replay."""
+    import jax
+
+    cfg = cfg or ReplayConfig(n_services=len(batch.services))
+    dev_chunks, n = stage_sharded(batch, mesh, cfg)
     fn = make_sharded_replay_fn(cfg, mesh, kernel=kernel)
     t0 = time.perf_counter()
     out = fn(dev_chunks)
     jax.block_until_ready(out)
     compile_s = time.perf_counter() - t0
-    best = float("inf")
+    times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         out = fn(dev_chunks)
         jax.block_until_ready(out)
-        best = min(best, time.perf_counter() - t0)
-    return ThroughputResult(n_spans=n, wall_s=best,
-                            spans_per_sec=n / best, compile_s=compile_s,
-                            kernel=kernel)
+        times.append(time.perf_counter() - t0)
+    # same wall_s contract as the single-chip path: median of the raw
+    # per-repeat walls, with the full trail on raw_wall_s
+    wall = sorted(times)[len(times) // 2]
+    return ThroughputResult(n_spans=n, wall_s=wall,
+                            spans_per_sec=n / wall, compile_s=compile_s,
+                            kernel=kernel, raw_wall_s=tuple(times))

@@ -297,6 +297,7 @@ class ThroughputResult:
     spans_per_sec: float
     compile_s: float
     kernel: str = "xla"
+    raw_wall_s: Tuple[float, ...] = ()  # per-repeat walls (median -> wall_s)
 
 
 def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
@@ -356,4 +357,4 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
     wall = sorted(times)[len(times) // 2]
     return ThroughputResult(n_spans=n, wall_s=wall,
                             spans_per_sec=n / wall, compile_s=compile_s,
-                            kernel=kernel)
+                            kernel=kernel, raw_wall_s=tuple(times))

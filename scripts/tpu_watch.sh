@@ -1,0 +1,38 @@
+#!/bin/bash
+# TPU tunnel watcher: probe until the device backend comes up, then capture
+# the headline bench on-chip IMMEDIATELY (both kernels) and COMMIT the
+# bench_runs/ provenance records (the round-2 verdict's evidence gap: the
+# tunnel drops mid-round, so captures must happen — and be committed — the
+# moment it is up).  A failed/timed-out capture does NOT consume the
+# watcher: it keeps probing so the next live window is retried.
+cd "$(dirname "$0")/.." || exit 1
+PROBE='import jax; d=jax.devices()[0]; print(d.platform, getattr(d,"device_kind","?"))'
+for i in $(seq 1 200); do
+  out=$(timeout 90 python -c "$PROBE" 2>/dev/null | tail -1)
+  echo "$(date -u +%H:%M:%S) probe $i: ${out:-timeout/dead}"
+  if [[ "$out" == tpu* ]]; then
+    echo "=== TUNNEL LIVE: $out — capturing now ==="
+    before=$(ls bench_runs/*_tpu.json 2>/dev/null | wc -l)
+    ANOMOD_BENCH_PLATFORM=tpu ANOMOD_BENCH_KERNEL=pallas timeout 600 python bench.py 20000
+    rc1=$?
+    ANOMOD_BENCH_PLATFORM=tpu ANOMOD_BENCH_KERNEL=xla timeout 600 python bench.py 20000
+    rc2=$?
+    after=$(ls bench_runs/*_tpu.json 2>/dev/null | wc -l)
+    new=$((after - before))
+    echo "=== capture rc: pallas=$rc1 xla=$rc2; new TPU records: $new ==="
+    if [[ "$new" -gt 0 ]]; then
+      # pathspec-scoped commit: must not sweep up unrelated staged work
+      git add bench_runs/ && \
+        git commit -m "Record on-chip bench captures (tpu_watch auto-commit)" \
+          -- bench_runs/ \
+        && echo "=== provenance committed ==="
+      if [[ "$rc1" -eq 0 && "$rc2" -eq 0 ]]; then
+        exit 0
+      fi
+    fi
+    echo "=== capture incomplete; continuing to probe ==="
+  fi
+  sleep 240
+done
+echo "=== watcher exhausted retries; tunnel never came up ==="
+exit 2

@@ -183,6 +183,58 @@ def test_es_trace_loader_roundtrip(tmp_path):
     assert int(b.duration_us[b.service == i][0]) == 500_000
 
 
+def test_es_trace_pattern_analysis(tmp_path):
+    """ES pattern-analysis artifact: schema + values matched to the
+    reference's analyze_trace_patterns / trace_analysis_<ts>.json
+    (enhanced_trace_collector.py:216-296,316-323)."""
+    from anomod.io import tt_traces_es
+    records = [
+        {"trace_id": "t1", "service_name": "ts-travel-service",
+         "endpoint_name": "/trips", "start_time": 1762180000000,
+         "latency": 100, "is_error": 0},
+        {"trace_id": "t2", "service_name": "ts-travel-service",
+         "endpoint_name": "/trips", "start_time": 1762180002000,
+         "latency": 300, "is_error": 1},
+        {"trace_id": "t3", "service_name": "ts-order-service",
+         "endpoint_name": "/orders", "start_time": 1762180001000,
+         "latency": 0, "is_error": 0},   # zero latency excluded from stats
+    ]
+    p = tmp_path / "detailed_traces_x.json"
+    p.write_text(json.dumps({"traces": records}))
+    batch = tt_traces_es.load_detailed_traces_json(p)
+    a = tt_traces_es.analyze_trace_patterns(batch)
+    assert a["total_traces"] == 3
+    assert sorted(a["unique_services"]) == ["ts-order-service",
+                                            "ts-travel-service"]
+    assert a["service_call_counts"] == {"ts-travel-service": 2,
+                                        "ts-order-service": 1}
+    assert a["endpoint_call_counts"] == {"/trips": 2, "/orders": 1}
+    assert a["error_traces"] == 1
+    assert a["latency_stats"] == {"min": 100.0, "max": 300.0,
+                                  "avg": 200.0, "count": 2}
+    assert a["time_range"]["earliest"] == 1762180000000
+    assert a["time_range"]["latest"] == 1762180002000
+    assert "earliest_datetime" in a["time_range"]
+
+    # artifact roundtrip: envelope schema + report text
+    out = tt_traces_es.write_trace_analysis(batch, tmp_path / "es",
+                                            timestamp="20251103_120000")
+    doc = tt_traces_es.load_trace_analysis(out)
+    assert doc["timestamp"] == "20251103_120000"
+    assert doc["analysis"]["total_traces"] == 3
+    report = (tmp_path / "es" / "trace_analysis_20251103_120000.txt"
+              ).read_text()
+    assert "Error rate: 33.33%" in report
+    assert "1. ts-travel-service: 2 calls" in report
+    assert "Avg latency: 200.00 ms" in report
+
+    # empty corpus keeps the reference's empty-shape contract
+    from anomod.schemas import empty_span_batch
+    empty = tt_traces_es.analyze_trace_patterns(empty_span_batch())
+    assert empty["latency_stats"] is None
+    assert empty["time_range"] == {"earliest": None, "latest": None}
+
+
 def test_tt_metric_csv_embedded_newline_fallback(tmp_path):
     """RFC-4180 quoted newlines desync the native line-based scanner; the
     loader must detect the row-count mismatch and fall back to pure Python
