@@ -43,41 +43,56 @@ def make_pallas_tdigest_fn(n_centroids: int, length: int,
 
     K = n_centroids
     L = length
+    # Mosaic requires the sublane (second-to-last) block dim to be a
+    # multiple of 8 or the full array dim; one digest lane per block
+    # violates that (caught by the compiled-parity TPU suite — interpret
+    # mode accepts any block shape), so each block carries SUB=8 lanes and
+    # the kernel unrolls the per-lane MXU contraction across sublanes.
+    SUB = 8
 
     def kernel(bucket_ref, w_ref, wv_ref, mean_ref, weight_ref):
-        bucket = bucket_ref[0]                  # [L] int32
-        w = w_ref[0]                            # [L]
-        wv = wv_ref[0]                          # [L]
-        # [L, K] one-hot in VMEM; contract on the MXU: [K, L] @ [L, 2]
+        # [L, K] centroid iota shared by every sublane's one-hot
         iota = jax.lax.broadcasted_iota(jnp.int32, (L, K), 1)
-        onehot = (iota == bucket[:, None]).astype(jnp.float32)
-        rhs = jnp.stack([w, wv], axis=1)        # [L, 2]
-        acc = jax.lax.dot_general(
-            onehot, rhs, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)  # [K, 2]
-        wk = acc[:, 0]
-        weight_ref[0] = wk
-        mean_ref[0] = jnp.where(wk > 0, acc[:, 1] / jnp.where(wk > 0, wk, 1.0),
-                                0.0)
+        for r in range(SUB):
+            bucket = bucket_ref[r]                  # [L] int32
+            w = w_ref[r]                            # [L]
+            wv = wv_ref[r]                          # [L]
+            # one-hot in VMEM; contract on the MXU: [K, L] @ [L, 2]
+            onehot = (iota == bucket[:, None]).astype(jnp.float32)
+            rhs = jnp.stack([w, wv], axis=1)        # [L, 2]
+            acc = jax.lax.dot_general(
+                onehot, rhs, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)  # [K, 2]
+            wk = acc[:, 0]
+            weight_ref[r] = wk
+            mean_ref[r] = jnp.where(
+                wk > 0, acc[:, 1] / jnp.where(wk > 0, wk, 1.0), 0.0)
 
     @jax.jit
     def run(bucket, w, wv):
         R = bucket.shape[0]
         assert bucket.shape == w.shape == wv.shape == (R, L)
-        out_shape = (jax.ShapeDtypeStruct((R, K), jnp.float32),
-                     jax.ShapeDtypeStruct((R, K), jnp.float32))
-        return pl.pallas_call(
+        pad = (-R) % SUB
+        if pad:  # padding lanes carry w == 0 -> zero weight, zero mean
+            bucket = jnp.pad(bucket, ((0, pad), (0, 0)))
+            w = jnp.pad(w, ((0, pad), (0, 0)))
+            wv = jnp.pad(wv, ((0, pad), (0, 0)))
+        Rp = R + pad
+        out_shape = (jax.ShapeDtypeStruct((Rp, K), jnp.float32),
+                     jax.ShapeDtypeStruct((Rp, K), jnp.float32))
+        mean, weight = pl.pallas_call(
             kernel,
-            grid=(R,),
-            in_specs=[pl.BlockSpec((1, L), lambda i: (i, 0))] * 3,
-            out_specs=[pl.BlockSpec((1, K), lambda i: (i, 0))] * 2,
+            grid=(Rp // SUB,),
+            in_specs=[pl.BlockSpec((SUB, L), lambda i: (i, 0))] * 3,
+            out_specs=[pl.BlockSpec((SUB, K), lambda i: (i, 0))] * 2,
             out_shape=out_shape,
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel",)),
             interpret=interpret,
         )(bucket.astype(jnp.int32), w.astype(jnp.float32),
           wv.astype(jnp.float32))
+        return mean[:R], weight[:R]
 
     return run
 

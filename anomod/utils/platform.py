@@ -19,6 +19,36 @@ from __future__ import annotations
 import os
 
 
+def probe_device_platform(attempts=None):
+    """Out-of-process device-backend probe with a hard deadline.
+
+    Returns ``(platform, diagnostic)`` where ``platform`` is the backend's
+    ``jax.devices()[0].platform`` string ("tpu", "cpu", ...) or "" when no
+    backend initializes within the deadline.  Probing in a subprocess is
+    mandatory here: with a dead axon tunnel the first in-process
+    device-touching call hangs forever, so the caller (bench.py, the TPU
+    test suite's collection gate) must learn the backend state without
+    touching it.
+    """
+    import subprocess
+    import sys
+
+    attempts = attempts or (75.0, 30.0)
+    last = ""
+    for t in attempts:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                timeout=t, capture_output=True)
+            if r.returncode == 0:
+                return r.stdout.decode(errors="replace").strip(), "probe ok"
+            last = (r.stderr or b"").decode(errors="replace").strip()[-300:]
+        except subprocess.TimeoutExpired:
+            last = f"backend init probe timed out after {t:.0f}s"
+    return "", last or "unknown"
+
+
 def pin_cpu(n_devices: int = 1) -> None:
     """Pin this process's JAX to ``n_devices`` virtual CPU devices.
 

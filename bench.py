@@ -27,37 +27,26 @@ where anything touching ``jax.devices()`` either raises or hangs forever):
 
 import json
 import os
-import subprocess
 import sys
 import time
 
-_PROBE = "import jax; print(jax.devices()[0].platform)"
-
-
-def _resolve_platform(attempts=(75.0, 30.0)):
+def _resolve_platform(attempts=None):
     """Return ("default"|"cpu", diagnostic). Probes backend init out-of-process
-    with a hard deadline per attempt so a dead tunnel can't block the bench.
-    A backend that initializes but is CPU-only still resolves to "cpu" so the
-    workload is sized for the host, not for a TPU."""
+    (anomod.utils.platform.probe_device_platform) with a hard deadline per
+    attempt so a dead tunnel can't block the bench.  A backend that
+    initializes but is CPU-only still resolves to "cpu" so the workload is
+    sized for the host, not for a TPU."""
     forced = os.environ.get("ANOMOD_BENCH_PLATFORM", "").strip().lower()
     if forced:
         plat = "cpu" if forced == "cpu" else "default"
         return plat, f"forced via ANOMOD_BENCH_PLATFORM={forced}"
-    last = ""
-    for t in attempts:
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE], timeout=t,
-                capture_output=True)
-            if r.returncode == 0:
-                plat = r.stdout.decode(errors="replace").strip()
-                if plat == "cpu":
-                    return "cpu", "backend probe found CPU-only devices"
-                return "default", f"device backend probe ok ({plat})"
-            last = (r.stderr or b"").decode(errors="replace").strip()[-300:]
-        except subprocess.TimeoutExpired:
-            last = f"backend init probe timed out after {t:.0f}s"
-    return "cpu", f"device backend unavailable ({last or 'unknown'})"
+    from anomod.utils.platform import probe_device_platform
+    plat, diag = probe_device_platform(attempts)
+    if plat == "cpu":
+        return "cpu", "backend probe found CPU-only devices"
+    if plat:
+        return "default", f"device backend probe ok ({plat})"
+    return "cpu", f"device backend unavailable ({diag})"
 
 
 def main() -> int:

@@ -1,0 +1,55 @@
+"""Mosaic-compiled Pallas kernel tests — require a real TPU.
+
+``tests/`` pins an 8-device virtual CPU mesh and exercises the Pallas
+kernels only in interpret mode; this suite runs them through the actual
+Mosaic compiler on the attached chip at production shapes (layouts, VMEM
+budgets at the bench block size, the shard_map ``check_vma=False``
+interaction).  It lives outside ``tests/`` because that conftest's CPU pin
+applies at import to the whole pytest session.
+
+Collection is gated on an out-of-process backend probe with a hard
+deadline (a dead axon tunnel makes any in-process ``jax.devices()`` call
+hang forever); without a TPU every test is skipped, so
+``python -m pytest tpu_tests/ -q`` is safe to run anywhere.
+
+Each completed TPU session writes a ``bench_runs/`` provenance record
+(device string, per-test outcomes, git SHA), so Mosaic-compiled parity is
+evidenced by committed artifacts even when the reviewer has no live device.
+"""
+
+import pytest
+
+from anomod.utils.platform import probe_device_platform
+
+_PLATFORM, _DIAG = probe_device_platform()
+_RESULTS = {}
+
+
+def pytest_collection_modifyitems(config, items):
+    if _PLATFORM != "tpu":
+        skip = pytest.mark.skip(
+            reason=f"requires a live TPU backend (probe: {_DIAG})")
+        for item in items:
+            item.add_marker(skip)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when == "call":
+        _RESULTS[item.name] = rep.outcome
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _PLATFORM != "tpu" or not _RESULTS:
+        return
+    import jax
+
+    from anomod.provenance import capture_record, write_capture
+    n_passed = sum(1 for v in _RESULTS.values() if v == "passed")
+    rec = capture_record(
+        "tpu_kernel_parity", float(n_passed), "tests_passed",
+        device=str(jax.devices()[0]), n_tests=len(_RESULTS),
+        outcomes=dict(sorted(_RESULTS.items())), exitstatus=int(exitstatus))
+    write_capture(rec)

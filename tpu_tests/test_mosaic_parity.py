@@ -1,0 +1,133 @@
+"""Mosaic-compiled parity for every Pallas kernel (round-2 weak #2).
+
+The CPU-mesh suite proves kernel *logic* via interpret mode; this module
+proves the *compiled* kernels — Mosaic layouts, bf16 hi/lo numerics on the
+real MXU, VMEM residency at the bench block size (4096), the revisited
+output block across grid steps, and the shard_map ``check_vma=False``
+composition — against the same numpy oracles, on a real synthetic corpus
+at production shapes.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tt_corpus():
+    """A real multi-experiment TT corpus staged exactly like bench.py
+    (all 13 labels so the service vocabulary and sid range match the
+    production replay), small enough to stage in seconds."""
+    from anomod import labels, synth
+    from anomod.replay import ReplayConfig, stage_columns
+    from anomod.schemas import concat_span_batches
+
+    batches = [synth.generate_spans(l, n_traces=60)
+               for l in labels.labels_for_testbed("TT")]
+    batch = concat_span_batches(batches)
+    cfg = ReplayConfig(n_services=batch.n_services)
+    chunks, n = stage_columns(batch, cfg)
+    return batch, cfg, chunks, n
+
+
+def test_replay_kernel_compiled_production_shape(tt_corpus):
+    """Fused replay kernel, Mosaic-compiled at the bench configuration
+    (block=4096, full TT service vocabulary) vs the numpy oracle."""
+    from anomod.ops.pallas_replay import make_pallas_replay_fn
+    from anomod.replay import pallas_block, replay_numpy, stage_pallas_planes
+
+    _, cfg, chunks, _ = tt_corpus
+    sid, planes = stage_pallas_planes(chunks)
+    fn = make_pallas_replay_fn(cfg.sw, cfg.n_hist_buckets,
+                               block=pallas_block(cfg.chunk_size))
+    out = np.asarray(fn(sid, planes))
+    ref = replay_numpy(chunks, cfg)
+    # same tolerance contract as the interpret-mode test: 0/1 planes and
+    # histogram exact, moments within the bf16 hi/lo split's error
+    np.testing.assert_allclose(out[:, :3], ref.agg[:, :3], rtol=0, atol=0)
+    np.testing.assert_allclose(out[:, 6:], ref.hist, rtol=0, atol=0)
+    np.testing.assert_allclose(out[:, 3:6], ref.agg[:, 3:6], rtol=2e-3,
+                               atol=1e-2)
+
+
+def test_replay_kernel_compiled_inner_repeats(tt_corpus):
+    """The bench measurement trick — replaying the staged corpus via the
+    outer grid dimension — must accumulate exactly r copies of the state
+    when compiled (revisited-output-block semantics under Mosaic)."""
+    from anomod.ops.pallas_replay import make_pallas_replay_fn
+    from anomod.replay import pallas_block, replay_numpy, stage_pallas_planes
+
+    _, cfg, chunks, _ = tt_corpus
+    sid, planes = stage_pallas_planes(chunks)
+    r = 3
+    fn = make_pallas_replay_fn(cfg.sw, cfg.n_hist_buckets,
+                               block=pallas_block(cfg.chunk_size),
+                               inner_repeats=r)
+    out = np.asarray(fn(sid, planes))
+    ref = replay_numpy(chunks, cfg)
+    np.testing.assert_allclose(out[:, :3], r * ref.agg[:, :3], rtol=0, atol=0)
+    np.testing.assert_allclose(out[:, 6:], r * ref.hist, rtol=0, atol=0)
+    np.testing.assert_allclose(out[:, 3:6], r * ref.agg[:, 3:6], rtol=2e-3,
+                               atol=3e-2)
+
+
+def test_sharded_replay_pallas_compiled(tt_corpus):
+    """make_sharded_replay_fn(kernel='pallas') on a real-device mesh: the
+    compiled kernel inside shard_map with check_vma=False, psum merge."""
+    import jax
+    from jax.sharding import Mesh
+
+    from anomod.parallel.replay import make_sharded_replay_fn, stage_sharded
+    from anomod.replay import replay_numpy
+
+    batch, cfg, chunks, _ = tt_corpus
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    dev_chunks, _ = stage_sharded(batch, mesh, cfg)
+    fn = make_sharded_replay_fn(cfg, mesh, kernel="pallas")
+    state = fn(dev_chunks)
+    ref = replay_numpy(chunks, cfg)
+    np.testing.assert_allclose(np.asarray(state.hist), ref.hist, rtol=0,
+                               atol=0)
+    np.testing.assert_allclose(np.asarray(state.agg)[:, :3], ref.agg[:, :3],
+                               rtol=0, atol=0)
+    np.testing.assert_allclose(np.asarray(state.agg)[:, 3:6], ref.agg[:, 3:6],
+                               rtol=2e-3, atol=1e-2)
+
+
+def test_tdigest_kernel_compiled():
+    """t-digest build + merge through the Mosaic-compiled MXU reduction at
+    production lane counts (a TT service plane's worth of digest lanes)."""
+    from anomod.ops.pallas_tdigest import (tdigest_build_pallas,
+                                           tdigest_merge_pallas)
+    from anomod.ops.tdigest import tdigest_build, tdigest_merge
+
+    rng = np.random.default_rng(3)
+    a = rng.lognormal(3.0, 1.0, size=(96, 1024)).astype(np.float32)
+    b = rng.lognormal(3.5, 0.8, size=(96, 1024)).astype(np.float32)
+    ra = tdigest_build(a, k=64)
+    pa = tdigest_build_pallas(a, k=64)
+    np.testing.assert_allclose(np.asarray(pa.weight), ra.weight, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pa.mean), ra.mean, rtol=1e-3,
+                               atol=1e-3)
+    ref = tdigest_merge(ra, tdigest_build(b, k=64))
+    out = tdigest_merge_pallas(pa, tdigest_build_pallas(b, k=64))
+    np.testing.assert_allclose(np.asarray(out.weight), ref.weight, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.mean), ref.mean, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_hll_kernel_compiled():
+    """HLL register kernel compiled: hashing, branchless clz, and the
+    revisited max-accumulated output block must match the numpy oracle
+    register-for-register."""
+    from anomod.ops.hll import hll_add, hll_estimate, hll_init
+    from anomod.ops.pallas_hll import make_pallas_hll_fn
+
+    p = 10
+    items = (np.arange(65536, dtype=np.int64) * 2654435761 % (2**31)
+             ).astype(np.int32)
+    ref = hll_add(hll_init(p), items, p=p)
+    fn = make_pallas_hll_fn(p=p, block=2048)
+    out = np.asarray(fn(items))
+    np.testing.assert_array_equal(out, ref)
+    est = hll_estimate(out)
+    assert abs(est - 65536) / 65536 < 0.05
