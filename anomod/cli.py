@@ -10,10 +10,19 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
 def main(argv=None) -> int:
+    # Pre-init platform pin: ANOMOD_PLATFORM=cpu makes every subcommand
+    # usable with a dead device tunnel (the container's sitecustomize
+    # eagerly probes the TPU backend, so even JAX_PLATFORMS=cpu in the
+    # environment hangs forever; only the pre-init jax.config pin sticks —
+    # see anomod.utils.platform).
+    if os.environ.get("ANOMOD_PLATFORM", "").strip().lower() == "cpu":
+        from anomod.utils.platform import pin_cpu
+        pin_cpu(int(os.environ.get("ANOMOD_CPU_DEVICES", "1") or 1))
     parser = argparse.ArgumentParser(
         prog="anomod",
         description="TPU-native anomaly-detection & RCA framework (AnoMod capabilities)")
@@ -122,6 +131,10 @@ def main(argv=None) -> int:
                           help="aggregation path: XLA scan (default; runs "
                                "anywhere) or the fused pallas kernel (the "
                                "TPU fast path; interpret-mode off-TPU)")
+    p_replay.add_argument("--percentiles", action="store_true",
+                          help="also report corpus-wide p50/p95/p99 from the "
+                               "per-segment t-digest plane (Mosaic kernel on "
+                               "TPU, host build elsewhere)")
 
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
@@ -391,12 +404,28 @@ def main(argv=None) -> int:
         cfg = ReplayConfig(n_services=batch.n_services)
         r = measure_throughput(batch, cfg, replicate=args.replicate,
                                kernel=args.kernel)
-        print(json.dumps({
+        out = {
             "n_spans": r.n_spans, "wall_s": round(r.wall_s, 4),
             "spans_per_sec": round(r.spans_per_sec, 1),
             "compile_s": round(r.compile_s, 2),
             "kernel": r.kernel,
-        }))
+        }
+        if args.percentiles:
+            import numpy as np
+
+            from anomod.ops.tdigest import tdigest_build, tdigest_quantile
+            from anomod.replay import replay_digests
+            # per-segment digest plane, merged (weighted rebuild) into ONE
+            # corpus digest so the reported tail is the true corpus-wide
+            # p99, not a median across segments
+            d = replay_digests(batch, cfg)
+            corpus = tdigest_build(d.mean.reshape(-1), k=64,
+                                   weights=d.weight.reshape(-1))
+            out["latency_us"] = {
+                name: round(float(np.expm1(tdigest_quantile(corpus, q))), 1)
+                for name, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99))
+            } if float(d.weight.sum()) > 0 else {}
+        print(json.dumps(out))
         return 0
 
     return 1

@@ -135,6 +135,31 @@ def tdigest_build_pallas(values, k: int = 64, weights=None,
     return TDigest(mean=mean.reshape(*lead, k), weight=weight.reshape(*lead, k))
 
 
+def tdigest_by_segment_pallas(values, segment_ids, n_segments: int,
+                              k: int = 64, interpret=None):
+    """Per-segment digests through the Mosaic kernel — the TPU featurization
+    fast path with the same contract as tdigest.tdigest_by_segment.
+
+    Host :func:`anomod.ops.tdigest.segment_pad` staging (lane dim rounded to
+    128 for TPU layout + compile-cache stability), then ONE fused build over
+    all segment lanes.  ``interpret=None`` auto-selects: compiled on a TPU
+    backend, interpret mode elsewhere (so the same call works on the CPU
+    test mesh).
+    """
+    import numpy as _np
+
+    from anomod.ops.tdigest import segment_pad
+
+    if interpret is None:
+        import jax
+        interpret = jax.default_backend() != "tpu"
+    padded, weights = segment_pad(_np.asarray(values, _np.float32),
+                                  _np.asarray(segment_ids), n_segments,
+                                  pad_to=128)
+    return tdigest_build_pallas(padded, k=k, weights=weights,
+                                interpret=interpret)
+
+
 def tdigest_merge_pallas(a, b, interpret: bool = False):
     """Merge two digest lanes by weighted rebuild through the kernel."""
     import jax.numpy as jnp

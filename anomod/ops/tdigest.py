@@ -127,21 +127,22 @@ def tdigest_quantile(d: TDigest, q, xp=np):
     return m0 + t * (m1 - m0)
 
 
-def tdigest_by_segment(values, segment_ids, n_segments: int, k: int = 64,
-                       xp=np) -> TDigest:
-    """Per-segment t-digests from a flat value stream — the vmapped
-    featurization path (BASELINE.json: per-service latency digests).
+def segment_pad(values, segment_ids, n_segments: int, xp=np, pad_to: int = 1):
+    """Scatter a flat value stream into padded per-segment lanes.
 
-    Sorts once by (segment, value), scatters each segment's run into a padded
-    [n_segments, L_max] matrix (weight 0 = padding), then builds all digests
-    with one vmapped/broadcast tdigest_build.
+    Sorts once by segment, scatters each segment's run into a
+    [n_segments, L_max] matrix (weight 0 = padding) — the shared staging
+    for every per-segment digest build (host/XLA and the Mosaic kernel).
+    ``pad_to`` rounds L_max up (the kernel path uses 128 so the lane dim
+    lands on a TPU lane-aligned layout and recompiles less often).
+    Returns ``(padded_values, weights)``.
     """
     values = xp.asarray(values, dtype="float32")
     segment_ids = xp.asarray(segment_ids)
     n = values.shape[0]
     if n == 0:
-        z = xp.zeros((n_segments, k), dtype="float32")
-        return TDigest(mean=z, weight=z)
+        z = xp.zeros((n_segments, pad_to), dtype="float32")
+        return z, xp.zeros_like(z)
     order = xp.argsort(segment_ids * xp.asarray(1, segment_ids.dtype), stable=True) \
         if xp is not np else np.argsort(segment_ids, kind="stable")
     seg_s = segment_ids[order]
@@ -154,6 +155,7 @@ def tdigest_by_segment(values, segment_ids, n_segments: int, k: int = 64,
         np.bincount(seg_s, minlength=n_segments)
     l_max = int(counts.max()) if xp is np else int(np.asarray(counts).max())
     l_max = max(l_max, 1)
+    l_max += (-l_max) % pad_to
     padded = xp.zeros((n_segments, l_max), dtype="float32")
     weights = xp.zeros((n_segments, l_max), dtype="float32")
     if xp is np:
@@ -162,4 +164,17 @@ def tdigest_by_segment(values, segment_ids, n_segments: int, k: int = 64,
     else:
         padded = padded.at[seg_s, pos].set(val_s)
         weights = weights.at[seg_s, pos].set(1.0)
+    return padded, weights
+
+
+def tdigest_by_segment(values, segment_ids, n_segments: int, k: int = 64,
+                       xp=np) -> TDigest:
+    """Per-segment t-digests from a flat value stream — the vmapped
+    featurization path (BASELINE.json: per-service latency digests).
+
+    One :func:`segment_pad` staging pass, then all digests in one
+    vmapped/broadcast tdigest_build.  On TPU the Mosaic-kernel variant of
+    the same contract is anomod.ops.pallas_tdigest.tdigest_by_segment_pallas.
+    """
+    padded, weights = segment_pad(values, segment_ids, n_segments, xp=xp)
     return tdigest_build(padded, k=k, weights=weights, xp=xp)

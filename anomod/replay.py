@@ -20,6 +20,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
@@ -234,27 +235,68 @@ def percentile_from_hist(hist: np.ndarray, q: float,
     return np.expm1(p).astype(np.float32) if as_us else p
 
 
+def _resolve_tdigest_engine(engine: str) -> str:
+    """Normalize the digest-engine selector: "host" (numpy build), "pallas"
+    (Mosaic MXU kernel; interpret mode off-TPU), or "auto" — env override
+    ``ANOMOD_TDIGEST_ENGINE`` first, else the kernel iff the default JAX
+    backend is a TPU.  Auto initializes the backend to look at it; callers
+    that must stay host-only in an unknown device environment pass
+    engine="host"."""
+    engine = (engine or "auto").strip().lower()
+    if engine == "auto":
+        engine = os.environ.get(
+            "ANOMOD_TDIGEST_ENGINE", "").strip().lower() or "auto"
+    if engine == "auto":
+        import jax
+        engine = "pallas" if jax.default_backend() == "tpu" else "host"
+    if engine not in ("host", "pallas"):
+        raise ValueError(f"unknown t-digest engine {engine!r}")
+    return engine
+
+
+def replay_digests(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
+                   k: int = 64, engine: str = "auto"):
+    """The per-(service, window) t-digest plane over the exact segments the
+    replay aggregates: [S*W, K] log1p-µs digests (TDigest NamedTuple,
+    host-resident numpy arrays — one device transfer regardless of how many
+    quantiles are queried afterwards).
+
+    This is the featurization entry the BASELINE mandates a Pallas kernel
+    for: on a TPU backend (engine="auto") the build runs through the
+    Mosaic kernel (anomod.ops.pallas_tdigest); elsewhere the numpy build.
+    Digests are built in log1p domain — service latencies are heavy-tailed
+    and linear-domain centroids smear the p99 tail."""
+    from anomod.ops.tdigest import TDigest
+    cfg = cfg or ReplayConfig(n_services=len(batch.services))
+    chunks, _ = stage_columns(batch, cfg)
+    sid = chunks["sid"].reshape(-1)
+    dur = chunks["dur"].reshape(-1)       # log1p(duration_us), staged
+    real = sid < cfg.sw
+    engine = _resolve_tdigest_engine(engine)
+    if engine == "pallas":
+        from anomod.ops.pallas_tdigest import tdigest_by_segment_pallas
+        digests = tdigest_by_segment_pallas(dur[real], sid[real], cfg.sw, k=k)
+    else:
+        from anomod.ops.tdigest import tdigest_by_segment
+        digests = tdigest_by_segment(dur[real], sid[real], cfg.sw, k=k)
+    return TDigest(mean=np.asarray(digests.mean),
+                   weight=np.asarray(digests.weight))
+
+
 def replay_percentiles(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
                        qs: Tuple[float, ...] = (0.5, 0.95, 0.99),
-                       k: int = 64) -> np.ndarray:
-    """Reporting-grade per-(service, window) latency percentiles in µs:
-    the t-digest plane over the exact segments the replay aggregates.
+                       k: int = 64, engine: str = "auto") -> np.ndarray:
+    """Reporting-grade per-(service, window) latency percentiles in µs from
+    the :func:`replay_digests` plane.
 
     Returns [S*W, len(qs)] float32.  The streaming digests bound quantile
     error by centroid capacity instead of the histogram's 16-bucket
     quantization — this wires the t-digest plane into the replay path for
-    every consumer that reports percentiles rather than detection deltas.
-    Digests are built in log1p domain (service latencies are heavy-tailed;
-    linear-domain centroids smear the p99 tail) and converted back to µs."""
-    from anomod.ops.tdigest import tdigest_by_segment, tdigest_quantile
-    cfg = cfg or ReplayConfig(n_services=len(batch.services))
-    chunks, n = stage_columns(batch, cfg)
-    sid = chunks["sid"].reshape(-1)
-    dur = chunks["dur"].reshape(-1)       # log1p(duration_us), staged
-    real = sid < cfg.sw
-    digests = tdigest_by_segment(dur[real], sid[real], cfg.sw, k=k)
-    out = np.stack([np.expm1(np.asarray(tdigest_quantile(digests, q)))
-                    for q in qs], axis=-1)
+    every consumer that reports percentiles rather than detection deltas."""
+    from anomod.ops.tdigest import tdigest_quantile
+    digests = replay_digests(batch, cfg, k=k, engine=engine)
+    out = np.stack([np.expm1(tdigest_quantile(digests, q)) for q in qs],
+                   axis=-1)
     return out.astype(np.float32)
 
 

@@ -137,6 +137,34 @@ def test_replay_percentiles_tdigest_plane(tt_batch):
         assert out[seg, 1] > out[seg, 0]
 
 
+def test_replay_percentiles_pallas_engine_matches_host(tt_batch):
+    """The production featurization wiring: engine='pallas' (Mosaic kernel,
+    interpret path on the CPU mesh) must reproduce the host digest plane,
+    and engine='auto' must resolve to host off-TPU."""
+    import pytest
+    from anomod.replay import replay_percentiles
+    cfg = ReplayConfig(n_services=tt_batch.n_services, chunk_size=2048)
+    host = replay_percentiles(tt_batch, cfg, qs=(0.5, 0.99), engine="host")
+    auto = replay_percentiles(tt_batch, cfg, qs=(0.5, 0.99), engine="auto")
+    np.testing.assert_array_equal(auto, host)
+    pal = replay_percentiles(tt_batch, cfg, qs=(0.5, 0.99), engine="pallas")
+    # identical staging + identical bucket math; only kernel-vs-numpy float
+    # ordering differs (lane padding slots carry weight 0)
+    np.testing.assert_allclose(pal, host, rtol=2e-3, atol=1e-2)
+    with pytest.raises(ValueError, match="engine"):
+        replay_percentiles(tt_batch, cfg, engine="exact")
+    # env override is normalized: "AUTO" restores auto-selection instead of
+    # crashing, "HOST" selects the host build
+    import os
+    for val in ("AUTO", "HOST"):
+        os.environ["ANOMOD_TDIGEST_ENGINE"] = val
+        try:
+            np.testing.assert_array_equal(
+                replay_percentiles(tt_batch, cfg, qs=(0.5, 0.99)), host)
+        finally:
+            del os.environ["ANOMOD_TDIGEST_ENGINE"]
+
+
 def test_measure_throughput_smoke(tt_batch):
     cfg = ReplayConfig(n_services=tt_batch.n_services, chunk_size=4096)
     r = measure_throughput(tt_batch, cfg, repeats=1)

@@ -1,0 +1,96 @@
+"""Compiled t-digest featurization path: parity + micro-bench vs the jax
+build (round-2 verdict item 2 — the kernel must run in the production call
+path, with a measured advantage trail).
+
+Writes a ``tdigest_featurize_micro`` provenance record with the median
+walls of both engines so the docs table can cite a committed artifact.
+"""
+
+import time
+
+import numpy as np
+
+
+def _median_wall(fn, *args, repeats=5):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile + warm
+    walls = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        walls.append(time.perf_counter() - t0)
+    return sorted(walls)[len(walls) // 2], walls
+
+
+def test_replay_percentiles_auto_uses_kernel_on_tpu():
+    """engine='auto' must route through the Mosaic kernel on a TPU backend
+    and agree with the host digest plane."""
+    from anomod import labels, synth
+    from anomod.replay import ReplayConfig, replay_percentiles
+    from anomod.schemas import concat_span_batches
+
+    batch = concat_span_batches([
+        synth.generate_spans(l, n_traces=40)
+        for l in labels.labels_for_testbed("TT")[:4]])
+    cfg = ReplayConfig(n_services=batch.n_services, chunk_size=2048)
+    auto = replay_percentiles(batch, cfg, qs=(0.5, 0.99))
+    host = replay_percentiles(batch, cfg, qs=(0.5, 0.99), engine="host")
+    np.testing.assert_allclose(auto, host, rtol=2e-3, atol=1e-2)
+    nonzero = host[:, 0] > 0
+    assert nonzero.any()
+    assert (auto[nonzero, 1] >= auto[nonzero, 0]).all()
+
+
+def test_tdigest_featurize_microbench_kernel_vs_jax():
+    """Mosaic kernel vs the XLA one-hot build on identical staged lanes at
+    a production-sized digest plane; records both walls as provenance.
+    The kernel must at least match the XLA path (its reason to exist is
+    deleting the [R, L, K] broadcast the XLA build materializes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from anomod.ops.pallas_tdigest import make_pallas_tdigest_fn, _scale_pass
+    from anomod.ops.tdigest import segment_pad, tdigest_build
+    from anomod.provenance import capture_record, write_capture
+
+    rng = np.random.default_rng(5)
+    n, S = 1_000_000, 2976          # one TT replay plane: 93 services x 32 win
+    seg = rng.integers(0, S, n).astype(np.int32)
+    vals = np.log1p(rng.lognormal(10.0, 1.0, n)).astype(np.float32)
+    padded, weights = segment_pad(vals, seg, S, pad_to=128)
+    k = 64
+    L = padded.shape[1]
+
+    jax_build = jax.jit(lambda p, w: tdigest_build(p, k=k, weights=w, xp=jnp))
+
+    kern = make_pallas_tdigest_fn(k, L)
+
+    @jax.jit
+    def pallas_build(p, w):
+        bucket, ws, wv = _scale_pass(p, w, k)
+        return kern(bucket, ws, wv)
+
+    p_dev = jnp.asarray(padded)
+    w_dev = jnp.asarray(weights)
+    jax_wall, jax_raw = _median_wall(jax_build, p_dev, w_dev)
+    pal_wall, pal_raw = _median_wall(pallas_build, p_dev, w_dev)
+
+    # parity between the two engines on the same staged lanes
+    ref = jax.device_get(jax_build(p_dev, w_dev))
+    mean, weight = jax.device_get(pallas_build(p_dev, w_dev))
+    np.testing.assert_allclose(weight, ref.weight, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(mean, ref.mean, rtol=2e-3, atol=1e-2)
+
+    rec = capture_record(
+        "tdigest_featurize_micro", round(n / pal_wall, 1), "values/sec",
+        device=str(jax.devices()[0]), kernel="pallas", n_values=n,
+        n_segments=S, lane_len=L, k=k,
+        pallas_wall_s=round(pal_wall, 5),
+        pallas_raw_wall_s=[round(t, 5) for t in pal_raw],
+        xla_wall_s=round(jax_wall, 5),
+        xla_raw_wall_s=[round(t, 5) for t in jax_raw],
+        speedup_vs_xla=round(jax_wall / pal_wall, 3))
+    write_capture(rec)
+    assert pal_wall <= jax_wall * 1.2, (pal_wall, jax_wall)
