@@ -27,9 +27,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from anomod import detect, labels as labels_mod, synth
-from anomod.rca import (_apply_model, _pick_confounders, _stack, build_dataset,
-                        init_params, make_model, rca_loss,
+from anomod import detect, synth
+from anomod.rca import (_apply_model, _stack, build_dataset,
+                        experiment_stream, init_params, make_model, rca_loss,
                         standardize_features, topk_eval)
 
 #: The default sweep grid: full-strength down to the hard regime.
@@ -94,25 +94,20 @@ def _zscore_eval(testbed: str, seeds: Sequence[int], severity: float,
     """Training-free z-score detector over hard corpora (per-seed corpus
     evaluation via detect.evaluate_corpus, averaged).
 
-    Regenerates the eval experiments (cheap: generation is ~1% of sweep wall
-    time, which training dominates — caching 100s of full experiment bundles
-    isn't worth the memory).  The detection statistic is a rank-based AUC
-    over experiment scores, same definition as rca.topk_eval, so the column
-    is comparable across zscore and learned models.
+    The experiments come from rca.experiment_stream — the SAME builder,
+    arguments, and seeds the learned-model eval consumes through
+    build_dataset — so every quality-table cell scores identical bundles
+    (regenerating is cheap: generation is ~1% of sweep wall time, which
+    training dominates).  The detection statistic is a rank-based AUC over
+    experiment scores, same definition as rca.topk_eval, so the column is
+    comparable across zscore and learned models.
     """
     top1s, top3s, aucs, n = [], [], [], 0
-    svc_list = synth.SN_SERVICES if testbed == "SN" else synth.TT_SERVICES
     for seed in seeds:
-        exps = []
-        for label in labels_mod.labels_for_testbed(testbed):
-            mode = synth.HardMode(severity=severity, noise=noise)
-            if n_confounders and label.is_anomaly:
-                mode = dataclasses.replace(
-                    mode, confounders=_pick_confounders(
-                        label, tuple(svc_list), seed, n_confounders))
-            exps.append(synth.generate_experiment(
-                label, n_traces=n_traces, hard=mode,
-                seed=seed * 1000 + synth._seed_for(label.experiment) % 997))
+        exps = [exp for _, exp in experiment_stream(
+            testbed, seed, n_traces=n_traces,
+            hard=synth.HardMode(severity=severity, noise=noise),
+            n_confounders=n_confounders)]
         s = detect.evaluate_corpus(exps)
         top1s.append(s.top1)
         top3s.append(s.top3)

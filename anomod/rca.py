@@ -58,6 +58,36 @@ def _pick_confounders(label, services: Tuple[str, ...], seed: int,
     return tuple(rng.choice(cands, size=min(n, len(cands)), replace=False))
 
 
+def experiment_stream(testbed: str, seed: int, n_traces: int = 80,
+                      hard: Optional["synth.HardMode"] = None,
+                      n_confounders: int = 0):
+    """Yield ``(label, experiment)`` for every label of one seed — THE
+    corpus definition for quality evaluation.
+
+    This is the single builder consumed by both the learned-model dataset
+    (:func:`build_dataset`) and the training-free baselines
+    (anomod.quality._zscore_eval), so every cell of the quality table
+    scores byte-identical experiment bundles; round 2's sweep regenerated
+    the zscore corpora separately, which made the model-vs-baseline
+    comparison cross-sample noise-coupled.
+
+    Seeds are process-stable per (seed, experiment): Python's ``hash()`` is
+    salted per interpreter, which would make every call produce different
+    corpora across processes (synth._seed_for is the stable hash).
+    """
+    svc_list = synth.SN_SERVICES if testbed == "SN" else synth.TT_SERVICES
+    services = tuple(svc_list)
+    for label in labels_mod.labels_for_testbed(testbed):
+        mode = hard or synth.HardMode()
+        if n_confounders and label.is_anomaly:
+            mode = dataclasses.replace(
+                mode, confounders=_pick_confounders(
+                    label, services, seed, n_confounders))
+        yield label, synth.generate_experiment(
+            label, n_traces=n_traces, hard=mode,
+            seed=seed * 1000 + synth._seed_for(label.experiment) % 997)
+
+
 def build_dataset(testbed: str, seeds: Sequence[int], n_traces: int = 80,
                   n_windows: int = 8,
                   hard: Optional["synth.HardMode"] = None,
@@ -84,18 +114,9 @@ def build_dataset(testbed: str, seeds: Sequence[int], n_traces: int = 80,
                                            seed=seed * 1000)
         base_x = detect.extract_features(normal, services).x
         base_t = _windowed_features(normal.spans, services, cfg)
-        for label in labels_mod.labels_for_testbed(testbed):
-            mode = hard or synth.HardMode()
-            if n_confounders and label.is_anomaly:
-                mode = dataclasses.replace(
-                    mode, confounders=_pick_confounders(
-                        label, services, seed, n_confounders))
-            # process-stable per-(seed, experiment) stream: Python's hash() is
-            # salted per interpreter, which would make every build_dataset
-            # call produce different corpora across processes
-            exp = synth.generate_experiment(
-                label, n_traces=n_traces, hard=mode,
-                seed=seed * 1000 + synth._seed_for(label.experiment) % 997)
+        for label, exp in experiment_stream(testbed, seed, n_traces=n_traces,
+                                            hard=hard,
+                                            n_confounders=n_confounders):
             x = detect.extract_features(exp, services).x - base_x
             x_t = _windowed_features(exp.spans, services, cfg) - base_t
             g = build_service_graph(exp.spans, services=services)

@@ -52,6 +52,46 @@ def test_model_separation(sweep_points):
             > _point(sweep_points, "zscore", 0.2).top1)
 
 
+def test_zscore_and_model_paths_consume_identical_corpora():
+    """Round-2 weak #3: both quality-table paths must score the SAME
+    experiment bundles.  Records every synth.generate_experiment call made
+    by the zscore path (_zscore_eval) and the learned-model path
+    (build_dataset) for one (seed, severity) cell and asserts the labeled
+    experiment streams are call-for-call identical, and that the generated
+    spans are byte-identical."""
+    from unittest import mock
+
+    from anomod.quality import _zscore_eval
+    from anomod.rca import build_dataset
+
+    calls = {}
+    real = synth.generate_experiment
+
+    def record(tag):
+        def wrapper(label, **kw):
+            exp = real(label, **kw)
+            calls.setdefault(tag, []).append(
+                (label.experiment, tuple(sorted(kw.items())),
+                 exp.spans.duration_us.tobytes(),
+                 exp.spans.service.tobytes()))
+            return exp
+        return wrapper
+
+    hard_kw = dict(severity=0.2, noise=0.5, n_confounders=2)
+    with mock.patch.object(synth, "generate_experiment", record("zscore")):
+        _zscore_eval("TT", [100], n_traces=12, **hard_kw)
+    with mock.patch.object(synth, "generate_experiment", record("model")):
+        build_dataset("TT", [100], n_traces=12,
+                      hard=synth.HardMode(severity=0.2, noise=0.5),
+                      n_confounders=2)
+    z = calls["zscore"]
+    # build_dataset additionally generates the per-seed normal BASELINE
+    # (feature reference, not an eval bundle) — exclude it, then the
+    # labeled streams must match exactly
+    m = [c for c in calls["model"] if dict(c[1]).get("hard") is not None]
+    assert z == m
+
+
 def test_hardmode_severity_scales_effects():
     from anomod.labels import label_for
     lab = label_for("Lv_P_CPU_preserve")
