@@ -151,6 +151,13 @@ def main(argv=None) -> int:
     p_q.add_argument("--epochs", type=int, default=120)
     p_q.add_argument("--noise", type=float, default=0.5)
     p_q.add_argument("--confounders", type=int, default=2)
+    p_q.add_argument("--sweep", choices=["severity", "shift"],
+                     default="severity",
+                     help="severity: degradation curves; shift: train on the "
+                          "default effect model, eval under shifted "
+                          "generators (effect shape / fault timing / locus)")
+    p_q.add_argument("--shift-severity", type=float, default=0.3,
+                     help="fixed fault severity for the shift sweep")
     p_q.add_argument("--json", action="store_true",
                      help="emit one JSON object per sweep point")
 
@@ -204,19 +211,34 @@ def main(argv=None) -> int:
     if args.cmd == "quality":
         import dataclasses as _dc
 
-        from anomod.quality import render_markdown, severity_sweep
-        pts = severity_sweep(
+        from anomod.quality import (render_markdown, render_shift_markdown,
+                                    severity_sweep, shift_sweep)
+        # a flag belonging to the other sweep kind must not be silently
+        # dropped (defaults come from the parser, so a non-default value
+        # means the user passed it)
+        if args.sweep == "shift" and args.severities != [1.0, 0.4, 0.2, 0.1,
+                                                         0.05]:
+            parser.error("--severities applies to --sweep severity; "
+                         "use --shift-severity for the shift sweep")
+        if args.sweep == "severity" and args.shift_severity != 0.3:
+            parser.error("--shift-severity applies to --sweep shift")
+        common = dict(
             testbed=args.testbed, model_names=args.models,
-            severities=args.severities,
             train_seeds=range(args.train_seeds),
             eval_seeds=range(100, 100 + args.eval_seeds),
             n_traces=args.traces, epochs=args.epochs, noise=args.noise,
             n_confounders=args.confounders, verbose=not args.json)
+        if args.sweep == "shift":
+            pts = shift_sweep(severity=args.shift_severity, **common)
+            render = render_shift_markdown
+        else:
+            pts = severity_sweep(severities=args.severities, **common)
+            render = render_markdown
         if args.json:
             for p in pts:
                 print(json.dumps(_dc.asdict(p)))
         else:
-            print(render_markdown(pts))
+            print(render(pts))
         return 0
 
     if args.cmd == "rca":

@@ -254,10 +254,33 @@ class HardMode:
     - ``confounders`` names decoy services that also degrade (fixed mild
       1.5x latency / 2% errors in the same anomaly window, independent of
       severity) — the ranking must still put the labeled culprit first.
+
+    The three ``*_shape/profile/locus`` knobs are the DISTRIBUTION-SHIFT
+    axes (round-2 weak #4: generator and evaluator shared one effect
+    model, so quality rankings could be statements about the generator).
+    Train on the default effect model, evaluate under shift:
+
+    - ``effect_shape``: how fault latency manifests on affected spans —
+      "mult" (lognormal location shift, the training shape), "add" (a
+      constant offset — spread does not scale with the effect), "tail"
+      (only ~12% of affected spans inflate, 3x harder — p99 moves, the
+      median barely does).
+    - ``fault_profile``: when the fault is active inside the anomaly
+      window — "sustained" (the whole [600, 1200) s window), "bursty"
+      (alternating 60 s on/off bursts), "partial" (first half only).
+      Applied consistently across ALL modality generators via
+      :func:`anomaly_window_mask` so the corpus stays time-synchronized.
+    - ``fault_locus``: where the fault manifests — "node" (the culprit
+      service's own spans) or "edge" (the callee side of the culprit's
+      outgoing calls, like a link fault: node-scoped metrics/logs stay
+      healthy and attribution must come from trace structure).
     """
     severity: float = 1.0
     noise: float = 0.0
     confounders: Tuple[str, ...] = ()
+    effect_shape: str = "mult"        # "mult" | "add" | "tail"
+    fault_profile: str = "sustained"  # "sustained" | "bursty" | "partial"
+    fault_locus: str = "node"         # "node" | "edge"
 
 
 _EASY = HardMode()
@@ -270,6 +293,27 @@ _CONFOUND_LAT, _CONFOUND_ERR = 1.5, 0.02
 def scale_mult(mult: float, severity: float) -> float:
     """Interpolate a fault multiplier toward 1.0 (works for <1 drops too)."""
     return 1.0 + (mult - 1.0) * severity
+
+
+def anomaly_window_mask(rel_s, profile: str = "sustained"):
+    """Fault-active mask from experiment-relative times in SECONDS — the one
+    definition of the anomaly window every modality generator uses, so a
+    fault_profile shift stays time-synchronized across spans, metrics,
+    logs, and API records.
+
+    "sustained" = the whole middle third [600, 1200); "bursty" = alternating
+    60 s on/off bursts inside it (5 bursts); "partial" = its first half
+    [600, 900) only.
+    """
+    rel_s = np.asarray(rel_s)
+    base = (rel_s >= 600) & (rel_s < 1200)
+    if profile == "sustained":
+        return base
+    if profile == "bursty":
+        return base & (((rel_s - 600) // 60).astype(np.int64) % 2 == 0)
+    if profile == "partial":
+        return base & (rel_s < 900)
+    raise ValueError(f"unknown fault_profile {profile!r}")
 
 
 # Per-(level,type) effect multipliers applied to the target service.
@@ -353,8 +397,8 @@ def generate_spans(label: FaultLabel, n_traces: int = 200,
     # third [600, 1200) s — the same anomaly window generate_metrics and
     # generate_api use, so the five modalities stay time-synchronized.
     trace_start = base_time_us + np.sort(rng.integers(0, 1_800_000_000, size=n_traces))
-    trace_in_window = ((trace_start - base_time_us >= 600_000_000)
-                       & (trace_start - base_time_us < 1_200_000_000))
+    trace_in_window = anomaly_window_mask(
+        (trace_start - base_time_us) / 1e6, hard.fault_profile)
 
     for t_id in range(len(templates)):
         mask = tpl_ids == t_id
@@ -374,8 +418,17 @@ def generate_spans(label: FaultLabel, n_traces: int = 200,
         # durations: lognormal around per-service base, inflated on the
         # culprit service only while the trace falls in the anomaly window
         tw = trace_in_window[mask]  # (m,)
-        culprit = (np.full(L, True) if host_level
-                   else (svc == target_idx))  # (L,)
+        if hard.fault_locus == "edge" and not host_level:
+            # link fault: the callee side of the culprit's outgoing calls
+            # degrades; the culprit's own spans (including its entry->exit
+            # self-edges) stay healthy, so node-level attribution has no
+            # direct signal and the ranking must come from trace structure
+            par_svc = np.where(par_local >= 0,
+                               svc[np.clip(par_local, 0, None)], -1)
+            culprit = (par_svc == target_idx) & (svc != target_idx)  # (L,)
+        else:
+            culprit = (np.full(L, True) if host_level
+                       else (svc == target_idx))  # (L,)
         active = label.is_anomaly & (tw[:, None] & culprit[None, :])  # (m, L)
         mult = np.where(active, lat_mult, 1.0)
         err_prob = np.where(active, err_p, 0.005 if label.is_anomaly else 0.002)
@@ -385,8 +438,24 @@ def generate_spans(label: FaultLabel, n_traces: int = 200,
             decoy_active = (tw[:, None] & decoy[None, :]) & ~active
             mult = np.where(decoy_active, _CONFOUND_LAT, mult)
             err_prob = np.where(decoy_active, _CONFOUND_ERR, err_prob)
-        dur_ms = rng.lognormal(mean=np.log(base_ms[svc][None, :] * mult),
-                               sigma=sigma, size=(m, L))
+        if hard.effect_shape == "mult":
+            dur_ms = rng.lognormal(mean=np.log(base_ms[svc][None, :] * mult),
+                                   sigma=sigma, size=(m, L))
+        elif hard.effect_shape == "add":
+            # constant offset: location moves, spread does not scale
+            dur_ms = rng.lognormal(mean=np.log(base_ms[svc][None, :]),
+                                   sigma=sigma, size=(m, L)) \
+                + (mult - 1.0) * base_ms[svc][None, :]
+        elif hard.effect_shape == "tail":
+            # only ~12% of affected spans inflate, 3x harder: the p99 moves,
+            # the median barely does (mean-based detectors see ~1/3 of the
+            # "mult" signal)
+            tail_sel = rng.random((m, L)) < 0.12
+            eff = np.where(tail_sel, 1.0 + (mult - 1.0) * 3.0, 1.0)
+            dur_ms = rng.lognormal(mean=np.log(base_ms[svc][None, :] * eff),
+                                   sigma=sigma, size=(m, L))
+        else:
+            raise ValueError(f"unknown effect_shape {hard.effect_shape!r}")
         errors = rng.random((m, L)) < err_prob
         # Entry spans of parents of failed spans also error (propagation).
         prop = errors.copy()
@@ -877,11 +946,19 @@ def generate_metrics(label: FaultLabel, duration_s: int = 1800, step_s: int = 15
         v_col.append(values)
 
     # anomaly window: middle third of the experiment (same [600, 1200) s
-    # window generate_spans / generate_logs / generate_api use)
-    in_window = (t - t[0] >= duration_s / 3) & (t - t[0] < 2 * duration_s / 3)
+    # window generate_spans / generate_logs / generate_api use; rescaled to
+    # the canonical 1800 s so non-default durations keep proportional
+    # boundaries under every fault_profile)
+    in_window = anomaly_window_mask((t - t[0]) * (1800.0 / duration_s),
+                                    hard.fault_profile)
     # SN host-level performance faults (ChaosBlade on the Docker host) hit
     # every service's containers; named-target faults hit one service.
     host_level = label.is_anomaly and label.target_service not in services
+    # an edge-locus fault is a link fault: node-scoped series stay healthy
+    # (the trace plane carries the only attribution evidence); is_anomaly
+    # derives from anomaly_level, so neutralize the level
+    if hard.fault_locus == "edge" and not host_level:
+        label = dataclasses.replace(label, anomaly_level="normal")
     for m_idx, name in enumerate(names):
         if label.testbed == "SN" and name in SN_STORE_FILES:
             store = name.split("_")[0]  # "mongodb" | "redis"
@@ -940,9 +1017,11 @@ def generate_logs(label: FaultLabel, lines_per_service: int = 400,
     for s, svc in enumerate(services):
         n = int(lines_per_service * rng.uniform(0.5, 2.0))
         tt = base_time_s + np.sort(rng.uniform(0, 1800, n))
-        culprit = label.is_anomaly and (host_level or label.target_service == svc)
+        # edge-locus faults leave node-scoped logs healthy (link fault)
+        culprit = label.is_anomaly and (host_level or label.target_service == svc) \
+            and not (hard.fault_locus == "edge" and not host_level)
         # elevated error rate only inside the shared anomaly window [600,1200)s
-        in_window = (tt - base_time_s >= 600) & (tt - base_time_s < 1200)
+        in_window = anomaly_window_mask(tt - base_time_s, hard.fault_profile)
         p_err = np.where(culprit & in_window, p_culprit, 0.01)
         if svc in hard.confounders and not culprit:
             p_err = np.where(in_window, 0.03, p_err)
@@ -994,7 +1073,10 @@ def generate_api(label: FaultLabel, n_records: int = 600,
         hit_p = np.where(on_target, min(err_p + 0.05, 0.6),
                          min(err_p * 0.1 + 0.01, 0.1))
         affected = rng.random(n_records) < hit_p
-        in_window = (t - t[0] >= 600) & (t - t[0] < 1200)
+        # API records see end-to-end latency, so they stay fault-conditioned
+        # under an edge locus (a slow outgoing call still slows the route);
+        # only the active-window profile shifts
+        in_window = anomaly_window_mask(t - t[0], hard.fault_profile)
         affected &= in_window
         lat = np.where(affected, lat * lat_mult, lat).astype(np.float32)
         status = np.where(affected & (rng.random(n_records) < err_p), 500, status)

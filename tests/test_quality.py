@@ -77,9 +77,9 @@ def test_zscore_and_model_paths_consume_identical_corpora():
             return exp
         return wrapper
 
-    hard_kw = dict(severity=0.2, noise=0.5, n_confounders=2)
     with mock.patch.object(synth, "generate_experiment", record("zscore")):
-        _zscore_eval("TT", [100], n_traces=12, **hard_kw)
+        _zscore_eval("TT", [100], n_traces=12, n_confounders=2,
+                     hard=synth.HardMode(severity=0.2, noise=0.5))
     with mock.patch.object(synth, "generate_experiment", record("model")):
         build_dataset("TT", [100], n_traces=12,
                       hard=synth.HardMode(severity=0.2, noise=0.5),
@@ -121,3 +121,18 @@ def test_confounders_degrade_decoy_spans():
     med_hard = np.median(b.duration_us[sel])
     med_base = np.median(base.duration_us[sel0])
     assert med_hard > 1.2 * med_base  # ~1.5x decoy inflation
+
+
+def test_shift_sweep_plumbing_zscore():
+    """Shift-sweep smoke (training-free detector only, tiny corpora): every
+    (model, shift) cell present, shift recorded on the points, and the
+    edge-locus shift is genuinely harder for the node-evidence detector
+    than in-distribution."""
+    from anomod.quality import shift_sweep
+    pts = shift_sweep(model_names=("zscore",),
+                      shifts=("in-dist", "edge-locus"), severity=0.6,
+                      train_seeds=range(1), eval_seeds=[100], n_traces=20,
+                      epochs=1)
+    assert {p.shift for p in pts} == {"in-dist", "edge-locus"}
+    by = {p.shift: p for p in pts}
+    assert by["edge-locus"].top1 <= by["in-dist"].top1

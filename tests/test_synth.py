@@ -186,3 +186,110 @@ def test_sn_request_mix_weighting():
     assert ht_n > ut_n  # 60% vs 30%
     # every template still present: all 12 services appear
     assert len(np.unique(b.service)) == len(b.services)
+
+
+# ---------------------------------------------------------------------------
+# Distribution-shift axes (HardMode effect_shape / fault_profile / fault_locus)
+# ---------------------------------------------------------------------------
+
+def _culprit_window_latency(batch, svc_name, lo_s=600, hi_s=1200):
+    """Median in-window latency of one service's spans."""
+    si = batch.services.index(svc_name)
+    rel = (batch.start_us - batch.start_us.min()) / 1e6
+    sel = (batch.service == si) & (rel >= lo_s) & (rel < hi_s)
+    return batch.duration_us[sel]
+
+
+def test_anomaly_window_profiles():
+    t = np.arange(0, 1800, 5)
+    sus = synth.anomaly_window_mask(t, "sustained")
+    bur = synth.anomaly_window_mask(t, "bursty")
+    par = synth.anomaly_window_mask(t, "partial")
+    assert sus.sum() == ((t >= 600) & (t < 1200)).sum()
+    # bursty: alternating 60 s bursts -> half the window, starting on
+    assert bur.sum() == sus.sum() // 2
+    assert bur[(t >= 600) & (t < 660)].all()
+    assert not bur[(t >= 660) & (t < 720)].any()
+    # partial: first half only
+    assert par[(t >= 600) & (t < 900)].all()
+    assert not par[(t >= 900)].any()
+    assert not (bur & ~sus).any() and not (par & ~sus).any()
+    with pytest.raises(ValueError, match="fault_profile"):
+        synth.anomaly_window_mask(t, "ramp")
+
+
+def test_effect_shapes_shift_latency_distribution():
+    lab = labels.label_for("Lv_P_CPU_preserve")
+    base = _culprit_window_latency(
+        synth.generate_spans(labels.label_for("Normal_case"), n_traces=400),
+        lab.target_service)
+    shapes = {}
+    for shape in ("mult", "add", "tail"):
+        b = synth.generate_spans(lab, n_traces=400,
+                                 hard=synth.HardMode(effect_shape=shape))
+        shapes[shape] = _culprit_window_latency(b, lab.target_service)
+    med0, p99_0 = np.median(base), np.quantile(base, 0.99)
+    # mult: the whole distribution scales (median strongly inflated)
+    assert np.median(shapes["mult"]) > 3 * med0
+    # add: location moves by a constant, so the median moves but the
+    # relative spread shrinks vs mult (spread does not scale)
+    assert np.median(shapes["add"]) > 2 * med0
+    iqr = lambda a: (np.quantile(a, 0.75) - np.quantile(a, 0.25)) / np.median(a)
+    assert iqr(shapes["add"]) < 0.6 * iqr(shapes["mult"])
+    # tail: the median barely moves, the p99 strongly does
+    assert np.median(shapes["tail"]) < 1.8 * med0
+    assert np.quantile(shapes["tail"], 0.99) > 3 * p99_0
+    with pytest.raises(ValueError, match="effect_shape"):
+        synth.generate_spans(lab, n_traces=10,
+                             hard=synth.HardMode(effect_shape="step"))
+
+
+def test_edge_locus_moves_signal_to_callees():
+    lab = labels.label_for("Lv_P_CPU_preserve")
+    node = synth.generate_spans(lab, n_traces=400)
+    edge = synth.generate_spans(lab, n_traces=400,
+                                hard=synth.HardMode(fault_locus="edge"))
+    normal = synth.generate_spans(labels.label_for("Normal_case"), n_traces=400)
+    # the culprit's own spans stay healthy under edge locus
+    cul_edge = _culprit_window_latency(edge, lab.target_service)
+    cul_norm = _culprit_window_latency(normal, lab.target_service)
+    assert np.median(cul_edge) < 1.5 * np.median(cul_norm)
+    assert np.median(_culprit_window_latency(node, lab.target_service)) \
+        > 3 * np.median(cul_norm)
+    # the callee side of the culprit's outgoing calls degrades instead
+    ti = edge.services.index(lab.target_service)
+    for b, expect_hot in ((edge, True), (normal, False)):
+        rel = (b.start_us - b.start_us.min()) / 1e6
+        cross = (b.parent >= 0) \
+            & (b.service[np.clip(b.parent, 0, None)] == ti) \
+            & (b.service != ti)  # callee side, excluding entry->exit self-edges
+        callee = cross & (rel >= 600) & (rel < 1200)
+        out_w = cross & ~((rel >= 600) & (rel < 1200))
+        assert callee.sum() > 20
+        ratio = np.median(b.duration_us[callee]) / np.median(b.duration_us[out_w])
+        assert (ratio > 3) if expect_hot else (ratio < 1.6), ratio
+    # and the node-scoped modalities stay healthy (link fault): culprit log
+    # error rate matches the healthy baseline
+    logs_e, _ = synth.generate_logs(lab, hard=synth.HardMode(fault_locus="edge"))
+    logs_n, _ = synth.generate_logs(lab)
+    from anomod.schemas import LOG_ERROR
+    def err_rate(lb):
+        sel = lb.service == lb.services.index(lab.target_service)
+        return (lb.level[sel] == LOG_ERROR).mean()
+    assert err_rate(logs_n) > 5 * err_rate(logs_e)
+
+
+def test_bursty_profile_is_cross_modality():
+    """The fault-timing shift must move metrics and spans together."""
+    lab = labels.label_for("Lv_P_CPU_preserve")
+    m = synth.generate_metrics(lab, hard=synth.HardMode(fault_profile="bursty"))
+    i = m.metric_names.index("container_cpu_usage_seconds_total")
+    ti = m.services.index(lab.target_service)
+    svc_of_sample = m.series_service[m.series]
+    sel = (m.metric == i) & (svc_of_sample == ti)
+    t_rel = m.t_s[sel] - m.t_s.min()
+    v = m.value[sel]
+    on = v[(t_rel >= 600) & (t_rel < 660)]
+    off = v[(t_rel >= 660) & (t_rel < 720)]
+    assert len(on) and len(off)
+    assert on.mean() > 2 * off.mean()  # fault active only during bursts
