@@ -234,11 +234,33 @@ def main(argv=None) -> int:
         else:
             pts = severity_sweep(severities=args.severities, **common)
             render = render_markdown
+        # committed provenance trail (same contract as bench.py): every
+        # sweep leaves a bench_runs/ record with the full table + device
+        # string + git SHA, so docs tables cite re-checkable artifacts
+        try:
+            import jax
+
+            from anomod.provenance import capture_record, write_capture
+            rec = capture_record(
+                f"quality_{args.sweep}_sweep", float(len(pts)), "points",
+                device=str(jax.devices()[0]), testbed=args.testbed,
+                models=list(args.models),
+                params={k: (list(v) if isinstance(v, range) else v)
+                        for k, v in common.items()
+                        if k not in ("verbose", "testbed", "model_names")},
+                points=[_dc.asdict(p) for p in pts])
+            capture_path = write_capture(rec)
+        except Exception:
+            capture_path = None
         if args.json:
             for p in pts:
                 print(json.dumps(_dc.asdict(p)))
+            if capture_path:
+                print(json.dumps({"capture_file": capture_path}))
         else:
             print(render(pts))
+            if capture_path:
+                print(f"\ncapture: {capture_path}")
         return 0
 
     if args.cmd == "rca":
