@@ -245,18 +245,23 @@ def main(argv=None) -> int:
                 f"quality_{args.sweep}_sweep", float(len(pts)), "points",
                 device=str(jax.devices()[0]), testbed=args.testbed,
                 models=list(args.models),
-                params={k: (list(v) if isinstance(v, range) else v)
-                        for k, v in common.items()
-                        if k not in ("verbose", "testbed", "model_names")},
+                params={**{k: (list(v) if isinstance(v, range) else v)
+                           for k, v in common.items()
+                           if k not in ("verbose", "testbed", "model_names")},
+                        **({"shift_severity": args.shift_severity}
+                           if args.sweep == "shift"
+                           else {"severities": args.severities})},
                 points=[_dc.asdict(p) for p in pts])
             capture_path = write_capture(rec)
         except Exception:
             capture_path = None
         if args.json:
+            # one QualityPoint per stdout line (stream stays homogeneous);
+            # the capture path goes to stderr
             for p in pts:
                 print(json.dumps(_dc.asdict(p)))
             if capture_path:
-                print(json.dumps({"capture_file": capture_path}))
+                print(f"capture: {capture_path}", file=sys.stderr)
         else:
             print(render(pts))
             if capture_path:

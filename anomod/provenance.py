@@ -40,7 +40,11 @@ def git_sha(cwd: Optional[str] = None) -> str:
         if r.returncode != 0:
             return ""
         sha = r.stdout.decode().strip()
-        s = subprocess.run(["git", "status", "--porcelain"], cwd=cwd,
+        # -uno: a capture record being written is itself untracked, so
+        # counting untracked files would mark every capture dirty by
+        # construction; only modified TRACKED files make the measured code
+        # state unreproducible
+        s = subprocess.run(["git", "status", "--porcelain", "-uno"], cwd=cwd,
                            capture_output=True, timeout=10)
         if s.returncode == 0 and s.stdout.strip():
             sha += "-dirty"

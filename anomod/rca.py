@@ -253,15 +253,16 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
               eval_seeds: Sequence[int] = range(100, 104),
               epochs: int = 150, lr: float = 3e-3,
               n_traces: int = 80, verbose: bool = False,
-              checkpoint_dir=None, resume: bool = False) -> TrainResult:
+              checkpoint_dir=None, resume: bool = False,
+              save_every: int = 50) -> TrainResult:
     """Train a GNN RCA scorer on chaos labels; report held-out top-k.
 
     ``checkpoint_dir`` persists params + opt_state + epoch counter
-    (anomod.utils.checkpoint) every 50 epochs and at the end; with
-    ``resume=True`` training continues from the saved epoch — the
+    (anomod.utils.checkpoint) every ``save_every`` epochs and at the end;
+    with ``resume=True`` training continues from the saved epoch — the
     checkpoint/resume plane the reference lacks (SURVEY.md §5), wired into
-    the training entry point so an interrupted run loses at most 50
-    epochs."""
+    the training entry point so an interrupted run loses at most
+    ``save_every`` epochs (``save_every <= 0`` = final save only)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -334,7 +335,7 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
         params, opt_state, loss = step(params, opt_state, batch)
         if verbose and ep % 20 == 0:
             print(f"epoch {ep}: loss {float(loss):.4f}")
-        if (ep + 1) % 50 == 0:
+        if save_every > 0 and (ep + 1) % save_every == 0:
             _save(ep + 1)
             last_saved = ep + 1
     if start_ep < epochs and last_saved != epochs:

@@ -146,25 +146,25 @@ def test_train_rca_checkpoint_resume(tmp_path):
 
     ck = tmp_path / "ck"
     kwargs = dict(testbed="TT", model_name="gcn", train_seeds=range(2),
-                  eval_seeds=range(100, 101), n_traces=12)
-    train_rca(epochs=60, checkpoint_dir=ck, **kwargs)
-    # saved at epoch 50 (periodic) and 60 (final); final wins
+                  eval_seeds=range(100, 101), n_traces=12, save_every=10)
+    train_rca(epochs=12, checkpoint_dir=ck, **kwargs)
+    # saved at epoch 10 (periodic) and 12 (final); final wins
     import json
-    assert json.loads((ck / "meta.json").read_text())["step"] == 60
-    r = train_rca(epochs=80, checkpoint_dir=ck, resume=True, **kwargs)
-    assert json.loads((ck / "meta.json").read_text())["step"] == 80
+    assert json.loads((ck / "meta.json").read_text())["step"] == 12
+    r = train_rca(epochs=16, checkpoint_dir=ck, resume=True, **kwargs)
+    assert json.loads((ck / "meta.json").read_text())["step"] == 16
     assert 0.0 <= r.top1 <= 1.0
     # a no-op resume (target epochs already reached) must not rewind the
     # completed-epoch counter
-    train_rca(epochs=60, checkpoint_dir=ck, resume=True, **kwargs)
-    assert json.loads((ck / "meta.json").read_text())["step"] == 80
+    train_rca(epochs=12, checkpoint_dir=ck, resume=True, **kwargs)
+    assert json.loads((ck / "meta.json").read_text())["step"] == 16
     # model / testbed mismatches are rejected
     with pytest.raises(ValueError, match="model"):
-        train_rca(epochs=80, model_name="gat", testbed="TT",
+        train_rca(epochs=16, model_name="gat", testbed="TT",
                   train_seeds=range(2), eval_seeds=range(100, 101),
                   n_traces=12, checkpoint_dir=ck, resume=True)
     with pytest.raises(ValueError, match="testbed"):
-        train_rca(epochs=80, model_name="gcn", testbed="SN",
+        train_rca(epochs=16, model_name="gcn", testbed="SN",
                   train_seeds=range(2), eval_seeds=range(100, 101),
                   n_traces=12, checkpoint_dir=ck, resume=True)
     # resume with no checkpoint yet starts fresh instead of crashing

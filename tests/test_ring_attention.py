@@ -64,7 +64,7 @@ def test_trace_transformer_forward():
 @pytest.mark.slow
 def test_transformer_rca_end_to_end():
     from anomod.rca import train_rca
-    r = train_rca("SN", "transformer", train_seeds=range(3),
-                  eval_seeds=range(100, 102), epochs=120, n_traces=40)
+    r = train_rca("SN", "transformer", train_seeds=range(2),
+                  eval_seeds=range(100, 102), epochs=60, n_traces=32)
     assert r.top1 >= 0.8
     assert r.detection_auc >= 0.9
