@@ -17,6 +17,9 @@ for i in $(seq 1 200); do
     rc1=$?
     ANOMOD_BENCH_PLATFORM=tpu ANOMOD_BENCH_KERNEL=xla timeout 600 python bench.py 20000
     rc2=$?
+    # Mosaic-compiled kernel parity at the current tree (writes its own
+    # bench_runs/ record via the tpu_tests conftest)
+    timeout 600 python -m pytest tpu_tests/ -q
     after=$(ls bench_runs/*_tpu.json 2>/dev/null | wc -l)
     new=$((after - before))
     echo "=== capture rc: pallas=$rc1 xla=$rc2; new TPU records: $new ==="
