@@ -11,7 +11,10 @@ as shardings + psum instead of explicit sends.
 The multi-process path is exercised for real by ``tests/test_multihost.py``,
 which launches two coordinator-connected CPU processes (4 virtual devices
 each), builds the hybrid (dcn=2, data=4) mesh, and runs psum + HLL
-register-merge collectives across the process boundary.
+register-merge collectives AND a dp-sharded GCN training step (each
+process stages only its half of the batch; the gradient psum crosses the
+process boundary; both replicas must agree bit-for-bit post-update)
+across the process boundary.
 """
 
 from __future__ import annotations

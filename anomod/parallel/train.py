@@ -36,7 +36,7 @@ def _param_spec(path, arr, mesh):
     model axis are column-sharded (tensor parallelism).  Everything else is
     replicated."""
     from jax.sharding import PartitionSpec as P
-    m = mesh.shape["model"]
+    m = mesh.shape.get("model", 1)  # dp-only meshes (e.g. hybrid (dcn, data))
     if m > 1 and hasattr(arr, "ndim"):
         keys = "/".join(str(getattr(k, "key", k)) for k in path)
         in_expert = "MoEBlock" in keys and "router" not in keys
@@ -47,33 +47,35 @@ def _param_spec(path, arr, mesh):
     return P()
 
 
-def make_distributed_train_step(model_name: str, sample_batch: dict, mesh):
-    """Returns (params, opt_state, step_fn) with sharded placements.
+def make_distributed_train_step(model_name: str, sample_batch: dict, mesh,
+                                stage: str = "global"):
+    """Returns (params, opt_state, step_fn, put_batch) with sharded
+    placements.
 
     ``sample_batch``: stacked numpy batch from anomod.rca._stack; its leading
-    (experiment) axis is the dp axis and must divide mesh.shape['data'].
+    (experiment) axis is the dp axis and must divide the product of the
+    mesh's dp axes (every axis except ``model`` — a single-host
+    ``(data, model)`` mesh and the multi-host hybrid ``(dcn, data)`` mesh
+    both work; params shard over ``model`` only when that axis exists).
+
+    ``stage`` selects how ``put_batch`` places data: "global" (every
+    process passes the full global batch) or "process-local" (each process
+    passes only ITS rows of the dp axis — the multi-host staging pattern,
+    via ``jax.make_array_from_process_local_data``).
     """
     import jax
     import jax.numpy as jnp
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from anomod.rca import _apply_model, make_model, rca_loss
+    if stage not in ("global", "process-local"):
+        raise ValueError(f"unknown staging mode {stage!r}")
+
+    from anomod.rca import _apply_model, init_params, make_model, rca_loss
 
     model = make_model(model_name)
     sample0 = {k: v[0] for k, v in sample_batch.items()}
-    rng = jax.random.PRNGKey(0)
-    if model_name == "gcn":
-        params = model.init(rng, sample0["x"], jnp.asarray(sample0["adj"]))
-    elif model_name in ("temporal", "lru", "transformer", "moe"):
-        W = sample0["x_t"].shape[1]
-        fused = np.concatenate(
-            [sample0["x_t"], np.repeat(sample0["x"][:, None, :], W, axis=1)],
-            axis=-1)
-        params = model.init(rng, fused, jnp.asarray(sample0["adj"]))
-    else:
-        params = model.init(rng, sample0["x"], sample0["edge_src"],
-                            sample0["edge_dst"], sample0["edge_mask"])
+    params = init_params(model_name, model, sample0, jax.random.PRNGKey(0))
 
     tx = optax.adamw(1e-3)
     opt_state = tx.init(params)
@@ -82,7 +84,8 @@ def make_distributed_train_step(model_name: str, sample_batch: dict, mesh):
         lambda p, a: NamedSharding(mesh, _param_spec(p, a, mesh)), params)
     opt_shardings = jax.tree_util.tree_map_with_path(
         lambda p, a: NamedSharding(mesh, _param_spec(p, a, mesh)), opt_state)
-    batch_sharding = {k: NamedSharding(mesh, P("data"))
+    dp_axes = tuple(a for a in mesh.axis_names if a != "model")
+    batch_sharding = {k: NamedSharding(mesh, P(dp_axes))
                       for k in sample_batch}
 
     params = jax.device_put(params, param_shardings)
@@ -99,6 +102,10 @@ def make_distributed_train_step(model_name: str, sample_batch: dict, mesh):
         return optax.apply_updates(params, updates), opt_state, loss
 
     def put_batch(batch_np: dict):
+        if stage == "process-local":
+            return {k: jax.make_array_from_process_local_data(
+                        batch_sharding[k], np.asarray(v))
+                    for k, v in batch_np.items()}
         return {k: jax.device_put(jnp.asarray(v), batch_sharding[k])
                 for k, v in batch_np.items()}
 

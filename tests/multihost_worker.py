@@ -3,12 +3,17 @@
 Launched by tests/test_multihost.py as
 ``python tests/multihost_worker.py <process_id> <num_processes> <port>``.
 Each worker pins 4 virtual CPU devices, joins the jax.distributed
-coordinator, builds the hybrid (dcn, data) mesh, and runs two cross-process
-collectives:
+coordinator, builds the hybrid (dcn, data) mesh, and runs three
+cross-process exercises:
 
 - a psum over both mesh axes (the gradient/sketch-state reduction shape),
 - an HLL register pmax-merge where each process observes a disjoint item
-  range (the distinct-count plane of the replay pipeline, merged over DCN).
+  range (the distinct-count plane of the replay pipeline, merged over DCN),
+- a full GCN training step with the batch dp-sharded over (dcn, data) and
+  replicated params: each process stages only ITS half of the batch, XLA
+  derives the cross-process gradient psum from the shardings — the
+  multi-host analog of the reference's per-worker collection + merge, for
+  training.
 
 Prints one ``MHRESULT {json}`` line; the parent asserts both processes
 produce identical, correct values.
@@ -69,6 +74,25 @@ def main() -> int:
         process_local_array(mesh, spec, per_shard)))
     est = float(hll_estimate(merged))
 
+    # --- dp training step across the process boundary -------------------
+    # THE shared distributed step (anomod.parallel.train), on the hybrid
+    # mesh with process-local staging: each process passes only its rows.
+    from anomod.parallel.train import make_distributed_train_step
+    from anomod.rca import _stack, build_dataset
+
+    samples, _ = build_dataset("TT", seeds=[0], n_traces=8, n_windows=4)
+    n_batch = 2 * n_global                      # dp axis | global devices
+    stacked = _stack((samples * ((n_batch // len(samples)) + 1))[:n_batch])
+    params, opt_state, train_step, put_batch = make_distributed_train_step(
+        "gcn", stacked, mesh, stage="process-local")
+    rows = slice(pid * (n_batch // nproc), (pid + 1) * (n_batch // nproc))
+    batch = put_batch({k: v[rows] for k, v in stacked.items()})
+    params, opt_state, loss = train_step(params, opt_state, batch)
+    loss = float(replicated_value(loss))
+    leaf0 = sorted(jax.tree_util.tree_leaves_with_path(params),
+                   key=lambda kv: str(kv[0]))[0][1]
+    param_sum = float(np.sum(replicated_value(leaf0)))
+
     print("MHRESULT " + json.dumps({
         "pid": pid,
         "process_count": jax.process_count(),
@@ -77,6 +101,8 @@ def main() -> int:
         "expected_psum": float(sum(range(n_global))),
         "hll_estimate": est,
         "true_distinct": n_global * 500,
+        "train_loss": loss,
+        "param_sum": param_sum,
     }), flush=True)
     return 0
 

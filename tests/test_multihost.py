@@ -51,6 +51,8 @@ def test_two_process_hybrid_mesh_collectives():
         assert lines, f"no MHRESULT line in: {out}"
         results.append(json.loads(lines[0][len("MHRESULT "):]))
 
+    import math
+
     for r in results:
         assert r["process_count"] == 2
         assert r["global_devices"] == 8
@@ -59,6 +61,13 @@ def test_two_process_hybrid_mesh_collectives():
         # merged HLL sees all 8 disjoint ranges (~2% p=10 error)
         assert r["hll_estimate"] == pytest.approx(r["true_distinct"],
                                                   rel=0.05)
-    # replicated results are identical on both hosts
+        # the dp train step across the process boundary produced a real
+        # finite loss and updated params
+        assert math.isfinite(r["train_loss"]) and r["train_loss"] > 0
+        assert math.isfinite(r["param_sum"])
+    # replicated results are identical on both hosts: the collectives AND
+    # the post-update model state (same gradients => same params)
     assert results[0]["psum"] == results[1]["psum"]
     assert results[0]["hll_estimate"] == results[1]["hll_estimate"]
+    assert results[0]["train_loss"] == results[1]["train_loss"]
+    assert results[0]["param_sum"] == results[1]["param_sum"]
