@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Pallas replay-kernel block-size sweep on the live TPU.
+
+Evidences the docs claim that throughput is flat (within a few %) across
+block sizes 1024-8192 with a committed bench_runs/ record per sweep —
+docs/BENCHMARKS.md cites the record instead of prose.  Also captures the
+XLA scan path on the same staged corpus for the kernel-vs-XLA ratio.
+
+Run manually when the tunnel is up: ``python scripts/bench_block_sweep.py``.
+Exits non-zero without touching the backend if no TPU is reachable (probe
+with a hard deadline, same recipe as bench.py).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from anomod.utils.platform import probe_device_platform
+
+    plat, diag = probe_device_platform()
+    if plat != "tpu":
+        print(json.dumps({"error": f"no TPU backend ({diag})"}))
+        return 2
+
+    import jax
+    import numpy as np
+
+    from anomod import labels, synth
+    from anomod.ops.pallas_replay import make_pallas_replay_fn
+    from anomod.provenance import capture_record, write_capture
+    from anomod.replay import (ReplayConfig, measure_throughput,
+                               stage_columns, stage_pallas_planes)
+    from anomod.schemas import concat_span_batches
+
+    batch = concat_span_batches([
+        synth.generate_spans(l, n_traces=2_000)
+        for l in labels.labels_for_testbed("TT")])
+    cfg = ReplayConfig(n_services=batch.n_services)
+    chunks, n = stage_columns(batch, cfg)
+    sid_np, planes_np = stage_pallas_planes(chunks)
+    replicate = 64
+    sid = jax.device_put(np.asarray(sid_np))
+    planes = jax.device_put(np.asarray(planes_np))
+
+    points = []
+    for block in (1024, 2048, 4096, 8192):
+        fn = make_pallas_replay_fn(cfg.sw, cfg.n_hist_buckets, block=block,
+                                   inner_repeats=replicate)
+        out = fn(sid, planes)
+        jax.block_until_ready(out)          # compile + warm
+        walls = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(sid, planes)
+            np.asarray(out[:1])             # host read-back barrier
+            walls.append(time.perf_counter() - t0)
+        wall = sorted(walls)[1]
+        points.append({"block": block,
+                       "spans_per_sec": round(n * replicate / wall, 1),
+                       "wall_s": round(wall, 4),
+                       "raw_wall_s": [round(w, 4) for w in walls]})
+        print(json.dumps(points[-1]))
+
+    xla = measure_throughput(batch, cfg, repeats=3, replicate=replicate,
+                             kernel="xla")
+    best = max(p["spans_per_sec"] for p in points)
+    worst = min(p["spans_per_sec"] for p in points)
+    rec = capture_record(
+        "pallas_block_sweep", best, "spans/sec/chip",
+        device=str(jax.devices()[0]), n_spans=n * replicate,
+        points=points, flatness=round(worst / best, 4),
+        xla_spans_per_sec=round(xla.spans_per_sec, 1),
+        xla_raw_wall_s=[round(w, 4) for w in xla.raw_wall_s])
+    path = write_capture(rec)
+    print(json.dumps({"capture_file": path, "best": best,
+                      "flatness": rec["flatness"],
+                      "vs_xla": round(best / xla.spans_per_sec, 3)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
