@@ -43,11 +43,9 @@ def test_replay_percentiles_auto_uses_kernel_on_tpu():
     assert (auto[nonzero, 1] >= auto[nonzero, 0]).all()
 
 
-def test_tdigest_featurize_microbench_kernel_vs_jax():
-    """Mosaic kernel vs the XLA one-hot build on identical staged lanes at
-    a production-sized digest plane; records both walls as provenance.
-    The kernel must at least match the XLA path (its reason to exist is
-    deleting the [R, L, K] broadcast the XLA build materializes)."""
+def _featurize_micro(n, S, lane_rng_seed, metric, floor):
+    """Shared engine: build identical staged lanes, time the Mosaic kernel
+    vs the XLA one-hot build, check parity, write a provenance record."""
     import jax
     import jax.numpy as jnp
 
@@ -55,8 +53,7 @@ def test_tdigest_featurize_microbench_kernel_vs_jax():
     from anomod.ops.tdigest import segment_pad, tdigest_build
     from anomod.provenance import capture_record, write_capture
 
-    rng = np.random.default_rng(5)
-    n, S = 1_000_000, 2976          # one TT replay plane: 93 services x 32 win
+    rng = np.random.default_rng(lane_rng_seed)
     seg = rng.integers(0, S, n).astype(np.int32)
     vals = np.log1p(rng.lognormal(10.0, 1.0, n)).astype(np.float32)
     padded, weights = segment_pad(vals, seg, S, pad_to=128)
@@ -84,7 +81,7 @@ def test_tdigest_featurize_microbench_kernel_vs_jax():
     np.testing.assert_allclose(mean, ref.mean, rtol=2e-3, atol=1e-2)
 
     rec = capture_record(
-        "tdigest_featurize_micro", round(n / pal_wall, 1), "values/sec",
+        metric, round(n / pal_wall, 1), "values/sec",
         device=str(jax.devices()[0]), kernel="pallas", n_values=n,
         n_segments=S, lane_len=L, k=k,
         pallas_wall_s=round(pal_wall, 5),
@@ -93,4 +90,22 @@ def test_tdigest_featurize_microbench_kernel_vs_jax():
         xla_raw_wall_s=[round(t, 5) for t in jax_raw],
         speedup_vs_xla=round(jax_wall / pal_wall, 3))
     write_capture(rec)
-    assert pal_wall <= jax_wall * 1.2, (pal_wall, jax_wall)
+    assert pal_wall <= jax_wall * floor, (pal_wall, jax_wall)
+
+
+def test_tdigest_featurize_microbench_kernel_vs_jax():
+    """Production-sized digest plane (one TT replay plane: 93 services x
+    32 windows, ~336 values/lane).  The kernel must at least match the XLA
+    path (its reason to exist is deleting the [R, L, K] broadcast the XLA
+    build materializes)."""
+    _featurize_micro(n=1_000_000, S=2976, lane_rng_seed=5,
+                     metric="tdigest_featurize_micro", floor=1.2)
+
+
+def test_tdigest_featurize_large_lanes():
+    """Skewed plane: few segments with long lanes (L_max ~8k), where the
+    XLA build's [R, L, K] intermediate is largest relative to useful work
+    — the regime the kernel's docs claim as its win; the committed record
+    carries the measured ratio either way."""
+    _featurize_micro(n=2_000_000, S=256, lane_rng_seed=6,
+                     metric="tdigest_featurize_large_lanes", floor=1.2)
