@@ -135,6 +135,14 @@ def main(argv=None) -> int:
                           help="also report corpus-wide p50/p95/p99 from the "
                                "per-segment t-digest plane (Mosaic kernel on "
                                "TPU, host build elsewhere)")
+    p_replay.add_argument("--devices", type=int, default=0,
+                          help="shard the stream over an N-device 1-D mesh "
+                               "(shard_map + psum merge over ICI) instead of "
+                               "the single-chip path; requires >= N attached "
+                               "devices (use ANOMOD_PLATFORM=cpu + "
+                               "ANOMOD_CPU_DEVICES=N for a virtual mesh). "
+                               "--percentiles still computes its digest "
+                               "plane in a separate single-chip pass")
 
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
@@ -451,14 +459,23 @@ def main(argv=None) -> int:
             synth.generate_spans(l, n_traces=args.traces)
             for l in labels.labels_for_testbed(args.testbed)])
         cfg = ReplayConfig(n_services=batch.n_services)
-        r = measure_throughput(batch, cfg, replicate=args.replicate,
-                               kernel=args.kernel)
+        if args.devices:
+            if args.replicate != 1:
+                parser.error("--replicate is not supported with --devices")
+            from anomod.parallel import make_mesh, sharded_throughput
+            mesh = make_mesh(args.devices)
+            r = sharded_throughput(batch, mesh, cfg, kernel=args.kernel)
+        else:
+            r = measure_throughput(batch, cfg, replicate=args.replicate,
+                                   kernel=args.kernel)
         out = {
             "n_spans": r.n_spans, "wall_s": round(r.wall_s, 4),
             "spans_per_sec": round(r.spans_per_sec, 1),
             "compile_s": round(r.compile_s, 2),
             "kernel": r.kernel,
         }
+        if args.devices:
+            out["devices"] = int(mesh.devices.size)
         if args.percentiles:
             import numpy as np
 

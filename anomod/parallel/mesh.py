@@ -28,11 +28,19 @@ def pvary_compat(x, axis_names: Sequence[str]):
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "data"):
-    """1-D device mesh over the first n devices (defaults to all)."""
+    """1-D device mesh over the first n devices (defaults to all).
+
+    Requesting more devices than are attached is an error, not a silent
+    shrink — a throughput record labeled "8 devices" must have run on 8.
+    """
     import jax
     from jax.sharding import Mesh
     devs = jax.devices()
     if n_devices is not None:
+        if not 0 < n_devices <= len(devs):
+            raise ValueError(
+                f"requested a {n_devices}-device mesh but "
+                f"{len(devs)} device(s) are attached")
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis,))
 
