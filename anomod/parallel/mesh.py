@@ -45,11 +45,15 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "data"):
     return Mesh(np.array(devs), (axis,))
 
 
-def shard_chunks(chunks: dict, n_shards: int) -> dict:
+def shard_chunks(chunks: dict, n_shards: int, dead_sid: int) -> dict:
     """Split the leading (chunk) dim across shards: [N, C] -> [D, N/D, C].
 
     Pads the chunk count to a multiple of n_shards with dead chunks
-    (sid = padding id, valid = 0) so every shard gets identical shapes.
+    (sid = ``dead_sid``, valid = 0) so every shard gets identical shapes.
+    ``dead_sid`` must be the config's padding id (``cfg.sw``) — inferring
+    it from the data (the old ``sid.max()`` heuristic) silently picked a
+    REAL segment whenever the corpus length was an exact chunk multiple,
+    and the HLL plane then counted the fill rows' phantom trace id.
     """
     out = {}
     n_chunks = next(iter(chunks.values())).shape[0]
@@ -58,7 +62,7 @@ def shard_chunks(chunks: dict, n_shards: int) -> dict:
         if pad:
             fill = np.zeros((pad,) + v.shape[1:], v.dtype)
             if k == "sid":
-                fill[:] = v.max()  # dead segment id (== cfg.sw)
+                fill[:] = dead_sid
             v = np.concatenate([v, fill], axis=0)
         out[k] = v.reshape(n_shards, -1, *v.shape[1:])
     return out

@@ -20,7 +20,7 @@ from anomod.schemas import SpanBatch
 
 
 def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
-                           kernel: str = "xla"):
+                           kernel: str = "xla", with_hll: bool = False):
     """Pod-sharded replay over the mesh's data axis.
 
     ``kernel`` selects the per-shard aggregation: "xla" scans chunks with
@@ -29,6 +29,11 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
     shard and runs the fused kernel (anomod.ops.pallas_replay — the
     single-chip fast path, composed with shard_map + psum; interpret mode
     off-TPU).  Both merge shard states over ICI with one psum.
+
+    ``with_hll`` adds the per-service distinct-trace HLL plane: each shard
+    scatter-maxes its trace ids into [n_services, 2^p] registers, merged
+    over ICI with one ``pmax`` (register-exact — the sketch-state
+    allreduce BASELINE.json mandates, in the production replay path).
     """
     import jax
     import jax.numpy as jnp
@@ -43,6 +48,14 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
         pfn = make_pallas_replay_fn(cfg.sw, cfg.n_hist_buckets,
                                     block=pallas_block(cfg.chunk_size),
                                     interpret=interpret)
+
+    def _shard_hll(chunks):
+        # whole-shard register build: one scatter-max over the flat shard
+        # through the shared plane definition (anomod.replay)
+        from anomod.replay import hll_scatter_update
+        regs = jnp.zeros((cfg.n_services, cfg.hll_m), jnp.int32)
+        return hll_scatter_update(regs, chunks["sid"].reshape(-1),
+                                  chunks["tid"].reshape(-1), cfg)
 
     def shard_body(chunks):  # runs per-device on its [N/D, C] shard
         if kernel == "pallas":
@@ -59,9 +72,14 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
                                  (axis,)),
                 hist=pvary_compat(jnp.zeros((SW, H), jnp.float32), (axis,)))
             state, _ = jax.lax.scan(make_chunk_step(cfg), state, chunks)
+        hll = None
+        if with_hll:
+            from anomod.parallel.collectives import pmax_merge_hll
+            hll = pmax_merge_hll(_shard_hll(chunks), axis)
         # merge shard states over ICI
         return ReplayState(agg=jax.lax.psum(state.agg, axis),
-                           hist=jax.lax.psum(state.hist, axis))
+                           hist=jax.lax.psum(state.hist, axis),
+                           hll=hll)
 
     from jax import shard_map
     # the pallas kernel's internal constants (iota tiles, zero-init) carry
@@ -75,7 +93,9 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
                    in_specs=({k: P(axis) for k in
                               ("sid", "dur", "dur_raw", "err", "s5", "valid",
                                "tid")},),
-                   out_specs=ReplayState(agg=P(), hist=P()), **kwargs)
+                   out_specs=ReplayState(agg=P(), hist=P(),
+                                         hll=P() if with_hll else None),
+                   **kwargs)
     return jax.jit(fn)
 
 
@@ -88,7 +108,7 @@ def stage_sharded(batch: SpanBatch, mesh, cfg: ReplayConfig):
 
     n_dev = mesh.devices.size
     chunks_np, n = stage_columns(batch, cfg)
-    sharded = shard_chunks(chunks_np, n_dev)
+    sharded = shard_chunks(chunks_np, n_dev, dead_sid=cfg.sw)
     # flatten back to [N_total, C] with device-major order for sharding
     flat = {k: v.reshape(-1, v.shape[-1]) for k, v in sharded.items()}
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -83,6 +83,23 @@ def stage_columns(batch: SpanBatch, cfg: ReplayConfig, t0_us: Optional[int] = No
     return {k: v.reshape(n_chunks, cfg.chunk_size) for k, v in cols.items()}, n
 
 
+def hll_scatter_update(regs, sid, tid, cfg: ReplayConfig):
+    """Scatter-max trace-id ranks into per-service HLL registers — the ONE
+    definition of the distinct-trace plane, shared by the single-chip chunk
+    step and the pod-sharded whole-shard build.  Routes through
+    anomod.ops.hll.hll_add (one hash pipeline in the repo); rows with
+    sid >= cfg.sw are padding and go to an extra dead lane, dropped."""
+    import jax.numpy as jnp
+
+    from anomod.ops.hll import hll_add
+
+    svc = jnp.clip(sid // cfg.n_windows, 0, cfg.n_services - 1)
+    lane = jnp.where(sid < cfg.sw, svc, cfg.n_services)
+    regs_ext = jnp.concatenate(
+        [regs, jnp.zeros((1, cfg.hll_m), regs.dtype)], axis=0)
+    return hll_add(regs_ext, tid, p=cfg.hll_p, lane=lane, xp=jnp)[:-1]
+
+
 def make_chunk_step(cfg: ReplayConfig, with_hll: bool = False):
     """The per-chunk aggregation step shared by the single-chip scan and the
     pod-sharded replay (one definition so the split-precision scheme can't
@@ -91,22 +108,11 @@ def make_chunk_step(cfg: ReplayConfig, with_hll: bool = False):
     import jax
     import jax.numpy as jnp
 
-    from anomod.ops.hll import _avalanche32, _clz32
-
     SW = cfg.sw
     H = cfg.n_hist_buckets
-    M = cfg.hll_m
 
     def hll_update(regs, chunk):
-        sid = chunk["sid"]
-        svc = sid // cfg.n_windows                       # [C]
-        h = _avalanche32(chunk["tid"].astype(jnp.uint32), jnp)
-        bucket = (h >> jnp.uint32(32 - cfg.hll_p)).astype(jnp.int32)
-        h2 = _avalanche32(h ^ jnp.uint32(0x9E3779B9), jnp)
-        rank = jnp.minimum(_clz32(h2, jnp) + 1, jnp.int32(32))
-        rank = jnp.where(sid < SW, rank, 0)              # dead rows contribute 0
-        flat = jnp.clip(svc, 0, cfg.n_services - 1) * M + bucket
-        return regs.reshape(-1).at[flat].max(rank).reshape(cfg.n_services, M)
+        return hll_scatter_update(regs, chunk["sid"], chunk["tid"], cfg)
 
     def chunk_step(state: ReplayState, chunk):
         sid = chunk["sid"]                    # [C] int32, SW = padding

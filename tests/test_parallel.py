@@ -27,9 +27,14 @@ def test_mesh_has_8_virtual_devices():
 def test_shard_chunks_preserves_rows(batch):
     cfg = ReplayConfig(n_services=batch.n_services, chunk_size=512)
     chunks, n = stage_columns(batch, cfg)
-    sh = shard_chunks(chunks, 8)
+    sh = shard_chunks(chunks, 8, dead_sid=cfg.sw)
     assert sh["sid"].shape[0] == 8
     assert int(sh["valid"].sum()) == n
+    # fill chunks carry the DEAD segment id, never a real one (the old
+    # sid.max() heuristic leaked a real sid when the corpus length was an
+    # exact chunk multiple — the HLL plane then counted phantom traces)
+    pad_rows = sh["sid"].reshape(-1, sh["sid"].shape[-1])[chunks["sid"].shape[0]:]
+    assert pad_rows.size == 0 or (pad_rows == cfg.sw).all()
 
 
 def test_sharded_replay_matches_numpy(batch):
@@ -43,7 +48,7 @@ def test_sharded_replay_matches_numpy(batch):
     from anomod.parallel.replay import make_sharded_replay_fn
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
-    sharded = shard_chunks(chunks, 8)
+    sharded = shard_chunks(chunks, 8, dead_sid=cfg.sw)
     flat = {k: v.reshape(-1, v.shape[-1]) for k, v in sharded.items()}
     dev = {k: jax.device_put(v, NamedSharding(mesh, P("data")))
            for k, v in flat.items()}
@@ -106,3 +111,34 @@ def test_seqpar_linear_recurrence_matches_single_device():
     fn = make_seqpar_recurrence(mesh)
     out = np.asarray(fn(jnp.asarray(xs), jnp.asarray(decay)))
     np.testing.assert_allclose(out, seq, rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_replay_hll_plane(batch):
+    """with_hll=True: the sharded distinct-trace registers (per-shard
+    scatter-max + pmax over ICI) are register-EXACT vs the single-chip
+    with_hll replay, for both per-shard kernels, and the estimates track
+    the true distinct-trace counts."""
+    from anomod.ops.hll import hll_estimate
+    from anomod.parallel.replay import make_sharded_replay_fn, stage_sharded
+    from anomod.replay import make_replay_fn
+
+    cfg = ReplayConfig(n_services=batch.n_services, chunk_size=512)
+    chunks, n = stage_columns(batch, cfg)
+    single = make_replay_fn(cfg, with_hll=True)(
+        {k: np.asarray(v) for k, v in chunks.items()})
+    ref_regs = np.asarray(single.hll)
+    assert ref_regs.shape == (cfg.n_services, cfg.hll_m)
+
+    mesh = make_mesh()
+    dev, _ = stage_sharded(batch, mesh, cfg)
+    for kernel in ("xla", "pallas"):
+        out = make_sharded_replay_fn(cfg, mesh, kernel=kernel,
+                                     with_hll=True)(dev)
+        np.testing.assert_array_equal(np.asarray(out.hll), ref_regs,
+                                      err_msg=kernel)
+    # estimates track the exact per-service distinct-trace counts
+    est = hll_estimate(ref_regs)
+    svc_of_span = batch.service
+    for s in np.unique(svc_of_span)[:5]:
+        true = len(np.unique(batch.trace[svc_of_span == s]))
+        assert abs(est[s] - true) / max(true, 1) < 0.25, (s, est[s], true)
