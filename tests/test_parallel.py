@@ -142,3 +142,39 @@ def test_sharded_replay_hll_plane(batch):
     for s in np.unique(svc_of_span)[:5]:
         true = len(np.unique(batch.trace[svc_of_span == s]))
         assert abs(est[s] - true) / max(true, 1) < 0.25, (s, est[s], true)
+
+
+def test_sharded_hll_exact_chunk_multiple_no_phantom():
+    """Regression for the shard_chunks dead-sid bug: when the corpus length
+    is an exact chunk multiple (stage_columns adds NO padding rows) and the
+    chunk count doesn't divide the mesh, the shard-padding chunks must not
+    leak a phantom trace id into the HLL registers."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from anomod.parallel.replay import make_sharded_replay_fn
+    from anomod.replay import make_replay_fn
+
+    cfg = ReplayConfig(n_services=4, n_windows=2, chunk_size=128, hll_p=6)
+    rng = np.random.default_rng(3)
+    n = cfg.chunk_size * 3            # exact multiple, 3 chunks on 8 devices
+    chunks = {
+        "sid": rng.integers(0, cfg.sw, n).astype(np.int32),
+        "tid": rng.integers(1, 50, n).astype(np.int32),
+        "dur": rng.uniform(1, 5, n).astype(np.float32),
+        "dur_raw": rng.uniform(10, 50, n).astype(np.float32),
+        "err": np.zeros(n, np.float32),
+        "s5": np.zeros(n, np.float32),
+        "valid": np.ones(n, np.float32),
+    }
+    chunks = {k: v.reshape(3, cfg.chunk_size) for k, v in chunks.items()}
+    single = make_replay_fn(cfg, with_hll=True)(chunks)
+
+    mesh = make_mesh()
+    sharded = shard_chunks(chunks, 8, dead_sid=cfg.sw)
+    flat = {k: v.reshape(-1, v.shape[-1]) for k, v in sharded.items()}
+    dev = {k: jax.device_put(v, NamedSharding(mesh, P("data")))
+           for k, v in flat.items()}
+    out = make_sharded_replay_fn(cfg, mesh, with_hll=True)(dev)
+    np.testing.assert_array_equal(np.asarray(out.hll),
+                                  np.asarray(single.hll))
