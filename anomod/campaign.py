@@ -186,20 +186,40 @@ def run_campaign(testbed: str, out_dir: Path,
                  n_traces: int = 200, seed: Optional[int] = None) -> List[str]:
     """Generate + archive experiments in the reference tree shape.
 
+    The campaign traces ITSELF (generate/materialize spans per experiment,
+    anomod.utils.tracing) and archives the trace as
+    ``<out>/campaign_trace_<testbed>.json`` in Jaeger shape — the
+    framework-level analog of the reference instrumenting its own toolchain
+    with Jaeger/SkyWalking, loadable back through anomod.io.sn_traces.  The
+    trace is written even when a run fails partway (that is when per-stage
+    timings matter most).
+
     Returns the list of archived experiment dir basenames.
     """
+    from anomod.utils.tracing import Tracer
+
     out_dir = Path(out_dir)
     root = out_dir / f"{testbed}_data"
     chosen = [labels_mod.label_for(e) for e in experiments] if experiments \
         else labels_mod.labels_for_testbed(testbed)
     done = []
-    for label in chosen:
-        if label is None or label.testbed != testbed:
-            raise ValueError(f"bad experiment for {testbed}: {label}")
-        exp = synth.generate_experiment(label, n_traces=n_traces, seed=seed)
-        if testbed == "SN":
-            _materialize_sn(exp, label, root)
-        else:
-            _materialize_tt(exp, label, root)
-        done.append(label.experiment)
+    tracer = Tracer(service=f"anomod-campaign-{testbed}")
+    try:
+        with tracer.span(f"campaign[{testbed}]"):
+            for label in chosen:
+                if label is None or label.testbed != testbed:
+                    raise ValueError(f"bad experiment for {testbed}: {label}")
+                with tracer.span(f"experiment[{label.experiment}]"):
+                    with tracer.span("generate"):
+                        exp = synth.generate_experiment(
+                            label, n_traces=n_traces, seed=seed)
+                    with tracer.span("materialize"):
+                        if testbed == "SN":
+                            _materialize_sn(exp, label, root)
+                        else:
+                            _materialize_tt(exp, label, root)
+                done.append(label.experiment)
+    finally:
+        out_dir.mkdir(parents=True, exist_ok=True)
+        tracer.dump(out_dir / f"campaign_trace_{testbed}.json")
     return done

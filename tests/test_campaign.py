@@ -33,6 +33,26 @@ def test_campaign_tt_tree_shape(tt_tree):
         assert len(list((root / sub).iterdir())) == 13
 
 
+def test_campaign_self_trace(tt_tree):
+    """The campaign traces itself in Jaeger shape and the artifact loads
+    back through the SN trace loader: one span per experiment with
+    generate/materialize children under the campaign root span."""
+    out, done = tt_tree
+    from anomod.io.sn_traces import load_jaeger_json
+    batch = load_jaeger_json(out / "campaign_trace_TT.json")
+    names = set()
+    import json
+    doc = json.loads((out / "campaign_trace_TT.json").read_text())
+    for s in doc["data"][0]["spans"]:
+        names.add(s["operationName"])
+    assert "campaign[TT]" in names
+    assert sum(1 for n in names if n.startswith("experiment[")) == 13
+    assert {"generate", "materialize"} <= names
+    # loader roundtrip: spans parent-resolve into one rooted trace
+    assert batch.n_spans == 1 + 13 * 3
+    assert (batch.parent == -1).sum() == 1
+
+
 def test_campaign_tt_roundtrip_loaders(tt_tree):
     out, _ = tt_tree
     cfg = Config(data_root=out, synth_on_lfs=False)
