@@ -98,8 +98,13 @@ def main() -> int:
             out["kernel_note"] = ("ANOMOD_BENCH_KERNEL=pallas requires a TPU "
                                   "backend (Mosaic); downgraded to xla")
         cfg = ReplayConfig(n_services=batch.n_services)
-        result = measure_throughput(batch, cfg, repeats=repeats,
-                                    replicate=replicate, kernel=kernel)
+        # ANOMOD_PROFILE_DIR=<dir> wraps the measured dispatches in a
+        # jax.profiler device trace (TensorBoard/Perfetto) for kernel-level
+        # inspection of the replay hot loop on real hardware
+        from anomod.utils.tracing import profile_to
+        with profile_to(os.environ.get("ANOMOD_PROFILE_DIR")):
+            result = measure_throughput(batch, cfg, repeats=repeats,
+                                        replicate=replicate, kernel=kernel)
 
         out.update({
             "value": round(result.spans_per_sec, 1),
