@@ -33,6 +33,16 @@ def ring_allreduce(x, axis: str):
     return acc
 
 
+def reduce_scatter_state(x, axis: str):
+    """psum_scatter: merge shard states AND leave each shard holding only
+    its slice of the result — half the ICI traffic of psum when the
+    consumer is itself sharded over the same axis (the pod-scale pattern
+    for huge [S*W, F] aggregate states: merge once, keep 1/D locally).
+    Call inside shard_map; the axis size must divide the leading dim."""
+    import jax
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+
+
 def pmax_merge_hll(registers, axis: str):
     """Exact HLL merge across shards (call inside shard_map)."""
     import jax

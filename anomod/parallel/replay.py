@@ -20,7 +20,8 @@ from anomod.schemas import SpanBatch
 
 
 def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
-                           kernel: str = "xla", with_hll: bool = False):
+                           kernel: str = "xla", with_hll: bool = False,
+                           merge: str = "replicated"):
     """Pod-sharded replay over the mesh's data axis.
 
     ``kernel`` selects the per-shard aggregation: "xla" scans chunks with
@@ -28,12 +29,18 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
     split-precision scheme to the single-chip path), "pallas" flattens the
     shard and runs the fused kernel (anomod.ops.pallas_replay — the
     single-chip fast path, composed with shard_map + psum; interpret mode
-    off-TPU).  Both merge shard states over ICI with one psum.
+    off-TPU).
 
     ``with_hll`` adds the per-service distinct-trace HLL plane: each shard
     scatter-maxes its trace ids into [n_services, 2^p] registers, merged
     over ICI with one ``pmax`` (register-exact — the sketch-state
     allreduce BASELINE.json mandates, in the production replay path).
+
+    ``merge`` selects the agg/hist reduction: "replicated" (one ``psum``,
+    every device holds the full merged state) or "scattered"
+    (``psum_scatter``: half the ICI traffic, each device keeps only its
+    SW/D slice of the segment axis — the pod-scale mode for aggregate
+    states too large to replicate; requires SW % n_devices == 0).
     """
     import jax
     import jax.numpy as jnp
@@ -41,7 +48,14 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
 
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown replay kernel {kernel!r}")
+    if merge not in ("replicated", "scattered"):
+        raise ValueError(f"unknown merge mode {merge!r}")
     SW, H = cfg.sw, cfg.n_hist_buckets
+    n_dev = int(mesh.shape[axis])
+    if merge == "scattered" and SW % n_dev != 0:
+        raise ValueError(
+            f"merge='scattered' needs SW ({SW}) divisible by the "
+            f"{axis} axis size ({n_dev})")
     if kernel == "pallas":
         from anomod.ops.pallas_replay import make_pallas_replay_fn
         interpret = mesh.devices.ravel()[0].platform != "tpu"
@@ -77,6 +91,11 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
             from anomod.parallel.collectives import pmax_merge_hll
             hll = pmax_merge_hll(_shard_hll(chunks), axis)
         # merge shard states over ICI
+        if merge == "scattered":
+            from anomod.parallel.collectives import reduce_scatter_state
+            return ReplayState(agg=reduce_scatter_state(state.agg, axis),
+                               hist=reduce_scatter_state(state.hist, axis),
+                               hll=hll)
         return ReplayState(agg=jax.lax.psum(state.agg, axis),
                            hist=jax.lax.psum(state.hist, axis),
                            hll=hll)
@@ -89,11 +108,12 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
     # check_vma=False — psum merge semantics are unchanged, only the
     # static checker is off for this variant
     kwargs = {"check_vma": False} if kernel == "pallas" else {}
+    state_spec = P(axis) if merge == "scattered" else P()
     fn = shard_map(shard_body, mesh=mesh,
                    in_specs=({k: P(axis) for k in
                               ("sid", "dur", "dur_raw", "err", "s5", "valid",
                                "tid")},),
-                   out_specs=ReplayState(agg=P(), hist=P(),
+                   out_specs=ReplayState(agg=state_spec, hist=state_spec,
                                          hll=P() if with_hll else None),
                    **kwargs)
     return jax.jit(fn)
