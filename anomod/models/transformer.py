@@ -14,6 +14,8 @@ RCA zoo trained on chaos labels by :mod:`anomod.rca`.
 
 from __future__ import annotations
 
+from typing import Callable
+
 import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
@@ -74,9 +76,14 @@ class ScoreHead(nn.Module):
 
 
 class AttentionBlock(nn.Module):
+    """Pre-LN block; ``attention_fn`` is the [L, H, D]-shaped attention
+    core — :func:`full_attention` single-chip, or a mesh-built
+    sequence-parallel plane (ring / Ulysses) with the SAME semantics and
+    param tree, so trained params are interchangeable across planes."""
     d_model: int
     n_heads: int
     mlp_hidden: int
+    attention_fn: Callable = full_attention
 
     @nn.compact
     def __call__(self, seq):                       # [L, d_model]
@@ -86,8 +93,9 @@ class AttentionBlock(nn.Module):
         qkv = nn.Dense(3 * self.d_model, use_bias=False)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (L, self.n_heads, d_head)
-        attn = full_attention(q.reshape(shape), k.reshape(shape),
-                              v.reshape(shape)).reshape(L, self.d_model)
+        attn = self.attention_fn(
+            q.reshape(shape), k.reshape(shape),
+            v.reshape(shape)).reshape(L, self.d_model)
         seq = seq + nn.Dense(self.d_model)(attn)
         h = nn.LayerNorm()(seq)
         h = nn.Dense(self.mlp_hidden)(h)
@@ -102,6 +110,7 @@ class TraceTransformer(nn.Module):
     n_layers: int = 2
     mlp_hidden: int = 96
     hidden: int = 64
+    attention_fn: Callable = full_attention
 
     @nn.compact
     def __call__(self, x_swf, adj_counts):
@@ -109,5 +118,6 @@ class TraceTransformer(nn.Module):
         seq = TokenEmbed(self.d_model)(x_swf)                  # [S·W, d]
         for _ in range(self.n_layers):
             seq = AttentionBlock(self.d_model, self.n_heads,
-                                 self.mlp_hidden)(seq)
+                                 self.mlp_hidden,
+                                 attention_fn=self.attention_fn)(seq)
         return ScoreHead(S, W, self.hidden)(seq, adj_counts)

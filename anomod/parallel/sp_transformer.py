@@ -1,0 +1,46 @@
+"""Sequence-parallel TraceTransformer forward: the RCA scorer's attention
+core swapped for a mesh-distributed plane, same params.
+
+The single-chip TraceTransformer already computes its attention through
+:func:`anomod.parallel.ring_attention.full_attention`; this builder
+instantiates the SAME module with that core replaced by a ring
+(ppermute K/V rotation) or Ulysses (all_to_all head-scatter) plane over a
+1-D mesh — the long-context path for experiments whose S·W token sequence
+outgrows one chip.  The param tree is identical, so params trained
+single-chip score sequence-parallel unchanged (and vice versa).
+
+Constraints come from the planes: S·W must divide the mesh size; Ulysses
+additionally needs n_heads % n_devices == 0.
+"""
+
+from __future__ import annotations
+
+
+def make_sp_transformer(mesh, model=None, plane: str = "ring"):
+    """Returns ``(sp_model, apply_fn)`` where ``apply_fn(params, x_swf,
+    adj_counts)`` runs the sequence-parallel forward over ``mesh``.
+
+    ``model`` is the single-chip TraceTransformer whose hyperparameters
+    (and trained params) to reuse; defaults to the zoo configuration.
+    """
+    import dataclasses
+
+    import jax
+
+    from anomod.models.transformer import TraceTransformer
+    from anomod.parallel.ring_attention import make_ring_attention
+    from anomod.parallel.ulysses import make_ulysses_attention
+
+    if plane == "ring":
+        attn = make_ring_attention(mesh)
+    elif plane == "ulysses":
+        attn = make_ulysses_attention(mesh)
+    else:
+        raise ValueError(f"unknown sequence-parallel plane {plane!r}")
+    model = model or TraceTransformer()
+    sp_model = dataclasses.replace(model, attention_fn=attn)
+
+    def apply_fn(params, x_swf, adj_counts):
+        return sp_model.apply(params, x_swf, adj_counts)
+
+    return sp_model, jax.jit(apply_fn)
