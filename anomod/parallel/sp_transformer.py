@@ -9,8 +9,8 @@ instantiates the SAME module with that core replaced by a ring
 outgrows one chip.  The param tree is identical, so params trained
 single-chip score sequence-parallel unchanged (and vice versa).
 
-Constraints come from the planes: S·W must divide the mesh size; Ulysses
-additionally needs n_heads % n_devices == 0.
+Constraints come from the planes: the mesh size must divide the S·W token
+count; Ulysses additionally needs n_heads % n_devices == 0.
 """
 
 from __future__ import annotations
@@ -23,8 +23,6 @@ def make_sp_transformer(mesh, model=None, plane: str = "ring"):
     ``model`` is the single-chip TraceTransformer whose hyperparameters
     (and trained params) to reuse; defaults to the zoo configuration.
     """
-    import dataclasses
-
     import jax
 
     from anomod.models.transformer import TraceTransformer
@@ -38,9 +36,5 @@ def make_sp_transformer(mesh, model=None, plane: str = "ring"):
     else:
         raise ValueError(f"unknown sequence-parallel plane {plane!r}")
     model = model or TraceTransformer()
-    sp_model = dataclasses.replace(model, attention_fn=attn)
-
-    def apply_fn(params, x_swf, adj_counts):
-        return sp_model.apply(params, x_swf, adj_counts)
-
-    return sp_model, jax.jit(apply_fn)
+    sp_model = model.clone(attention_fn=attn)
+    return sp_model, jax.jit(sp_model.apply)
