@@ -6,8 +6,9 @@ from anomod.parallel.mesh import make_mesh, shard_chunks
 from anomod.parallel.replay import (make_sharded_replay_fn, stage_sharded,
                                     sharded_throughput)
 from anomod.parallel.ring_attention import make_ring_attention
+from anomod.parallel.sp_transformer import make_sp_transformer
 from anomod.parallel.ulysses import make_ulysses_attention
 
 __all__ = ["make_mesh", "shard_chunks", "make_sharded_replay_fn",
            "stage_sharded", "sharded_throughput", "make_ring_attention",
-           "make_ulysses_attention"]
+           "make_sp_transformer", "make_ulysses_attention"]
