@@ -9,6 +9,17 @@ from anomod.utils.platform import pin_cpu
 
 pin_cpu(8)
 
+import jax
+
+# The suite's wall time is XLA:CPU *compile* time on this single-core box
+# (the computations themselves are tiny).  Skipping the expensive HLO
+# optimization passes cuts the full run ~6:10 -> ~4:00 with all numeric
+# assertions intact — tests verify semantics against numpy oracles, not
+# codegen.  Optimized-pipeline behavior is still exercised where it
+# matters: tpu_tests/ (Mosaic-compiled kernels on the real chip) and the
+# driver's bench/dryrun paths never load this conftest.
+jax.config.update("jax_disable_most_optimizations", True)
+
 
 def make_qkv(L, H, D, seed=0):
     """Shared random q/k/v blocks for the sequence-parallel attention tests
