@@ -14,6 +14,25 @@ import os
 import sys
 
 
+def _probe_backend(args) -> None:
+    """Dead-tunnel guard for the jax-heavy subcommands: probe the device
+    backend out-of-process and fall back to CPU instead of hanging at the
+    first backend touch.  Called AFTER each subcommand's cheap flag
+    validation so usage errors stay instant; ANOMOD_PLATFORM=cpu skips it
+    by pinning up front, ANOMOD_SKIP_PROBE=1 skips it trusting the
+    backend."""
+    if os.environ.get("ANOMOD_PLATFORM", "").strip().lower() == "cpu":
+        return
+    from anomod.utils.platform import ensure_live_backend, env_number
+    # the fallback mesh must be large enough for an explicitly requested
+    # virtual device count (replay --devices N)
+    n_fallback = max(env_number("ANOMOD_CPU_DEVICES", 1),
+                     getattr(args, "devices", None) or 1)
+    note = ensure_live_backend(n_fallback)
+    if "unavailable" in note:
+        print(f"[anomod] {note}", file=sys.stderr)
+
+
 def main(argv=None) -> int:
     # Pre-init platform pin: ANOMOD_PLATFORM=cpu makes every subcommand
     # usable with a dead device tunnel (the container's sitecustomize
@@ -21,8 +40,8 @@ def main(argv=None) -> int:
     # environment hangs forever; only the pre-init jax.config pin sticks —
     # see anomod.utils.platform).
     if os.environ.get("ANOMOD_PLATFORM", "").strip().lower() == "cpu":
-        from anomod.utils.platform import pin_cpu
-        pin_cpu(int(os.environ.get("ANOMOD_CPU_DEVICES", "1") or 1))
+        from anomod.utils.platform import env_number, pin_cpu
+        pin_cpu(env_number("ANOMOD_CPU_DEVICES", 1))
     parser = argparse.ArgumentParser(
         prog="anomod",
         description="TPU-native anomaly-detection & RCA framework (AnoMod capabilities)")
@@ -194,6 +213,8 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd == "detect":
+        if args.backend == "jax":
+            _probe_backend(args)
         from anomod import detect, labels, synth
         from anomod.io import dataset
         if args.from_data:
@@ -230,6 +251,7 @@ def main(argv=None) -> int:
                          "use --shift-severity for the shift sweep")
         if args.sweep == "severity" and args.shift_severity != 0.3:
             parser.error("--shift-severity applies to --sweep shift")
+        _probe_backend(args)
         common = dict(
             testbed=args.testbed, model_names=args.models,
             train_seeds=range(args.train_seeds),
@@ -248,7 +270,13 @@ def main(argv=None) -> int:
         try:
             import jax
 
+            from anomod import quality as _q
             from anomod.provenance import capture_record, write_capture
+            # a sweep that lost its device mid-run and finished on the CPU
+            # failover backend is labeled so (the device string alone would
+            # already read cpu, but the note records *why*)
+            failover = ({"device_failover": _q.LAST_FAILOVER}
+                        if _q.LAST_FAILOVER else {})
             rec = capture_record(
                 f"quality_{args.sweep}_sweep", float(len(pts)), "points",
                 device=str(jax.devices()[0]), testbed=args.testbed,
@@ -259,7 +287,7 @@ def main(argv=None) -> int:
                         **({"shift_severity": args.shift_severity}
                            if args.sweep == "shift"
                            else {"severities": args.severities})},
-                points=[_dc.asdict(p) for p in pts])
+                points=[_dc.asdict(p) for p in pts], **failover)
             capture_path = write_capture(rec)
         except Exception:
             capture_path = None
@@ -279,18 +307,25 @@ def main(argv=None) -> int:
     if args.cmd == "rca":
         if args.resume and not args.checkpoint_dir:
             parser.error("--resume requires --checkpoint-dir")
-        from anomod.rca import train_rca
-        r = train_rca(args.testbed, args.model,
-                      train_seeds=range(args.train_seeds),
-                      eval_seeds=range(100, 100 + args.eval_seeds),
-                      epochs=args.epochs,
-                      checkpoint_dir=args.checkpoint_dir,
-                      resume=args.resume)
-        print(json.dumps({
+        _probe_backend(args)
+        from anomod.rca import train_rca_resilient
+        r, failover = train_rca_resilient(
+            args.testbed, args.model,
+            train_seeds=range(args.train_seeds),
+            eval_seeds=range(100, 100 + args.eval_seeds),
+            epochs=args.epochs,
+            checkpoint_dir=args.checkpoint_dir,
+            resume=args.resume)
+        if failover:
+            print(f"[anomod] {failover}", file=sys.stderr)
+        out = {
             "testbed": args.testbed, "model": r.model_name,
             "top1": r.top1, "top3": r.top3,
             "detection_auc": r.detection_auc, "n_eval": r.n_eval,
-        }))
+        }
+        if failover:
+            out["device_failover"] = failover
+        print(json.dumps(out))
         return 0
 
     if args.cmd == "validate":
@@ -452,6 +487,9 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd == "replay":
+        if args.devices and args.replicate != 1:
+            parser.error("--replicate is not supported with --devices")
+        _probe_backend(args)
         from anomod import labels, synth
         from anomod.replay import ReplayConfig, measure_throughput
         from anomod.schemas import concat_span_batches
@@ -460,8 +498,6 @@ def main(argv=None) -> int:
             for l in labels.labels_for_testbed(args.testbed)])
         cfg = ReplayConfig(n_services=batch.n_services)
         if args.devices:
-            if args.replicate != 1:
-                parser.error("--replicate is not supported with --devices")
             from anomod.parallel import make_mesh, sharded_throughput
             mesh = make_mesh(args.devices)
             r = sharded_throughput(batch, mesh, cfg, kernel=args.kernel)

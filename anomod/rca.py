@@ -349,3 +349,57 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
     top1, top3, auc, n_eval = topk_eval(scores, evalb)
     return TrainResult(model_name=model_name, top1=top1, top3=top3,
                        detection_auc=auc, n_eval=n_eval, params=params)
+
+
+def train_rca_resilient(*args, resume: bool = False, checkpoint_dir=None,
+                        **kwargs):
+    """:func:`train_rca` with mid-run dead-device failover.
+
+    If training dies with a backend RuntimeError while a device backend is
+    active (the tunnel-died-mid-sweep mode), the process is repointed to
+    CPU (utils.platform.with_cpu_failover) and training reruns once.  The
+    retry resumes ONLY from a checkpoint this invocation itself published
+    (checkpoint mtime >= start; a stale same-model checkpoint left from an
+    earlier run must not be silently resumed into a "freshly trained"
+    result) — with no fresh checkpoint it retrains from scratch.
+
+    Returns ``(result, failover_note)`` where ``failover_note`` is None on
+    the clean path and a one-line explanation when the CPU retry ran —
+    callers surface it so mixed-backend results are labeled as such.
+    """
+    import time
+
+    from anomod.utils.checkpoint import checkpoint_mtime
+    from anomod.utils.platform import with_cpu_failover
+
+    t_start = time.time()
+    tried = []
+    note = []
+
+    def _saved_this_run() -> bool:
+        if not checkpoint_dir:
+            return False
+        m = checkpoint_mtime(checkpoint_dir)
+        return m is not None and m >= t_start
+
+    def _attempt():
+        do_resume = resume if not tried else (resume or _saved_this_run())
+        tried.append(1)
+        return train_rca(*args, resume=do_resume,
+                         checkpoint_dir=checkpoint_dir, **kwargs)
+
+    def _on_failover(exc):
+        # the retry actually resumes only when a restorable checkpoint
+        # exists at retry time AND the resume gate passes — "--resume with
+        # an empty dir, died before the first save" retrains from scratch
+        # and must be labeled so
+        will_resume = ((resume or _saved_this_run())
+                       and checkpoint_dir is not None
+                       and checkpoint_mtime(checkpoint_dir) is not None)
+        note.append(f"device backend lost mid-train ({type(exc).__name__});"
+                    f" retried on the CPU failover backend"
+                    + (" from the last checkpoint"
+                       if will_resume else " from scratch"))
+
+    result = with_cpu_failover(_attempt, on_failover=_on_failover)
+    return result, (note[0] if note else None)

@@ -49,6 +49,111 @@ def probe_device_platform(attempts=None):
     return "", last or "unknown"
 
 
+def ensure_live_backend(n_cpu_fallback: int = 1, attempts=None) -> str:
+    """Probe the device backend out-of-process; pin CPU when it is dead.
+
+    The long-running CLI subcommands (quality / rca / replay) would
+    otherwise hang forever at first backend touch when the axon tunnel is
+    down (same failure mode bench.py hardens against).  Returns a one-line
+    note: "probe ok: tpu" or "device backend unavailable (...); pinned
+    cpu".  ``attempts=None`` keeps probe_device_platform's bounded-retry
+    default (75 s + 30 s — sized for a slow-but-alive cold init, which must
+    NOT be misread as dead); ``ANOMOD_PROBE_DEADLINE=<secs>`` overrides it,
+    ``ANOMOD_SKIP_PROBE=1`` bypasses the probe entirely (saves the
+    ~10-20 s subprocess init when the caller knows the backend is healthy).
+    """
+    if os.environ.get("ANOMOD_SKIP_PROBE", "").strip() == "1":
+        return "probe skipped via ANOMOD_SKIP_PROBE"
+    if attempts is None:
+        deadline = env_number("ANOMOD_PROBE_DEADLINE", None, cast=float)
+        if deadline is not None:
+            attempts = (deadline,)
+    plat, diag = probe_device_platform(attempts)
+    if plat:
+        return f"probe ok: {plat}"
+    pin_cpu(n_cpu_fallback)
+    return f"device backend unavailable ({diag}); pinned cpu"
+
+
+def env_number(name: str, default, cast=int):
+    """Parse a numeric env var, warning and falling back on garbage.
+
+    Single home for the "numeric knob from the environment" pattern
+    (ANOMOD_CPU_DEVICES, ANOMOD_PROBE_DEADLINE): empty/unset → default,
+    non-numeric → stderr warning + default.
+    """
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        import sys
+        print(f"[anomod] ignoring non-numeric {name}={raw!r}",
+              file=sys.stderr)
+        return default
+
+
+def _current_platform() -> str:
+    """Best-effort platform of the (possibly already-initialized) backend."""
+    try:
+        import jax
+        return jax.devices()[0].platform
+    except Exception:
+        return "unknown"
+
+
+#: Substrings that mark a RuntimeError as loss of the device *backend*
+#: (tunnel/transport death) rather than a deterministic device-side error.
+#: A TPU OOM (RESOURCE_EXHAUSTED) or a compile error must NOT fail over —
+#: retrying those on CPU buries the real bug under a mislabeled
+#: "backend lost" note.
+_BACKEND_LOSS_MARKERS = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "Connection", "connection",
+    "transport", "remote_compile", "Socket closed", "failed to connect",
+)
+
+
+def is_backend_loss(exc: BaseException) -> bool:
+    """True when the exception text reads as a dead device backend."""
+    msg = str(exc)
+    return any(m in msg for m in _BACKEND_LOSS_MARKERS)
+
+
+def with_cpu_failover(fn, n_cpu=None, on_failover=None, _platform=None):
+    """Run ``fn()``; if it dies because the device *backend* was lost while
+    active, repoint the process to CPU and run it once more.
+
+    This is the mid-run analog of the pre-run probe: a device tunnel that
+    dies *during* a long sweep poisons every subsequent jax call in the
+    process, but the host-side state (numpy corpora, completed result
+    cells) is intact — repointing via :func:`pin_cpu` and redoing only the
+    in-flight unit of work salvages the run.  The retry is single-shot and
+    gated twice: the current platform must not already be ``cpu`` and the
+    error text must read as backend loss (:func:`is_backend_loss`) —
+    deterministic device errors (OOM, compile failures) propagate so they
+    surface as what they are.  ``n_cpu=None`` sizes the fallback mesh from
+    ``ANOMOD_CPU_DEVICES``; ``on_failover`` is called with the original
+    exception before the retry (log/record hook); ``_platform`` injects
+    the platform getter for tests.
+    """
+    get = _platform or _current_platform
+    try:
+        return fn()
+    except RuntimeError as e:
+        # marker check FIRST: it never touches the backend, so ordinary
+        # RuntimeErrors (bugs) propagate without a jax.devices() call that
+        # could itself hang on a dead, never-initialized tunnel; the
+        # platform gate then only runs for plausible backend-loss errors
+        if not is_backend_loss(e) or get() == "cpu":
+            raise
+        pin_cpu(n_cpu if n_cpu is not None
+                else env_number("ANOMOD_CPU_DEVICES", 1))
+        if on_failover is not None:
+            on_failover(e)
+        return fn()
+
+
 def pin_cpu(n_devices: int = 1) -> None:
     """Pin this process's JAX to ``n_devices`` virtual CPU devices.
 

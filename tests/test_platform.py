@@ -1,0 +1,221 @@
+"""Dead-tunnel resilience: probe-or-pin and mid-run CPU failover.
+
+The axon device tunnel can die *between* runs (bench.py probes and pins
+pre-init) or *during* one (a 25-minute sweep dies at the next compile).
+These tests cover the second path: utils.platform.with_cpu_failover and its
+integration into the quality sweep engine.  pin_cpu is monkeypatched to a
+recorder throughout — really repinning would collapse the suite's 8-device
+mesh for every later test.
+"""
+
+import pytest
+
+from anomod.utils import platform
+
+
+def test_with_cpu_failover_passthrough():
+    assert platform.with_cpu_failover(lambda: 42) == 42
+
+
+def test_with_cpu_failover_retries_on_device_backend(monkeypatch):
+    monkeypatch.delenv("ANOMOD_CPU_DEVICES", raising=False)
+    pins = []
+    monkeypatch.setattr(platform, "pin_cpu", lambda n=1: pins.append(n))
+    seen = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("UNAVAILABLE: remote_compile: conn refused")
+        return "ok"
+
+    out = platform.with_cpu_failover(flaky, on_failover=seen.append,
+                                     _platform=lambda: "tpu")
+    assert out == "ok"
+    assert calls["n"] == 2
+    assert pins == [1]
+    assert len(seen) == 1 and "UNAVAILABLE" in str(seen[0])
+
+
+def test_with_cpu_failover_reraises_when_already_cpu(monkeypatch):
+    monkeypatch.setattr(platform, "pin_cpu",
+                        lambda n=1: pytest.fail("must not repin on cpu"))
+
+    def broken():
+        raise RuntimeError("a real bug, not a dead tunnel")
+
+    with pytest.raises(RuntimeError, match="real bug"):
+        platform.with_cpu_failover(broken, _platform=lambda: "cpu")
+
+
+def test_with_cpu_failover_ignores_deterministic_device_errors(monkeypatch):
+    """A device-side OOM/compile error is NOT backend loss: it must
+    propagate (retrying it on CPU would bury the real bug under a
+    mislabeled 'backend lost' note)."""
+    monkeypatch.setattr(platform, "pin_cpu",
+                        lambda n=1: pytest.fail("must not repin on OOM"))
+
+    def oom():
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating "
+                           "1.2G on TPU_0")
+
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        platform.with_cpu_failover(oom, _platform=lambda: "tpu")
+
+
+def test_with_cpu_failover_single_shot(monkeypatch):
+    """A second failure after the repoint propagates (no retry loop)."""
+    monkeypatch.setattr(platform, "pin_cpu", lambda n=1: None)
+
+    def always():
+        raise RuntimeError("UNAVAILABLE: still dead")
+
+    with pytest.raises(RuntimeError, match="still dead"):
+        platform.with_cpu_failover(always, _platform=lambda: "tpu")
+
+
+def test_ensure_live_backend_skip_env(monkeypatch):
+    monkeypatch.setenv("ANOMOD_SKIP_PROBE", "1")
+    monkeypatch.setattr(platform, "probe_device_platform",
+                        lambda *a, **k: pytest.fail("probe must be skipped"))
+    assert "skipped" in platform.ensure_live_backend()
+
+
+def test_ensure_live_backend_pins_on_dead_probe(monkeypatch):
+    monkeypatch.delenv("ANOMOD_SKIP_PROBE", raising=False)
+    pins = []
+    monkeypatch.setattr(platform, "pin_cpu", lambda n=1: pins.append(n))
+    monkeypatch.setattr(platform, "probe_device_platform",
+                        lambda *a, **k: ("", "probe timed out after 45s"))
+    note = platform.ensure_live_backend(n_cpu_fallback=2)
+    assert "unavailable" in note and "pinned cpu" in note
+    assert pins == [2]
+
+
+def test_checkpoint_mtime_distinguishes_fresh_from_stale(tmp_path):
+    """The rca failover retry resumes only from a checkpoint whose publish
+    time postdates the attempt start — checkpoint_mtime is that clock."""
+    import time
+
+    import jax.numpy as jnp
+
+    from anomod.utils.checkpoint import checkpoint_mtime, save_train_state
+
+    assert checkpoint_mtime(tmp_path / "nope") is None     # no checkpoint
+    ck = tmp_path / "ck"
+    save_train_state(ck, {"w": jnp.ones(2)}, {"m": jnp.zeros(2)}, step=5)
+    m = checkpoint_mtime(ck)
+    assert m is not None
+    # just published -> fresh relative to a run that started a minute ago
+    assert m >= time.time() - 60
+    # backdate the publish marker: a checkpoint left by an EARLIER run
+    # must read as stale relative to this run's start time
+    import os
+    past = time.time() - 3600
+    os.utime(ck / "meta.json", (past, past))
+    m_stale = checkpoint_mtime(ck)
+    assert m_stale is not None and m_stale < time.time() - 3000
+
+
+def _fake_train_result(name="gcn"):
+    from anomod.rca import TrainResult
+    return TrainResult(model_name=name, top1=1.0, top3=1.0,
+                       detection_auc=1.0, n_eval=4, params={})
+
+
+def test_rca_resilient_does_not_resume_stale_checkpoint(monkeypatch,
+                                                        tmp_path):
+    """Retry after a pre-save failure must NOT resume a checkpoint left in
+    the dir by an earlier run (it predates this invocation)."""
+    import jax.numpy as jnp
+
+    from anomod import rca
+    from anomod.utils.checkpoint import save_train_state
+
+    monkeypatch.setattr(platform, "pin_cpu", lambda n=1: None)
+    monkeypatch.setattr(platform, "_current_platform", lambda: "tpu")
+
+    ck = tmp_path / "ck"
+    save_train_state(ck, {"w": jnp.ones(2)}, {"m": jnp.zeros(2)}, step=300)
+
+    seen = []
+
+    def flaky_train(*a, resume=False, checkpoint_dir=None, **k):
+        seen.append(resume)
+        if len(seen) == 1:
+            raise RuntimeError("UNAVAILABLE: tunnel died pre-save")
+        return _fake_train_result()
+
+    monkeypatch.setattr(rca, "train_rca", flaky_train)
+    result, note = rca.train_rca_resilient(
+        "TT", "gcn", resume=False, checkpoint_dir=ck)
+    assert seen == [False, False]      # stale checkpoint not resumed
+    assert result.top1 == 1.0
+    assert note and "from scratch" in note
+
+
+def test_rca_resilient_resumes_own_checkpoint(monkeypatch, tmp_path):
+    """Retry resumes when the interrupted attempt itself published a save."""
+    import jax.numpy as jnp
+
+    from anomod import rca
+    from anomod.utils.checkpoint import save_train_state
+
+    monkeypatch.setattr(platform, "pin_cpu", lambda n=1: None)
+    monkeypatch.setattr(platform, "_current_platform", lambda: "tpu")
+
+    ck = tmp_path / "ck"
+    seen = []
+
+    def flaky_train(*a, resume=False, checkpoint_dir=None, **k):
+        seen.append(resume)
+        if len(seen) == 1:
+            # periodic save lands, then the device dies
+            save_train_state(ck, {"w": jnp.ones(2)}, {"m": jnp.zeros(2)},
+                             step=50)
+            raise RuntimeError("UNAVAILABLE: tunnel died mid-train")
+        return _fake_train_result()
+
+    monkeypatch.setattr(rca, "train_rca", flaky_train)
+    result, note = rca.train_rca_resilient(
+        "TT", "gcn", resume=False, checkpoint_dir=ck)
+    assert seen == [False, True]       # own save -> resumed
+    assert note and "last checkpoint" in note
+
+
+def test_quality_sweep_survives_mid_run_backend_loss(monkeypatch):
+    """Integration: the sweep engine finishes (and flags the failover) when
+    a model's train+eval row dies with a backend RuntimeError mid-sweep."""
+    from anomod import quality
+
+    monkeypatch.delenv("ANOMOD_CPU_DEVICES", raising=False)
+    pins = []
+    monkeypatch.setattr(platform, "pin_cpu", lambda n=1: pins.append(n))
+    monkeypatch.setattr(platform, "_current_platform", lambda: "tpu")
+
+    orig = quality._train_model
+    calls = {"n": 0}
+
+    def flaky_train(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("UNAVAILABLE: remote_compile: conn refused")
+        return orig(*a, **k)
+
+    monkeypatch.setattr(quality, "_train_model", flaky_train)
+
+    pts = quality.severity_sweep(
+        testbed="TT", model_names=("gcn",), severities=(1.0,),
+        train_seeds=range(3), eval_seeds=(100,), n_traces=8, epochs=2)
+    assert len(pts) == 1 and pts[0].model == "gcn"
+    assert calls["n"] == 2          # failed once, retried once
+    assert pins == [1]
+    assert quality.LAST_FAILOVER is not None
+    assert "gcn" in quality.LAST_FAILOVER
+
+    # a clean follow-up sweep resets the breadcrumb
+    quality.severity_sweep(testbed="TT", model_names=("zscore",),
+                           severities=(1.0,), train_seeds=range(3),
+                           eval_seeds=(100,), n_traces=8, epochs=1)
+    assert quality.LAST_FAILOVER is None
