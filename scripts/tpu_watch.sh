@@ -20,6 +20,16 @@ for i in $(seq 1 200); do
     # Mosaic-compiled kernel parity at the current tree (writes its own
     # bench_runs/ record via the tpu_tests conftest)
     timeout 600 python -m pytest tpu_tests/ -q
+    # On-chip quality shift sweep if no TPU record of it exists yet (the
+    # round-3 tunnel death killed this exact capture; ~25 min budget).
+    # ANOMOD_SKIP_PROBE: the watcher just proved the backend live, and the
+    # CLI's own probe would burn another subprocess init.
+    if ! ls bench_runs/*_quality_shift_sweep_tpu.json >/dev/null 2>&1; then
+      ANOMOD_SKIP_PROBE=1 timeout 2400 \
+        python -m anomod.cli quality --testbed TT --sweep shift --json \
+        > /tmp/tpu_watch_shift.log 2>&1
+      echo "=== shift sweep rc: $? (log /tmp/tpu_watch_shift.log) ==="
+    fi
     after=$(ls bench_runs/*_tpu.json 2>/dev/null | wc -l)
     new=$((after - before))
     echo "=== capture rc: pallas=$rc1 xla=$rc2; new TPU records: $new ==="
