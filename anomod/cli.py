@@ -145,11 +145,13 @@ def main(argv=None) -> int:
     p_replay.add_argument("--testbed", choices=["SN", "TT"], default="TT")
     p_replay.add_argument("--traces", type=int, default=2000)
     p_replay.add_argument("--replicate", type=int, default=1)
-    p_replay.add_argument("--kernel", choices=["xla", "pallas"],
+    p_replay.add_argument("--kernel", choices=["xla", "pallas", "numpy"],
                           default="xla",
                           help="aggregation path: XLA scan (default; runs "
-                               "anywhere) or the fused pallas kernel (the "
-                               "TPU fast path; interpret-mode off-TPU)")
+                               "anywhere), the fused pallas kernel (the "
+                               "TPU fast path; interpret-mode off-TPU), or "
+                               "the numpy cpu-backend engine (fastest on a "
+                               "host core; single-chip only)")
     p_replay.add_argument("--percentiles", action="store_true",
                           help="also report corpus-wide p50/p95/p99 from the "
                                "per-segment t-digest plane (Mosaic kernel on "
@@ -489,7 +491,13 @@ def main(argv=None) -> int:
     if args.cmd == "replay":
         if args.devices and args.replicate != 1:
             parser.error("--replicate is not supported with --devices")
-        _probe_backend(args)
+        if args.devices and args.kernel == "numpy":
+            parser.error("--kernel numpy is the single-chip host engine; "
+                         "the sharded path needs a device kernel")
+        # a pure-host run (numpy engine, no mesh, no digest plane) touches
+        # no jax — don't pay the backend probe for it
+        if args.kernel != "numpy" or args.devices or args.percentiles:
+            _probe_backend(args)
         from anomod import labels, synth
         from anomod.replay import ReplayConfig, measure_throughput
         from anomod.schemas import concat_span_batches

@@ -360,17 +360,31 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
     outer grid dimension) to amortize the fixed dispatch/RPC overhead into a
     steady-state number without inflating the host arrays or the HBM
     working set.  ``kernel`` selects the aggregation path: "xla" (scan +
-    one-hot matmuls) or "pallas" (the fused anomod.ops.pallas_replay
-    kernel).
+    one-hot matmuls), "pallas" (the fused anomod.ops.pallas_replay
+    kernel), or "numpy" — the framework's cpu-backend engine
+    (BASELINE.json's backend switch): direct scatter-add over the staged
+    columns, which is the right shape for a host core (~13x the XLA scan
+    on one CPU core, where one-hot matmuls are wasted work) and doubles as
+    the parity oracle both device kernels are tested against.
     """
-    import jax
-    if kernel not in ("xla", "pallas"):
+    if kernel not in ("xla", "pallas", "numpy"):
         raise ValueError(f"unknown replay kernel {kernel!r} "
-                         "(expected 'xla' or 'pallas')")
+                         "(expected 'xla', 'pallas' or 'numpy')")
     cfg = cfg or ReplayConfig(n_services=len(batch.services))
     chunks_np, n = stage_columns(batch, cfg)
     n *= replicate
-    if kernel == "pallas":
+
+    # Per-kernel run_once() -> summed span count (host float); one shared
+    # timing/median/count-assert block below so tolerance and median policy
+    # can't silently diverge between engines.
+    if kernel == "numpy":
+        def run_once():
+            for _r in range(replicate):        # host analog of inner_repeats
+                out = replay_numpy(chunks_np, cfg)
+            return float(out.agg[:, F_COUNT].astype(np.float64).sum()
+                         ) * replicate
+    elif kernel == "pallas":
+        import jax
         from anomod.ops.pallas_replay import make_pallas_replay_fn
         sid_np, planes_np = stage_pallas_planes(chunks_np)
         sid, planes = jax.device_put(sid_np), jax.device_put(planes_np)
@@ -381,21 +395,24 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
                                     inner_repeats=replicate,
                                     block=pallas_block(cfg.chunk_size),
                                     interpret=interpret)
-        def fn(_):
-            agg = pfn(sid, planes)
-            return ReplayState(agg=agg[:, :N_FEATS], hist=agg[:, N_FEATS:])
-        chunks = None
+        def run_once():
+            agg = np.asarray(pfn(sid, planes))
+            return float(agg[:, F_COUNT].astype(np.float64).sum())
     else:
+        import jax
         chunks = jax.device_put(chunks_np)
-        fn = make_replay_fn(cfg, inner_repeats=replicate)
+        xfn = make_replay_fn(cfg, inner_repeats=replicate)
+        def run_once():
+            agg = np.asarray(xfn(chunks).agg)
+            return float(agg[:, F_COUNT].astype(np.float64).sum())
+
     t0 = time.perf_counter()
-    np.asarray(fn(chunks).agg)
-    compile_s = time.perf_counter() - t0
+    run_once()                                  # compile / cache warm-up
+    compile_s = 0.0 if kernel == "numpy" else time.perf_counter() - t0
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        out = fn(chunks)
-        total = float(np.asarray(out.agg)[:, F_COUNT].astype(np.float64).sum())
+        total = run_once()
         times.append(time.perf_counter() - t0)
     # Sanity check with f32 headroom: per-segment counts accumulate on device
     # in f32 and lose exactness past 2^24 spans per (service, window) segment,

@@ -83,20 +83,31 @@ def main() -> int:
         # a near-single-shot sample; per-repeat walls ride raw_wall_s.
         replicate = 64 if platform != "cpu" else 2
         repeats = 3
-        # The fused pallas kernel is the fast path on TPU (3.0e8 vs 2.5e8
-        # spans/sec for the XLA scan on v5e).  Mosaic only executes on real
-        # TPU devices — everything else (CPU fallback, any non-TPU
-        # accelerator) must take the XLA path or measure_throughput would
-        # drop the kernel into never-finishing interpret mode; an explicit
+        # Engine per backend (the BASELINE.json backend switch): the fused
+        # pallas kernel is the fast path on TPU (3.0e8 vs 2.5e8 spans/sec
+        # for the XLA scan on v5e); the CPU fallback runs the numpy
+        # scatter-add engine — the right shape for a host core (~13x the
+        # XLA scan there, one-hot matmuls are wasted work on CPU).  Mosaic
+        # only executes on real TPU devices — an explicit
         # ANOMOD_BENCH_KERNEL=pallas override off-TPU is therefore
-        # downgraded to xla (with a note) instead of honored into a hang.
+        # downgraded (with a note) instead of honored into the
+        # never-finishing interpret path.
         on_tpu = platform != "cpu" and jax.devices()[0].platform == "tpu"
+        # per-backend default: pallas on TPU, the host numpy engine on the
+        # CPU fallback, the XLA path on any other accelerator (numpy there
+        # would measure the host while "device" reports the accelerator)
+        default_kernel = "pallas" if on_tpu else \
+            ("numpy" if platform == "cpu" else "xla")
         kernel = os.environ.get("ANOMOD_BENCH_KERNEL", "").strip().lower() \
-            or ("pallas" if on_tpu else "xla")
+            or default_kernel
         if kernel == "pallas" and not on_tpu:
-            kernel = "xla"
+            kernel = "numpy" if platform == "cpu" else "xla"
             out["kernel_note"] = ("ANOMOD_BENCH_KERNEL=pallas requires a TPU "
-                                  "backend (Mosaic); downgraded to xla")
+                                  f"backend (Mosaic); downgraded to {kernel}")
+        if kernel == "numpy":
+            # host engine: device-sized replication would be 64 full host
+            # passes per repeat — size the work for one core
+            replicate = min(replicate, 2)
         cfg = ReplayConfig(n_services=batch.n_services)
         # ANOMOD_PROFILE_DIR=<dir> wraps the measured dispatches in a
         # jax.profiler device trace (TensorBoard/Perfetto) for kernel-level

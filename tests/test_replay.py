@@ -172,6 +172,18 @@ def test_measure_throughput_smoke(tt_batch):
     assert r.spans_per_sec > 0
 
 
+def test_measure_throughput_numpy_kernel(tt_batch):
+    """The cpu-backend engine rides the same harness: replicate scaling,
+    count integrity (asserted inside), median-of-N walls."""
+    cfg = ReplayConfig(n_services=tt_batch.n_services, chunk_size=4096)
+    r = measure_throughput(tt_batch, cfg, repeats=3, replicate=2,
+                           kernel="numpy")
+    assert r.kernel == "numpy"
+    assert r.n_spans == 2 * tt_batch.n_spans
+    assert r.spans_per_sec > 0
+    assert len(r.raw_wall_s) == 3
+
+
 def test_replay_hll_distinct_traces(tt_batch):
     """HLL plane counts distinct traces per service within sketch error."""
     import numpy as np
