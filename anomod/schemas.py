@@ -134,6 +134,24 @@ def concat_span_batches(batches: Sequence[SpanBatch]) -> SpanBatch:
     ).validate()
 
 
+def take_spans(batch: SpanBatch, idx: np.ndarray) -> SpanBatch:
+    """Row-subset of a SpanBatch (boolean mask or index array).
+
+    Side tables are kept whole so service/endpoint/trace ids stay valid;
+    ``parent`` is NOT remapped — rows whose parent falls outside the subset
+    keep their original global index, so callers that need parent edges
+    must subset by whole traces.  Used by the streaming layer to slice a
+    corpus into arrival-ordered micro-batches (time slices keep traces
+    intact only incidentally; the replay plane never reads ``parent``).
+    """
+    return batch._replace(
+        trace=batch.trace[idx], parent=batch.parent[idx],
+        service=batch.service[idx], endpoint=batch.endpoint[idx],
+        start_us=batch.start_us[idx], duration_us=batch.duration_us[idx],
+        is_error=batch.is_error[idx], status=batch.status[idx],
+        kind=batch.kind[idx])
+
+
 # ---------------------------------------------------------------------------
 # Metric IR — long-format samples, matching both reference CSV shapes:
 #   SN per-query CSVs:  timestamp,value,metric,<label cols>

@@ -1,0 +1,356 @@
+"""Online (streaming) detection over the replay plane.
+
+The reference is strictly post-hoc: collectors archive an experiment after
+it ran, and any detection happens offline on the archive
+(`/root/reference/SN_collection-scripts/collect_all_data.sh:379`,
+`T-Dataset/collect_all_modalities.sh:196-254`).  An operator of those
+testbeds wants the obvious next step — alerts while the fault is live.
+This module provides it on top of the existing replay machinery:
+
+- :class:`StreamReplay` feeds span micro-batches (arrival order) through
+  the SAME jitted chunk step the batch replay scans with
+  (`anomod.replay.make_chunk_step`) — the incremental state is
+  bit-identical to a one-shot replay of the same spans (parity-tested),
+  so everything downstream of the aggregate plane (percentiles, HLL
+  distinct-trace counts, detectors) works unchanged on a live stream.
+- :class:`OnlineDetector` scores each *closed* 60 s window per service
+  against leading baseline windows (z-scores on log-latency mean and
+  error rate read straight off the aggregate plane) and raises
+  :class:`Alert` rows with hysteresis.  Detection latency — windows from
+  fault onset to first alert on the culprit — is the streaming-mode
+  quality metric the offline sweep cannot measure.
+
+TPU notes: the hot path is the shared chunk step (one bf16 MXU matmul per
+micro-batch chunk); window scoring reads the tiny [S*W, F] plane back to
+host, which is the natural cadence point (once per closed window, not per
+span).
+
+Operating envelope: the z statistics need traffic density — around ≥10
+spans per (service, window) the full fault taxonomy localizes with 0-4
+window latency and the normal baselines stay quiet (tests pin this at the
+default 300-400 traces / 30 windows); at a few spans per window the tests
+lose power honestly (wider nulls, a rare service killed mid-run may never
+alert).  Sparse regimes are what the offline learned models are for
+(docs/BENCHMARKS.md quality tables) — the streaming detector is the
+training-free first responder, not a replacement for them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from anomod.replay import (F_COUNT, F_ERR, F_LOGLAT, N_FEATS, ReplayConfig,
+                           ReplayState, make_chunk_step, stage_columns)
+from anomod.schemas import SpanBatch, take_spans
+
+
+@dataclasses.dataclass(frozen=True)
+class Alert:
+    window: int            # closed window index that scored anomalous
+    service: int           # service id (index into the batch's table)
+    service_name: str
+    score: float           # max of the three z-scores below
+    z_latency: float       # standard-error z on the window's log-latency mean
+    z_error: float         # binomial z on the window's error rate
+    z_drop: float          # Poisson z on missing throughput (dead service)
+
+
+class StreamReplay:
+    """Incremental replay state over arrival-ordered span micro-batches.
+
+    ``t0_us`` anchors the window grid at stream start.  The grid ROLLS: a
+    push whose spans start past the last column evicts the oldest windows
+    (host-side roll of the tiny [S*W, *] state) and advances the anchor,
+    so a live stream of any duration keeps scoring — ``window_offset``
+    is the absolute index of plane column 0 and only grows.  Late
+    stragglers older than the rolled anchor clamp into column 0 (the
+    bounded misbinning of any ring buffer).  Chunk size should be sized
+    to the expected micro-batch (default 4096 vs the batch path's 32768).
+    """
+
+    def __init__(self, cfg: ReplayConfig, t0_us: int,
+                 with_hll: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.t0_us = int(t0_us)
+        self.window_offset = 0     # absolute window index of plane column 0
+        self.n_spans = 0
+        step = make_chunk_step(cfg, with_hll=with_hll)
+        self._step = jax.jit(lambda st, ch: step(st, ch)[0])
+        self.state = ReplayState(
+            agg=jnp.zeros((cfg.sw, N_FEATS), jnp.float32),
+            hist=jnp.zeros((cfg.sw, cfg.n_hist_buckets), jnp.float32),
+            hll=(jnp.zeros((cfg.n_services, cfg.hll_m), jnp.int32)
+                 if with_hll else None))
+
+    def _roll(self, k: int) -> None:
+        """Evict the oldest ``k`` windows: shift plane columns left, zero
+        the tail, advance the anchor.  The anchor advances by the FULL
+        ``k`` even when that clears the whole plane (a feed gap wider than
+        the grid) — only the column shift clamps, so later spans always
+        bin into their true absolute window.  HLL registers are
+        per-service (not per-window) and keep accumulating across rolls."""
+        import jax.numpy as jnp
+        cfg = self.cfg
+        shift = min(k, cfg.n_windows)
+
+        def roll2(x, width):
+            x = np.asarray(x).reshape(cfg.n_services, cfg.n_windows, width)
+            out = np.zeros_like(x)
+            if shift < cfg.n_windows:
+                out[:, :cfg.n_windows - shift] = x[:, shift:]
+            return jnp.asarray(out.reshape(cfg.sw, width))
+
+        self.state = self.state._replace(
+            agg=roll2(self.state.agg, N_FEATS),
+            hist=roll2(self.state.hist, self.cfg.n_hist_buckets))
+        self.t0_us += k * cfg.window_us
+        self.window_offset += k
+
+    def push(self, batch: SpanBatch) -> int:
+        """Fold a micro-batch into the plane.
+
+        Returns the newest ABSOLUTE window the batch's spans were binned
+        into (-1 for an empty batch) — the one true span→window mapping,
+        so consumers never re-derive it from raw timestamps."""
+        if batch.n_spans == 0:
+            return -1
+        w_need = int((int(batch.start_us.max()) - self.t0_us)
+                     // self.cfg.window_us)
+        if w_need > self.cfg.n_windows - 1:
+            self._roll(w_need - (self.cfg.n_windows - 1))
+            w_need = self.cfg.n_windows - 1
+        chunks, n = stage_columns(batch, self.cfg, t0_us=self.t0_us)
+        for i in range(next(iter(chunks.values())).shape[0]):
+            self.state = self._step(self.state,
+                                    {k: v[i] for k, v in chunks.items()})
+        self.n_spans += n
+        return self.window_offset + max(w_need, 0)
+
+    def agg_plane(self) -> np.ndarray:
+        """Host copy of the aggregate plane as [S, W, F] (column w holds
+        absolute window ``window_offset + w``)."""
+        cfg = self.cfg
+        return np.asarray(self.state.agg).reshape(
+            cfg.n_services, cfg.n_windows, N_FEATS)
+
+
+class OnlineDetector:
+    """Window-closed z-score alerting over a :class:`StreamReplay`.
+
+    The first ``baseline_windows`` closed windows per service calibrate
+    mu/sigma for log-latency mean and error rate (the reference's
+    pre-fault normal phase — faults start at 600 s = window 10 on the
+    default grid, so the default 8 stays inside it).  A window is closed
+    once a pushed span starts in a LATER window (in-order arrival is the
+    stream contract).  ``consecutive`` windows above ``z_threshold`` are
+    required before alerting (hysteresis against single-window noise).
+    """
+
+    def __init__(self, batch_services: Sequence[str], cfg: ReplayConfig,
+                 t0_us: int, baseline_windows: int = 8,
+                 z_threshold: float = 4.0, min_count: float = 5.0,
+                 consecutive: int = 1, with_hll: bool = False):
+        if baseline_windows < 2:
+            raise ValueError("need >= 2 baseline windows for a sigma")
+        if baseline_windows >= cfg.n_windows:
+            raise ValueError("baseline must fit inside the window ring "
+                             f"({baseline_windows} >= {cfg.n_windows})")
+        if consecutive < 1:
+            raise ValueError("consecutive must be >= 1 (0 would alert "
+                             "every service in every window)")
+        self.replay = StreamReplay(cfg, t0_us, with_hll=with_hll)
+        self.services = tuple(batch_services)
+        self.baseline_windows = baseline_windows
+        self.z_threshold = z_threshold
+        self.min_count = min_count
+        self.consecutive = consecutive
+        self.alerts: List[Alert] = []
+        self._scored_through = -1          # last closed ABSOLUTE window scored
+        self._max_seen = -1                # newest absolute window with data
+        self._streak = np.zeros(len(batch_services), np.int32)
+        self._baseline = None              # frozen calibration snapshot
+
+    def push(self, batch: SpanBatch) -> List[Alert]:
+        """Feed a micro-batch; returns alerts for newly closed windows.
+
+        Window indices in alerts are ABSOLUTE (they keep growing after the
+        replay ring rolls past its grid width).  The newest window comes
+        from the replay itself — the detector never re-derives binning
+        from raw timestamps."""
+        w_max = self.replay.push(batch)
+        if w_max < 0:
+            return []
+        self._max_seen = max(self._max_seen, w_max)
+        return self._score_through(self._max_seen - 1)
+
+    def finish(self) -> List[Alert]:
+        """End of stream: the newest window with data counts as closed.
+
+        Windows past the last span are never scored — an ended stream is
+        not a fleet-wide outage, and scoring empty windows would fire the
+        drop signal for every active service (the busiest loudest)."""
+        return self._score_through(self._max_seen)
+
+    # -- scoring ----------------------------------------------------------
+    #
+    # The three signals read straight off the aggregate plane's moments,
+    # each normalized by the statistically right denominator for sparse
+    # windows (a handful of spans per (service, window) is the realistic
+    # regime — per-window-mean sigmas explode there):
+    #   latency:    z = (mean_w - mu0) / sqrt(var_span0 / n_w)
+    #               (standard error of the window mean; var_span0 pooled
+    #                from the baseline spans via the E[x^2] plane)
+    #   error rate: binomial z vs the pooled baseline rate
+    #   throughput: Poisson z on MISSING spans — a killed service stops
+    #               emitting, which latency/error z-scores cannot see
+    #               (the reference's Lv_S kill faults fail exactly this way)
+
+    def _calibrate(self, plane: np.ndarray) -> dict:
+        """Freeze baseline statistics from plane columns [0, B).
+
+        Called once, the first time scoring reaches the end of the
+        calibration phase — before the ring can roll (B << n_windows), so
+        the columns still hold absolute windows 0..B-1.  Frozen stats keep
+        every later window scored against the SAME healthy reference even
+        after the ring evicts those columns."""
+        from anomod.replay import F_LOGLAT2
+        B = self.baseline_windows
+        if self.replay.window_offset > 0:
+            raise RuntimeError(
+                "stream jumped past the calibration phase before "
+                f"{B} baseline windows closed (ring already rolled)")
+        cnt = plane[..., F_COUNT]
+        # pooled baseline per service (count-weighted, all B windows)
+        C0 = np.maximum(cnt[:, :B].sum(axis=1), 1.0)
+        mu_l = plane[:, :B, F_LOGLAT].sum(axis=1) / C0
+        var_span = np.maximum(
+            plane[:, :B, F_LOGLAT2].sum(axis=1) / C0 - mu_l ** 2, 1e-4)
+        # Laplace-smoothed error rate: an all-clean baseline must not make
+        # the first stray background error an infinite-z event — the +1/+2
+        # prior keeps the binomial variance honest at small counts (one
+        # error in a 6-span window on a 24-span clean baseline: z ~ 1.6,
+        # vs ~13 with a raw rate and a hard variance floor)
+        p_err = (plane[:, :B, F_ERR].sum(axis=1) + 1.0) / (C0 + 2.0)
+        err_var = np.maximum(p_err * (1.0 - p_err), 1e-6)
+        rate0 = cnt[:, :B].mean(axis=1)          # spans per baseline window
+        # between-window baseline variance: endpoint-mix drift and traffic
+        # burstiness are real window-to-window variation that the pure
+        # within-window denominators (SE-of-mean, binomial, Poisson) do not
+        # carry — without these terms a bursty-but-healthy service alerts
+        # on every naturally quiet window
+        bsafe = np.maximum(cnt[:, :B], 1.0)
+        bvalid = cnt[:, :B] >= self.min_count
+        nb = np.maximum(bvalid.sum(axis=1), 1)
+
+        def _between_var(per_window):
+            m = (per_window * bvalid).sum(axis=1) / nb
+            return ((per_window - m[:, None]) ** 2 * bvalid).sum(axis=1) / nb
+
+        return dict(
+            mu_l=mu_l, var_span=var_span, p_err=p_err, err_var=err_var,
+            rate0=rate0,
+            active=rate0 >= self.min_count,   # drop signal needs traffic
+            # latency/error z need a calibrated baseline: a service unseen
+            # (or barely seen) during calibration has a fabricated mu/var
+            # and its first busy window would be a guaranteed false alert
+            calibrated=C0 >= 2.0 * self.min_count,
+            var_bl=_between_var(plane[:, :B, F_LOGLAT] / bsafe),
+            var_be=_between_var(plane[:, :B, F_ERR] / bsafe),
+            sd_cnt=np.sqrt(np.maximum(cnt[:, :B].var(axis=1),
+                                      np.maximum(rate0, 1.0))))
+
+    def _score_through(self, through: int) -> List[Alert]:
+        """Score closed ABSOLUTE windows (scored_through, through]."""
+        B = self.baseline_windows
+        start = max(self._scored_through + 1, B)
+        if through < start:
+            self._scored_through = max(self._scored_through, through)
+            return []
+        plane = self.replay.agg_plane()
+        if self._baseline is None:
+            self._baseline = self._calibrate(plane)
+        b = self._baseline
+        cnt = plane[..., F_COUNT]
+        off = self.replay.window_offset
+        out: List[Alert] = []
+        for w in range(start, through + 1):
+            col = w - off
+            if col < 0:          # evicted before it could be scored
+                self._streak[:] = 0      # a gap breaks any consecutive run
+                continue
+            if cnt[:, col].sum() <= 0:
+                # nobody at all reported in this window: that is feed
+                # silence (collector outage / gap), not per-service
+                # evidence — firing z_drop for EVERY active service would
+                # be an alert storm carrying no localization signal.  The
+                # silence also breaks hysteresis: windows on either side
+                # of a gap are not consecutive
+                self._streak[:] = 0
+                continue
+            n_w = cnt[:, col]
+            safe = np.maximum(n_w, 1.0)
+            ok = (n_w >= self.min_count) & b["calibrated"]
+            zl = np.where(ok, (plane[:, col, F_LOGLAT] / safe - b["mu_l"])
+                          / np.sqrt(b["var_span"] / safe + b["var_bl"]), 0.0)
+            ze = np.where(ok, (plane[:, col, F_ERR] / safe - b["p_err"])
+                          / np.sqrt(b["err_var"] / safe + b["var_be"]), 0.0)
+            zd = np.where(b["active"],
+                          (b["rate0"] - n_w) / b["sd_cnt"], 0.0)
+            score = np.maximum(np.maximum(zl, ze), zd)
+            hot = score >= self.z_threshold
+            self._streak = np.where(hot, self._streak + 1, 0)
+            for s in np.nonzero(self._streak >= self.consecutive)[0]:
+                out.append(Alert(window=w, service=int(s),
+                                 service_name=self.services[s],
+                                 score=float(score[s]),
+                                 z_latency=float(zl[s]),
+                                 z_error=float(ze[s]),
+                                 z_drop=float(zd[s])))
+        self._scored_through = through
+        self.alerts.extend(out)
+        return out
+
+    # -- stream-mode quality metrics --------------------------------------
+
+    def ranked_services(self) -> List[str]:
+        """Culprit ranking: peak alert score per service, descending."""
+        peak = {}
+        for a in self.alerts:
+            peak[a.service_name] = max(peak.get(a.service_name, 0.0), a.score)
+        return sorted(peak, key=peak.get, reverse=True)
+
+    def first_alert_window(self, service_name: Optional[str] = None):
+        ws = [a.window for a in self.alerts
+              if service_name is None or a.service_name == service_name]
+        return min(ws) if ws else None
+
+
+def stream_experiment(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
+                      slice_s: float = 60.0, **detector_kw):
+    """Replay a corpus in arrival order through the online detector.
+
+    Sorts spans by start time, slices the timeline into ``slice_s``-second
+    micro-batches, and pushes each — the offline corpus standing in for a
+    live feed.  Returns the finished :class:`OnlineDetector`.
+    """
+    cfg = cfg or ReplayConfig(n_services=batch.n_services, chunk_size=4096)
+    order = np.argsort(batch.start_us, kind="stable")
+    batch = take_spans(batch, order)
+    t0 = int(batch.start_us.min()) if batch.n_spans else 0
+    det = OnlineDetector(batch.services, cfg, t0, **detector_kw)
+    if batch.n_spans:
+        rel_s = (batch.start_us - t0) / 1e6
+        bounds = np.searchsorted(
+            rel_s, np.arange(slice_s, float(rel_s[-1]) + slice_s, slice_s))
+        for lo, hi in zip(np.concatenate([[0], bounds]),
+                          np.concatenate([bounds, [batch.n_spans]])):
+            if hi > lo:
+                det.push(take_spans(batch, slice(int(lo), int(hi))))
+    det.finish()
+    return det

@@ -1,0 +1,221 @@
+"""Online detection: incremental state parity + alert quality.
+
+The streaming layer's contract is that it is the SAME replay plane fed
+incrementally (anomod.replay.make_chunk_step), so parity with the batch
+path is exact for order-independent planes (0/1 counts, histogram, HLL
+max-merge) and allclose for the f32 moment sums (different chunk
+boundaries reorder the additions).
+"""
+
+import numpy as np
+
+from anomod import labels, synth
+from anomod.replay import ReplayConfig, replay_numpy, stage_columns
+from anomod.schemas import SpanBatch, concat_span_batches, take_spans
+from anomod.stream import OnlineDetector, StreamReplay, stream_experiment
+
+
+def _tt_batch(n_traces=40):
+    return concat_span_batches([
+        synth.generate_spans(l, n_traces=n_traces)
+        for l in labels.labels_for_testbed("TT")[:4]])
+
+
+def test_take_spans_subsets_rows():
+    b = _tt_batch(10)
+    idx = np.arange(0, b.n_spans, 3)
+    sub = take_spans(b, idx)
+    assert sub.n_spans == len(idx)
+    np.testing.assert_array_equal(sub.service, b.service[idx])
+    np.testing.assert_array_equal(sub.start_us, b.start_us[idx])
+    assert sub.services == b.services       # side tables kept whole
+
+
+def test_stream_state_matches_batch_replay():
+    batch = _tt_batch()
+    cfg = ReplayConfig(n_services=batch.n_services, chunk_size=2048)
+    chunks, n = stage_columns(batch, cfg)
+    ref = replay_numpy(chunks, cfg)
+
+    t0 = int(batch.start_us.min())
+    sr = StreamReplay(cfg, t0, with_hll=True)
+    order = np.argsort(batch.start_us, kind="stable")
+    batch = take_spans(batch, order)
+    # uneven micro-batches: chunk boundaries differ from the batch staging
+    cuts = [0, 1000, 1001, 5000, batch.n_spans]
+    for lo, hi in zip(cuts, cuts[1:]):
+        sr.push(take_spans(batch, slice(lo, hi)))
+    assert sr.n_spans == n
+    got = np.asarray(sr.state.agg)
+    # 0/1 planes + histogram: small-integer f32 sums, order-independent
+    np.testing.assert_array_equal(got[:, :3], ref.agg[:, :3])
+    np.testing.assert_array_equal(np.asarray(sr.state.hist), ref.hist)
+    # moment planes: f32 accumulation order differs -> allclose
+    np.testing.assert_allclose(got[:, 3:], ref.agg[:, 3:], rtol=1e-5,
+                               atol=1e-3)
+    # HLL registers max-merge, exactly order-independent: compare against
+    # a second stream fed as ONE batch
+    one = StreamReplay(cfg, t0, with_hll=True)
+    one.push(batch)
+    np.testing.assert_array_equal(np.asarray(sr.state.hll),
+                                  np.asarray(one.state.hll))
+
+
+def test_streaming_detects_and_localizes_kill_fault():
+    label = labels.label_for("Svc_Kill_UserTimeline")
+    exp = synth.generate_experiment(label, n_traces=300, seed=0)
+    det = stream_experiment(exp.spans)
+    ranked = det.ranked_services()
+    assert ranked and ranked[0] == label.target_service
+    onset = 10                               # fault onset 600 s, 60 s windows
+    fw = det.first_alert_window(label.target_service)
+    assert fw is not None and onset <= fw <= onset + 6
+
+
+def test_streaming_detects_latency_fault_tt():
+    label = labels.label_for("Lv_P_CPU_preserve")
+    exp = synth.generate_experiment(label, n_traces=300, seed=0)
+    det = stream_experiment(exp.spans)
+    ranked = det.ranked_services()
+    assert ranked and ranked[0] == label.target_service
+    fw = det.first_alert_window(label.target_service)
+    assert fw is not None and 10 <= fw <= 16
+
+
+def test_streaming_quiet_on_normal_baseline():
+    exp = synth.generate_experiment(labels.label_for("Normal_Baseline"),
+                                    n_traces=300, seed=0)
+    det = stream_experiment(exp.spans)
+    assert len(det.alerts) <= 2              # no alert storm without a fault
+
+
+def _uniform_batch(n_per_window, n_windows, n_services=2, window_us=60_000_000):
+    """Healthy constant-rate, constant-latency synthetic stream."""
+    rng = np.random.default_rng(0)
+    rows = n_per_window * n_windows * n_services
+    start = np.repeat(np.arange(n_windows, dtype=np.int64),
+                      n_per_window * n_services) * window_us
+    start = start + rng.integers(0, window_us, rows)
+    svc = np.tile(np.arange(n_services, dtype=np.int32),
+                  rows // n_services)
+    return SpanBatch(
+        trace=np.arange(rows, dtype=np.int32) % 100,
+        parent=np.full(rows, -1, np.int32),
+        service=svc, endpoint=np.zeros(rows, np.int32),
+        start_us=np.sort(start),
+        duration_us=rng.integers(900, 1100, rows).astype(np.int64),
+        is_error=np.zeros(rows, np.bool_),
+        status=np.full(rows, 200, np.int16),
+        kind=np.zeros(rows, np.int8),
+        services=tuple(f"svc{i}" for i in range(n_services)),
+        endpoints=("ep",), trace_ids=tuple(f"t{i}" for i in range(100)),
+    ).validate()
+
+
+def test_finish_does_not_score_empty_trailing_windows():
+    """A stream that ends at window 11 of a 32-window grid must not fire
+    the drop signal for windows 12..31 (stream end != fleet outage)."""
+    batch = _uniform_batch(n_per_window=20, n_windows=12)
+    cfg = ReplayConfig(n_services=2, n_windows=32, chunk_size=512)
+    det = OnlineDetector(batch.services, cfg, t0_us=0)
+    det.push(batch)
+    det.finish()
+    assert det.alerts == []
+
+
+def test_ring_rolls_past_grid_and_keeps_detecting():
+    """A live stream longer than the window grid keeps scoring: the ring
+    evicts old windows, alert indices stay absolute, and a fault at
+    window 30 of a 16-window grid is caught."""
+    batch = _uniform_batch(n_per_window=20, n_windows=40)
+    kill_us = 30 * 60_000_000
+    keep = ~((batch.service == 1) & (batch.start_us >= kill_us))
+    batch = take_spans(batch, keep)
+    cfg = ReplayConfig(n_services=2, n_windows=16, chunk_size=512)
+    det = OnlineDetector(batch.services, cfg, t0_us=0)
+    # window-sized micro-batches, as a live feed would deliver them
+    for w in range(40):
+        lo, hi = w * 60_000_000, (w + 1) * 60_000_000
+        m = (batch.start_us >= lo) & (batch.start_us < hi)
+        det.push(take_spans(batch, m))
+    det.finish()
+    assert det.replay.window_offset > 0          # the ring really rolled
+    dead = [a for a in det.alerts if a.service_name == "svc1"]
+    assert dead and dead[0].window in (30, 31)   # absolute indices
+    assert not [a for a in det.alerts if a.service_name == "svc0"]
+
+
+def test_feed_gap_wider_than_grid_no_alert_storm():
+    """A collector outage longer than the whole window grid: the anchor
+    advances by the FULL gap (spans after the gap bin into their true
+    absolute window) and the empty gap windows are skipped as feed
+    silence — not scored as a fleet-wide outage."""
+    cfg = ReplayConfig(n_services=2, n_windows=16, chunk_size=512)
+    healthy = _uniform_batch(n_per_window=20, n_windows=10)
+    det = OnlineDetector(healthy.services, cfg, t0_us=0)
+    det.push(healthy)
+    # 35-window silence, then healthy traffic resumes at window 45
+    resumed = _uniform_batch(n_per_window=20, n_windows=2)
+    resumed = resumed._replace(start_us=resumed.start_us + 45 * 60_000_000)
+    det.push(resumed)
+    det.finish()
+    assert det.alerts == []                      # no storm from the gap
+    # the resumed data landed at its true absolute windows (45, 46)
+    assert det.replay.window_offset == 46 - (cfg.n_windows - 1)
+    plane = det.replay.agg_plane()
+    nonzero_cols = np.nonzero(plane[..., 0].sum(axis=0))[0]
+    got_abs = set(int(c) + det.replay.window_offset for c in nonzero_cols)
+    assert got_abs == {45, 46}
+
+
+def test_consecutive_zero_rejected():
+    import pytest
+    cfg = ReplayConfig(n_services=2, n_windows=32)
+    with pytest.raises(ValueError, match="consecutive"):
+        OnlineDetector(("a", "b"), cfg, t0_us=0, consecutive=0)
+
+
+def test_gap_breaks_hysteresis_streak():
+    """With consecutive=2, hot windows on either side of a feed-silence
+    gap are NOT a consecutive run."""
+    cfg = ReplayConfig(n_services=2, n_windows=32, chunk_size=512)
+    base = _uniform_batch(n_per_window=20, n_windows=9)
+    det = OnlineDetector(base.services, cfg, t0_us=0, consecutive=2)
+    det.push(base)
+    # window 9 hot for svc1 (all errors), window 10 silent, window 11 hot
+    hot = _uniform_batch(n_per_window=20, n_windows=1)
+
+    def at(b, w):
+        return b._replace(start_us=b.start_us + w * 60_000_000,
+                          is_error=(b.service == 1),
+                          status=np.where(b.service == 1, 500,
+                                          b.status).astype(np.int16))
+    det.push(at(hot, 9))
+    det.push(at(hot, 11))
+    det.finish()
+    assert det.alerts == []          # 9 and 11 are separated by silence
+    """A service with no baseline traffic must not alert on its first busy
+    window (its mu/var would be fabricated) — but its drop signal stays
+    off too (nothing to drop from)."""
+    batch = _uniform_batch(n_per_window=20, n_windows=14)
+    late = (batch.service == 1) & (batch.start_us < 10 * 60_000_000)
+    batch = take_spans(batch, ~late)             # svc1 exists only from w10
+    cfg = ReplayConfig(n_services=2, n_windows=32, chunk_size=512)
+    det = OnlineDetector(batch.services, cfg, t0_us=0)
+    det.push(batch)
+    det.finish()
+    assert not [a for a in det.alerts if a.service_name == "svc1"]
+
+
+def test_detector_flags_throughput_drop():
+    """A service that stops emitting after window 9 alerts via z_drop."""
+    batch = _uniform_batch(n_per_window=20, n_windows=12)
+    keep = ~((batch.service == 1) & (batch.start_us >= 10 * 60_000_000))
+    cfg = ReplayConfig(n_services=2, n_windows=32, chunk_size=512)
+    det = OnlineDetector(batch.services, cfg, t0_us=0)
+    det.push(take_spans(batch, keep))
+    det.finish()
+    dead = [a for a in det.alerts if a.service_name == "svc1"]
+    assert dead and dead[0].window in (10, 11)
+    assert dead[0].z_drop >= det.z_threshold
+    assert not [a for a in det.alerts if a.service_name == "svc0"]
