@@ -169,7 +169,16 @@ def main(argv=None) -> int:
         "stream", help="online detection: replay an experiment's spans in "
         "arrival order through the incremental replay state and report the "
         "alert timeline + detection latency (streaming analog of `detect`)")
-    p_stream.add_argument("experiment")
+    p_stream.add_argument("experiment", nargs="?", default=None)
+    p_stream.add_argument("--all", action="store_true",
+                          help="run every experiment of --testbed and "
+                               "report the taxonomy-wide quality table "
+                               "(localization + detection latency); "
+                               "writes a bench_runs/ provenance record")
+    p_stream.add_argument("--testbed", choices=["SN", "TT"], default="TT",
+                          help="with --all: which taxonomy to run; "
+                               "single-experiment mode infers the testbed "
+                               "from the name")
     p_stream.add_argument("--traces", type=int, default=400)
     p_stream.add_argument("--seed", type=int, default=0)
     p_stream.add_argument("--slice-seconds", type=float, default=60.0,
@@ -259,9 +268,63 @@ def main(argv=None) -> int:
 
         from anomod import labels, synth
         from anomod.stream import stream_experiment
+        if bool(args.experiment) == bool(args.all):
+            parser.error("give an experiment name OR --all")
+        if args.all:
+            _probe_backend(args)
+            from anomod.stream import stream_quality
+            rows = stream_quality(
+                args.testbed, n_traces=args.traces, seed=args.seed,
+                slice_s=args.slice_seconds, z_threshold=args.threshold,
+                baseline_windows=args.baseline_windows,
+                consecutive=args.consecutive)
+            for r in rows:
+                print(json.dumps(r))
+            import statistics
+            rca_rows = [r for r in rows if "top1_hit" in r]
+            lats = [r["detection_latency_windows"] for r in rca_rows
+                    if r.get("detection_latency_windows") is not None]
+            summary = {
+                "testbed": args.testbed, "n_experiments": len(rows),
+                "top1": (sum(r["top1_hit"] for r in rca_rows)
+                         / len(rca_rows)) if rca_rows else None,
+                "top3": (sum(r["top3_hit"] for r in rca_rows)
+                         / len(rca_rows)) if rca_rows else None,
+                "median_detection_latency_windows":
+                    (statistics.median(lats) if lats else None),
+            }
+            print(json.dumps({"summary": summary}))
+            try:
+                import jax
+
+                from anomod.provenance import capture_record, write_capture
+                rec = capture_record(
+                    "stream_quality", float(len(rows)), "experiments",
+                    device=str(jax.devices()[0]), testbed=args.testbed,
+                    params=dict(n_traces=args.traces, seed=args.seed,
+                                slice_seconds=args.slice_seconds,
+                                threshold=args.threshold,
+                                baseline_windows=args.baseline_windows,
+                                consecutive=args.consecutive),
+                    summary=summary, rows=rows)
+                path = write_capture(rec)
+                if path:
+                    print(f"capture: {path}", file=sys.stderr)
+            except Exception:
+                pass
+            return 0
         label = labels.label_for(args.experiment)
         if label is None:
             parser.error(f"unknown experiment {args.experiment!r}")
+        # a non-default --testbed that contradicts the experiment's own
+        # testbed must not be silently dropped (same contract as the
+        # quality subcommand's cross-mode flag checks); the TT default
+        # can't be told apart from an explicit --testbed TT, hence only
+        # the detectable mismatch errors
+        if args.testbed != "TT" and label.testbed != args.testbed:
+            parser.error(f"{label.experiment} is a {label.testbed} "
+                         f"experiment; --testbed {args.testbed} "
+                         "contradicts it")
         _probe_backend(args)
         exp = synth.generate_experiment(label, n_traces=args.traces,
                                         seed=args.seed)

@@ -89,6 +89,18 @@ def test_streaming_quiet_on_normal_baseline():
     assert len(det.alerts) <= 2              # no alert storm without a fault
 
 
+def test_stream_quality_rows():
+    from anomod.stream import stream_quality
+    rows = stream_quality("SN", n_traces=300,
+                          experiments=["Normal_Baseline",
+                                       "Svc_Kill_UserTimeline"])
+    assert len(rows) == 2
+    normal, kill = rows
+    assert "top1_hit" not in normal          # no RCA row for the baseline
+    assert kill["top1_hit"] and kill["top3_hit"]
+    assert 0 <= kill["detection_latency_windows"] <= 6
+
+
 def _uniform_batch(n_per_window, n_windows, n_services=2, window_us=60_000_000):
     """Healthy constant-rate, constant-latency synthetic stream."""
     rng = np.random.default_rng(0)
