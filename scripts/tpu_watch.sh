@@ -28,7 +28,11 @@ for i in $(seq 1 200); do
       ANOMOD_SKIP_PROBE=1 timeout 2400 \
         python -m anomod.cli quality --testbed TT --sweep shift --json \
         > /tmp/tpu_watch_shift.log 2>&1
-      echo "=== shift sweep rc: $? (log /tmp/tpu_watch_shift.log) ==="
+      echo "=== TT shift sweep rc: $? (log /tmp/tpu_watch_shift.log) ==="
+      ANOMOD_SKIP_PROBE=1 timeout 2400 \
+        python -m anomod.cli quality --testbed SN --sweep shift --json \
+        > /tmp/tpu_watch_shift_sn.log 2>&1
+      echo "=== SN shift sweep rc: $? ==="
     fi
     after=$(ls bench_runs/*_tpu.json 2>/dev/null | wc -l)
     new=$((after - before))
