@@ -52,10 +52,21 @@ class Alert:
     window: int            # closed window index that scored anomalous
     service: int           # service id (index into the batch's table)
     service_name: str
-    score: float           # max of the three z-scores below
+    score: float           # RANKING score: max of the latency/error z and
+    #                        the drop z's weighted by their deficit
+    #                        FRACTION — may be far below the raw z fields
+    #                        (alerting thresholds the raw max; ranking
+    #                        needs specificity, see _score_through)
     z_latency: float       # standard-error z on the window's log-latency mean
     z_error: float         # binomial z on the window's error rate
-    z_drop: float          # Poisson z on missing throughput (dead service)
+    z_drop: float          # per-window z on missing throughput
+    z_drop_cum: float = 0.0  # CUSUM z: accumulated missing throughput over
+    #                          the current deficit run (resets when the
+    #                          service returns to its baseline rate) — the
+    #                          signal that catches a SPARSE service going
+    #                          dark (per-window evidence for a 3-spans/min
+    #                          service never clears any sane threshold;
+    #                          8 windows of total silence does)
 
 
 class StreamReplay:
@@ -155,7 +166,9 @@ class OnlineDetector:
     def __init__(self, batch_services: Sequence[str], cfg: ReplayConfig,
                  t0_us: int, baseline_windows: int = 8,
                  z_threshold: float = 4.0, min_count: float = 5.0,
-                 consecutive: int = 1, with_hll: bool = False):
+                 consecutive: int = 1, drop_memory: int = 8,
+                 call_edges: Optional[set] = None,
+                 with_hll: bool = False):
         if baseline_windows < 2:
             raise ValueError("need >= 2 baseline windows for a sigma")
         if baseline_windows >= cfg.n_windows:
@@ -170,11 +183,20 @@ class OnlineDetector:
         self.z_threshold = z_threshold
         self.min_count = min_count
         self.consecutive = consecutive
+        self.drop_memory = drop_memory
+        #: observed caller→callee service-id pairs (self-loops ignored);
+        #: enables dependency-aware culprit ranking in ranked_services
+        self.call_edges = {(a, b) for a, b in (call_edges or set())
+                           if a != b}
         self.alerts: List[Alert] = []
         self._scored_through = -1          # last closed ABSOLUTE window scored
         self._max_seen = -1                # newest absolute window with data
         self._streak = np.zeros(len(batch_services), np.int32)
         self._baseline = None              # frozen calibration snapshot
+        # CUSUM state for the cumulative drop signal: accumulated span
+        # deficit + length of the current deficit run, per service
+        self._cusum = np.zeros(len(batch_services), np.float64)
+        self._cusum_k = np.zeros(len(batch_services), np.int32)
 
     def push(self, batch: SpanBatch) -> List[Alert]:
         """Feed a micro-batch; returns alerts for newly closed windows.
@@ -255,7 +277,11 @@ class OnlineDetector:
         return dict(
             mu_l=mu_l, var_span=var_span, p_err=p_err, err_var=err_var,
             rate0=rate0,
-            active=rate0 >= self.min_count,   # drop signal needs traffic
+            active=rate0 >= self.min_count,   # per-window drop needs traffic
+            # the cumulative drop accumulates evidence across windows, so
+            # even ~1 span/window suffices — but a service with a near-zero
+            # baseline rate has nothing measurable to lose
+            cum_active=rate0 >= 1.0,
             # latency/error z need a calibrated baseline: a service unseen
             # (or barely seen) during calibration has a fabricated mu/var
             # and its first busy window would be a guaranteed false alert
@@ -278,20 +304,27 @@ class OnlineDetector:
         b = self._baseline
         cnt = plane[..., F_COUNT]
         off = self.replay.window_offset
+        # fleet-activity per column: a window where nobody reported is
+        # feed silence, skipped below (never evidence for any service)
+        fleet = cnt.sum(axis=0) > 0
         out: List[Alert] = []
         for w in range(start, through + 1):
             col = w - off
             if col < 0:          # evicted before it could be scored
                 self._streak[:] = 0      # a gap breaks any consecutive run
+                self._cusum[:] = 0.0
+                self._cusum_k[:] = 0
                 continue
-            if cnt[:, col].sum() <= 0:
+            if not fleet[col]:
                 # nobody at all reported in this window: that is feed
                 # silence (collector outage / gap), not per-service
                 # evidence — firing z_drop for EVERY active service would
                 # be an alert storm carrying no localization signal.  The
-                # silence also breaks hysteresis: windows on either side
-                # of a gap are not consecutive
+                # silence also breaks hysteresis and the CUSUM run:
+                # windows on either side of a gap are not consecutive
                 self._streak[:] = 0
+                self._cusum[:] = 0.0
+                self._cusum_k[:] = 0
                 continue
             n_w = cnt[:, col]
             safe = np.maximum(n_w, 1.0)
@@ -302,8 +335,42 @@ class OnlineDetector:
                           / np.sqrt(b["err_var"] / safe + b["var_be"]), 0.0)
             zd = np.where(b["active"],
                           (b["rate0"] - n_w) / b["sd_cnt"], 0.0)
-            score = np.maximum(np.maximum(zl, ze), zd)
-            hot = score >= self.z_threshold
+            # CUSUM on missing throughput: per-window Poisson evidence for
+            # a 2-3 spans/window service never clears the threshold, but
+            # several windows of silence accumulate to certainty.  The
+            # slack term keeps healthy jitter from accumulating; a window
+            # back at (or above) the baseline rate RESETS the run — no
+            # lingering "still down" alerts after recovery.  Run length is
+            # capped at drop_memory for the normalization.
+            healthy = n_w >= b["rate0"]
+            slack = 0.25 * b["sd_cnt"]
+            self._cusum = np.where(
+                healthy, 0.0,
+                np.maximum(0.0, self._cusum + b["rate0"] - n_w - slack))
+            self._cusum_k = np.where(
+                self._cusum > 0,
+                np.minimum(self._cusum_k + 1, self.drop_memory),
+                0).astype(np.int32)
+            k_run = np.maximum(self._cusum_k, 1)
+            zdc = np.where(b["cum_active"],
+                           self._cusum / (b["sd_cnt"] * np.sqrt(k_run)),
+                           0.0)
+            frac_t = np.clip(self._cusum / np.maximum(
+                k_run * b["rate0"], 1e-9), 0.0, 1.0)
+            # Detection vs localization: a high-fan-in carrier (the
+            # gateway) loses a FRACTION of its traffic when any callee
+            # dies, and its sheer volume makes that partial deficit a
+            # statistically huge z — certainty about a 30% dip must not
+            # outrank certainty about a service that went 100% dark.
+            # Alerts fire on the raw z (sensitivity); the recorded score
+            # used for culprit ranking weights the drop signals by their
+            # deficit FRACTION (specificity).
+            frac_w = np.clip(1.0 - n_w / np.maximum(b["rate0"], 1e-9),
+                             0.0, 1.0)
+            detect_z = np.maximum(np.maximum(zl, ze), np.maximum(zd, zdc))
+            score = np.maximum(np.maximum(zl, ze),
+                               np.maximum(zd * frac_w, zdc * frac_t))
+            hot = detect_z >= self.z_threshold
             self._streak = np.where(hot, self._streak + 1, 0)
             for s in np.nonzero(self._streak >= self.consecutive)[0]:
                 out.append(Alert(window=w, service=int(s),
@@ -311,7 +378,8 @@ class OnlineDetector:
                                  score=float(score[s]),
                                  z_latency=float(zl[s]),
                                  z_error=float(ze[s]),
-                                 z_drop=float(zd[s])))
+                                 z_drop=float(zd[s]),
+                                 z_drop_cum=float(zdc[s])))
         self._scored_through = through
         self.alerts.extend(out)
         return out
@@ -319,16 +387,149 @@ class OnlineDetector:
     # -- stream-mode quality metrics --------------------------------------
 
     def ranked_services(self) -> List[str]:
-        """Culprit ranking: peak alert score per service, descending."""
-        peak = {}
+        """Culprit ranking: deepest anomalous dependency first.
+
+        Peak alert score per service, but a service with an anomalous
+        service TRANSITIVELY downstream of it (reachable over the call
+        graph) ranks after services with none — a gateway/caller whose
+        error spike is (at least partly) explained by a misbehaving
+        dependency must not outrank that dependency, no matter how
+        statistically loud the blast radius is at the aggregation point,
+        and a healthy-but-silent middle hop must not shield the caller.
+        Reachability runs on the condensation (strongly-connected
+        components collapse to one node), so mutual call edges between
+        two anomalous services leave BOTH unexplained — peak order
+        decides — instead of degenerating the whole ranking.  Needs
+        ``call_edges``; without it, pure peak-score order."""
+        peak: dict = {}
+        windows: dict = {}
         for a in self.alerts:
-            peak[a.service_name] = max(peak.get(a.service_name, 0.0), a.score)
-        return sorted(peak, key=peak.get, reverse=True)
+            peak[a.service] = max(peak.get(a.service, 0.0), a.score)
+            windows.setdefault(a.service, set()).add(a.window)
+        anomalous = set(peak)
+        explained = _explained_by_downstream(self.call_edges, anomalous,
+                                             peaks=peak, windows=windows)
+
+        def key(s):
+            return (s in explained, -peak[s])
+
+        return [self.services[s] for s in sorted(peak, key=key)]
 
     def first_alert_window(self, service_name: Optional[str] = None):
         ws = [a.window for a in self.alerts
               if service_name is None or a.service_name == service_name]
         return min(ws) if ws else None
+
+
+def _explained_by_downstream(call_edges: set, anomalous: set,
+                             peaks: Optional[dict] = None,
+                             windows: Optional[dict] = None,
+                             rho: float = 0.6) -> set:
+    """Anomalous nodes explained by an anomalous node strictly downstream.
+
+    Condense the call graph into strongly-connected components (iterative
+    Tarjan), then mark an anomalous node "explained" iff some OTHER SCC
+    reachable from its own contains an anomalous node that passes two
+    guards (when the data is provided):
+
+    - **magnitude** (``peaks``): the downstream anomaly's peak ranking
+      score must be ≥ ``rho`` × the caller's — blame flows downstream
+      only onto an anomaly of comparable strength; a marginal noise
+      alert deep in the graph must not demote a loud true culprit above
+      it (the discriminating guard: blast-radius pairs score within ~2×
+      of each other, noise explainers sit far below);
+    - **temporal** (``windows``): at least one of the caller's alert
+      windows must be within ±1 of one of the explainer's — blame does
+      not flow onto an anomaly from a different time.  (Any-overlap, not
+      coverage: the sparse culprit's detection LAGS its blast radius, so
+      demanding wide coverage punishes exactly the case the attribution
+      exists for.)
+
+    Nodes locked in a cycle with their only anomalous dependency stay
+    unexplained — the edge direction carries no blame signal inside an
+    SCC."""
+    nodes = {n for e in call_edges for n in e} | set(anomalous)
+    adj = {n: [] for n in nodes}
+    for a, b in call_edges:
+        adj[a].append(b)
+    # iterative Tarjan SCC
+    index = {}
+    low = {}
+    comp = {}
+    stack, on_stack = [], set()
+    counter = [0]
+    n_comp = [0]
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(adj[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for u in it:
+                if u not in index:
+                    index[u] = low[u] = counter[0]
+                    counter[0] += 1
+                    stack.append(u)
+                    on_stack.add(u)
+                    work.append((u, iter(adj[u])))
+                    advanced = True
+                    break
+                if u in on_stack:
+                    low[v] = min(low[v], index[u])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                while True:
+                    u = stack.pop()
+                    on_stack.discard(u)
+                    comp[u] = n_comp[0]
+                    if u == v:
+                        break
+                n_comp[0] += 1
+    # condensation adjacency + anomalous members per SCC
+    canom = {}
+    for n in anomalous:
+        canom.setdefault(comp[n], set()).add(n)
+    cadj = {}
+    for a, b in call_edges:
+        if comp[a] != comp[b]:
+            cadj.setdefault(comp[a], set()).add(comp[b])
+    # anomalous nodes in strictly-downstream SCCs.  Tarjan emits SCCs in
+    # REVERSE topological order (every successor SCC is completed — gets a
+    # smaller id — before its predecessors), so one pass over component
+    # ids in emission order visits children before parents: no recursion,
+    # no stack-depth limit (the reason Tarjan above is iterative too).
+    memo = {}
+    for c in range(n_comp[0]):
+        acc = set()
+        for d in cadj.get(c, ()):
+            acc |= canom.get(d, set())
+            acc |= memo.get(d, set())
+        memo[c] = acc
+
+    def downstream_anom(c):
+        return memo[c]
+
+    def guards_pass(n, b):
+        if peaks is not None and peaks.get(b, 0.0) < rho * peaks.get(n, 0.0):
+            return False
+        if windows is not None:
+            wn, wb = windows.get(n, set()), windows.get(b, set())
+            if not any(x - 1 <= y <= x + 1 for x in wn for y in wb):
+                return False
+        return True
+
+    return {n for n in anomalous
+            if any(guards_pass(n, b) for b in downstream_anom(comp[n]))}
 
 
 def stream_quality(testbed: str = "TT", n_traces: int = 400, seed: int = 0,
@@ -378,6 +579,16 @@ def stream_experiment(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
     live feed.  Returns the finished :class:`OnlineDetector`.
     """
     cfg = cfg or ReplayConfig(n_services=batch.n_services, chunk_size=4096)
+    # observed call graph from span parents — computed on the FULL batch
+    # (time slices cut parent/child pairs across micro-batches, so the
+    # caller of each span must be resolved before slicing)
+    edges = set()
+    if batch.n_spans and "call_edges" not in detector_kw:
+        has_parent = batch.parent >= 0
+        callers = batch.service[batch.parent[has_parent]]
+        callees = batch.service[has_parent]
+        edges = set(zip(callers.tolist(), callees.tolist()))
+        detector_kw = dict(detector_kw, call_edges=edges)
     order = np.argsort(batch.start_us, kind="stable")
     batch = take_spans(batch, order)
     t0 = int(batch.start_us.min()) if batch.n_spans else 0

@@ -180,6 +180,49 @@ def test_feed_gap_wider_than_grid_no_alert_storm():
     assert got_abs == {45, 46}
 
 
+def test_dependency_aware_ranking_prefers_deepest_anomalous():
+    """A gateway whose error spike is explained by its dying callee must
+    rank BELOW the callee, even with a louder peak score."""
+    label = labels.label_for("Svc_Kill_UserTimeline")
+    exp = synth.generate_experiment(label, n_traces=300, seed=0)
+    det = stream_experiment(exp.spans)
+    ranked = det.ranked_services()
+    assert ranked[0] == "user-timeline-service"
+    # the gateway still alerted (detection kept its sensitivity)...
+    alerted = {a.service_name for a in det.alerts}
+    assert "nginx-web-server" in alerted
+    # ...but ranks behind the dependency that explains it (structural
+    # property of the attribution: anomalous-callee services sort last)
+    assert ranked.index("nginx-web-server") > \
+        ranked.index("user-timeline-service")
+    from anomod.stream import _explained_by_downstream
+    anomalous = {a.service for a in det.alerts}
+    explained = _explained_by_downstream(det.call_edges, anomalous)
+    clean = [det.services.index(n) not in explained for n in ranked]
+    assert clean == sorted(clean, reverse=True)   # unexplained first
+
+
+def test_explained_by_downstream_graph_cases():
+    from anomod.stream import _explained_by_downstream as ex
+    # direct edge: caller explained by anomalous callee
+    assert ex({(0, 1)}, {0, 1}) == {0}
+    # chain through a HEALTHY middle hop still explains the caller
+    assert ex({(0, 1), (1, 2)}, {0, 2}) == {0}
+    # mutual cycle: same SCC -> neither explained (peak order decides)
+    assert ex({(0, 1), (1, 0)}, {0, 1}) == set()
+    # cycle with a genuinely downstream anomaly: both cycle members explained
+    assert ex({(0, 1), (1, 0), (1, 2)}, {0, 1, 2}) == {0, 1}
+    # no edges -> nothing explained
+    assert ex(set(), {0, 1}) == set()
+    # cross-edge DAG: u->v visited via another branch first — u must
+    # still see v's transitive anomaly w (memo must be topo-ordered)
+    D, u, v, w = 0, 1, 2, 3
+    assert ex({(D, u), (D, v), (u, v), (v, w)}, {u, w}) == {u}
+    # deep chain (iterative closure, no recursion limit)
+    chain = {(i, i + 1) for i in range(3000)}
+    assert ex(chain, {0, 3000}) == {0}
+
+
 def test_consecutive_zero_rejected():
     import pytest
     cfg = ReplayConfig(n_services=2, n_windows=32)
@@ -217,6 +260,22 @@ def test_gap_breaks_hysteresis_streak():
     det.push(batch)
     det.finish()
     assert not [a for a in det.alerts if a.service_name == "svc1"]
+
+
+def test_cusum_resets_on_recovery():
+    """No lingering 'still down' alerts once traffic returns: the CUSUM
+    run resets at the first window back at the baseline rate."""
+    batch = _uniform_batch(n_per_window=20, n_windows=24)
+    outage = ((batch.service == 1)
+              & (batch.start_us >= 10 * 60_000_000)
+              & (batch.start_us < 14 * 60_000_000))
+    cfg = ReplayConfig(n_services=2, n_windows=32, chunk_size=512)
+    det = OnlineDetector(batch.services, cfg, t0_us=0)
+    det.push(take_spans(batch, ~outage))
+    det.finish()
+    dead = [a.window for a in det.alerts if a.service_name == "svc1"]
+    assert dead and min(dead) in (10, 11)        # outage caught
+    assert max(dead) <= 14                       # nothing after recovery
 
 
 def test_detector_flags_throughput_drop():
