@@ -188,6 +188,10 @@ def main(argv=None) -> int:
     p_stream.add_argument("--baseline-windows", type=int, default=8)
     p_stream.add_argument("--consecutive", type=int, default=1,
                           help="windows above threshold before alerting")
+    p_stream.add_argument("--multimodal", action="store_true",
+                          help="fuse the log/metric/api planes with the "
+                               "span stream (streaming counterpart of the "
+                               "offline five-modality detector)")
 
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
@@ -275,6 +279,7 @@ def main(argv=None) -> int:
             from anomod.stream import stream_quality
             rows = stream_quality(
                 args.testbed, n_traces=args.traces, seed=args.seed,
+                multimodal=args.multimodal,
                 slice_s=args.slice_seconds, z_threshold=args.threshold,
                 baseline_windows=args.baseline_windows,
                 consecutive=args.consecutive)
@@ -302,6 +307,7 @@ def main(argv=None) -> int:
                     "stream_quality", float(len(rows)), "experiments",
                     device=str(jax.devices()[0]), testbed=args.testbed,
                     params=dict(n_traces=args.traces, seed=args.seed,
+                                multimodal=args.multimodal,
                                 slice_seconds=args.slice_seconds,
                                 threshold=args.threshold,
                                 baseline_windows=args.baseline_windows,
@@ -328,10 +334,14 @@ def main(argv=None) -> int:
         _probe_backend(args)
         exp = synth.generate_experiment(label, n_traces=args.traces,
                                         seed=args.seed)
-        det = stream_experiment(exp.spans, slice_s=args.slice_seconds,
-                                z_threshold=args.threshold,
-                                baseline_windows=args.baseline_windows,
-                                consecutive=args.consecutive)
+        _kw = dict(slice_s=args.slice_seconds, z_threshold=args.threshold,
+                   baseline_windows=args.baseline_windows,
+                   consecutive=args.consecutive)
+        if args.multimodal:
+            from anomod.stream import stream_experiment_multimodal
+            det = stream_experiment_multimodal(exp, **_kw)
+        else:
+            det = stream_experiment(exp.spans, **_kw)
         ranked = det.ranked_services()
         win_s = det.replay.cfg.window_us / 1e6
         out = {

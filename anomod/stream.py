@@ -44,7 +44,7 @@ import numpy as np
 
 from anomod.replay import (F_COUNT, F_ERR, F_LOGLAT, N_FEATS, ReplayConfig,
                            ReplayState, make_chunk_step, stage_columns)
-from anomod.schemas import SpanBatch, take_spans
+from anomod.schemas import LOG_ERROR, SpanBatch, take_spans
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +67,10 @@ class Alert:
     #                          dark (per-window evidence for a 3-spans/min
     #                          service never clears any sane threshold;
     #                          8 windows of total silence does)
+    evidence: str = ""       # which signal won the ranking score for this
+    #                          alert: latency/error/drop/cusum, or a
+    #                          modality plane (log/metric/api) in the
+    #                          multimodal detector
 
 
 class StreamReplay:
@@ -364,12 +368,21 @@ class OnlineDetector:
             # outrank certainty about a service that went 100% dark.
             # Alerts fire on the raw z (sensitivity); the recorded score
             # used for culprit ranking weights the drop signals by their
-            # deficit FRACTION (specificity).
+            # deficit FRACTION (specificity).  Subclass modality planes
+            # (log/metric/api z's) join both sides at full weight — they
+            # are per-service direct evidence, not blast-radius carriers.
             frac_w = np.clip(1.0 - n_w / np.maximum(b["rate0"], 1e-9),
                              0.0, 1.0)
-            detect_z = np.maximum(np.maximum(zl, ze), np.maximum(zd, zdc))
-            score = np.maximum(np.maximum(zl, ze),
-                               np.maximum(zd * frac_w, zdc * frac_t))
+            extras = self._modality_z(w)
+            det_parts = dict(latency=zl, error=ze, drop=zd, cusum=zdc,
+                             **extras)
+            rank_parts = dict(latency=zl, error=ze, drop=zd * frac_w,
+                              cusum=zdc * frac_t, **extras)
+            detect_z = np.stack(list(det_parts.values())).max(axis=0)
+            rank_stack = np.stack(list(rank_parts.values()))
+            score = rank_stack.max(axis=0)
+            ev_names = list(rank_parts)
+            ev_idx = rank_stack.argmax(axis=0)
             hot = detect_z >= self.z_threshold
             self._streak = np.where(hot, self._streak + 1, 0)
             for s in np.nonzero(self._streak >= self.consecutive)[0]:
@@ -379,10 +392,20 @@ class OnlineDetector:
                                  z_latency=float(zl[s]),
                                  z_error=float(ze[s]),
                                  z_drop=float(zd[s]),
-                                 z_drop_cum=float(zdc[s])))
+                                 z_drop_cum=float(zdc[s]),
+                                 evidence=ev_names[int(ev_idx[s])]))
         self._scored_through = through
+        self._after_score(through)
         self.alerts.extend(out)
         return out
+
+    def _after_score(self, through: int) -> None:
+        """Hook after scoring advances (multimodal subclass prunes its
+        per-window host state here)."""
+
+    def _modality_z(self, w: int) -> dict:
+        """Hook for extra per-window z planes (multimodal subclass)."""
+        return {}
 
     # -- stream-mode quality metrics --------------------------------------
 
@@ -419,6 +442,314 @@ class OnlineDetector:
         ws = [a.window for a in self.alerts
               if service_name is None or a.service_name == service_name]
         return min(ws) if ws else None
+
+
+class MultimodalDetector(OnlineDetector):
+    """Online detector fusing all the time-resolved modalities.
+
+    The offline detector scores five modalities at experiment granularity
+    (anomod.detect.extract_features); this is its streaming counterpart:
+    logs, metrics, and API responses accumulate into per-(service,
+    absolute-window) host planes (kB/s volumes — the MXU plane is for
+    spans) and contribute three per-service z signals to every closed
+    window, fused with the span statistics in the base class:
+
+    - ``log``: Laplace-smoothed binomial z on the window's log-error rate
+      (collect_log.sh's error counting, made into a statistic);
+    - ``metric``: per-SERIES |z| of the window mean vs its own frozen
+      baseline (counters detected by monotone baseline means and
+      rate-ified by window diffs, Prometheus-style), max over the
+      service's series — this is the plane that localizes a killed
+      sparse service (request-rate collapse, error-rate series,
+      kube_pod restarts) when its span stream is too thin to matter;
+    - ``api``: binomial z on per-owner-service probe error rates
+      (endpoint→owner via the gateway route tables, as offline).
+
+    Coverage is not time-resolved (end-of-run artifact) and stays
+    offline-only.  Modalities must be pushed before the span push that
+    closes their windows (stream_experiment_multimodal slices all four
+    on one clock).
+    """
+
+    #: minimum lines/records in a window for its rate to be scored
+    MIN_EVENTS = 3.0
+
+    def __init__(self, batch_services: Sequence[str], cfg: ReplayConfig,
+                 t0_us: int, testbed: Optional[str] = None, **kw):
+        super().__init__(batch_services, cfg, t0_us, **kw)
+        self.testbed = testbed
+        self._t0_s = t0_us / 1e6
+        self._win_s = cfg.window_us / 1e6
+        self._svc_index = {s: i for i, s in enumerate(batch_services)}
+        S = len(batch_services)
+        self._S = S
+        self._log_tot: dict = {}     # abs window -> [S] float
+        self._log_err: dict = {}
+        self._api_tot: dict = {}
+        self._api_err: dict = {}
+        # metric series: canonical key -> {"svc": id, "win": {w: [sum, n]}}
+        self._met: dict = {}
+        self._mm_base: Optional[dict] = None
+        self._owner_cache: dict = {}
+
+    def _windows_of(self, t_s: np.ndarray) -> np.ndarray:
+        return ((t_s - self._t0_s) // self._win_s).astype(np.int64)
+
+    def push_logs(self, lb) -> None:
+        if lb is None or lb.n_lines == 0:
+            return
+        smap = np.array([self._svc_index.get(n, -1) for n in lb.services],
+                        np.int32)
+        svc = smap[lb.service]
+        w = self._windows_of(lb.t_s)
+        keep = (svc >= 0) & (w >= 0)
+        err = keep & (lb.level == LOG_ERROR)
+        for wv in np.unique(w[keep]):
+            m = keep & (w == wv)
+            tot = self._log_tot.setdefault(int(wv), np.zeros(self._S))
+            np.add.at(tot, svc[m], 1.0)
+            ev = self._log_err.setdefault(int(wv), np.zeros(self._S))
+            me = err & (w == wv)
+            np.add.at(ev, svc[me], 1.0)
+
+    def push_metrics(self, mb) -> None:
+        if mb is None or mb.n_samples == 0:
+            return
+        smap = np.array([self._svc_index.get(n, -1) for n in mb.services],
+                        np.int32)
+        w = self._windows_of(mb.t_s)
+        finite = np.isfinite(mb.value)
+        # one accumulator per (metric, label-set) PAIR: the schema allows
+        # a producer to reuse one series id (label-set id) across metrics,
+        # and pooling different metrics' values would poison the baseline
+        nm = len(mb.metric_names)
+        combo = mb.series.astype(np.int64) * nm + mb.metric
+        ok = finite & (w >= 0)
+        for cv in np.unique(combo[ok]):
+            si, mi = int(cv) // nm, int(cv) % nm
+            sv = mb.series_service[si]
+            svc = int(smap[sv]) if sv >= 0 else -1
+            if svc < 0:
+                continue
+            sel = ok & (combo == cv)
+            key = f"{mb.metric_names[mi]}|{mb.series_keys[si]}"
+            rec = self._met.setdefault(key, {"svc": svc, "win": {}})
+            for wv, val in zip(w[sel], mb.value[sel]):
+                acc = rec["win"].setdefault(int(wv), [0.0, 0])
+                acc[0] += float(val)
+                acc[1] += 1
+
+    def push_api(self, ab) -> None:
+        if ab is None or ab.n_records == 0:
+            return
+        from anomod.suite import endpoint_owner
+        owner = np.empty(len(ab.endpoints), np.int32)
+        for i, e in enumerate(ab.endpoints):
+            if e not in self._owner_cache:
+                self._owner_cache[e] = self._svc_index.get(
+                    endpoint_owner(e, self.testbed or "TT"), -1)
+            owner[i] = self._owner_cache[e]
+        svc = owner[ab.endpoint]
+        w = self._windows_of(ab.t_s)
+        keep = (svc >= 0) & (w >= 0)
+        err = keep & (ab.status >= 500)
+        for wv in np.unique(w[keep]):
+            m = keep & (w == wv)
+            tot = self._api_tot.setdefault(int(wv), np.zeros(self._S))
+            np.add.at(tot, svc[m], 1.0)
+            ev = self._api_err.setdefault(int(wv), np.zeros(self._S))
+            me = err & (w == wv)
+            np.add.at(ev, svc[me], 1.0)
+
+    # -- modality baselines + per-window z --------------------------------
+
+    def _rate_baseline(self, tot: dict, err: dict) -> dict:
+        B = self.baseline_windows
+        T0 = np.zeros(self._S)
+        E0 = np.zeros(self._S)
+        rates = []
+        for wv in range(B):
+            t = tot.get(wv)
+            if t is None:
+                continue
+            e = err.get(wv, np.zeros(self._S))
+            T0 += t
+            E0 += e
+            with np.errstate(invalid="ignore", divide="ignore"):
+                rates.append(np.where(t >= self.MIN_EVENTS, e / np.maximum(
+                    t, 1.0), np.nan))
+        p = (E0 + 1.0) / (T0 + 2.0)
+        var = np.maximum(p * (1.0 - p), 1e-6)
+        if rates:
+            stack = np.stack(rates)           # [B_present, S], NaN = too few
+            mask = np.isfinite(stack)
+            n = np.maximum(mask.sum(axis=0), 1)
+            mean = np.where(mask, stack, 0.0).sum(axis=0) / n
+            var_b = np.where(mask, (stack - mean) ** 2, 0.0).sum(axis=0) / n
+        else:
+            var_b = np.zeros(self._S)
+        return dict(p=p, var=var, var_b=var_b)
+
+    def _metric_baseline(self) -> dict:
+        B = self.baseline_windows
+        out = {}
+        for key, rec in self._met.items():
+            means = {wv: s / n for wv, (s, n) in rec["win"].items() if n}
+            base = [means[wv] for wv in range(B) if wv in means]
+            if len(base) < 3:
+                continue
+            arr = np.asarray(base)
+            counter = bool(np.all(np.diff(arr) >= -1e-12) and arr[-1] > arr[0])
+            if counter:
+                arr = np.diff(arr)
+            mu = float(arr.mean())
+            # relative sd floor: B windows underestimate a series' true
+            # spread often enough that a tighter floor turns ordinary
+            # gauge jitter into fake certainty (multiple testing over
+            # every series x window)
+            sd = float(max(arr.std(), 0.1 * (abs(mu) + 1.0)))
+            out[key] = dict(svc=rec["svc"], mu=mu, sd=sd, counter=counter)
+        return out
+
+    def _series_z(self, key: str, b: dict, w: int) -> float:
+        rec = self._met.get(key)
+        if rec is None:
+            return 0.0
+        acc = rec["win"].get(w)
+        if not acc or not acc[1]:
+            return 0.0
+        v = acc[0] / acc[1]
+        if b["counter"]:
+            prev = rec["win"].get(w - 1)
+            if not prev or not prev[1]:
+                return 0.0
+            v = v - prev[0] / prev[1]
+        return abs(v - b["mu"]) / b["sd"]
+
+    def _mm_calibrate(self) -> None:
+        self._mm_base = dict(
+            log=self._rate_baseline(self._log_tot, self._log_err),
+            api=self._rate_baseline(self._api_tot, self._api_err),
+            met=self._metric_baseline())
+
+    def _rate_z(self, w: int, tot: dict, err: dict, base: dict) -> np.ndarray:
+        t = tot.get(w)
+        if t is None:
+            return np.zeros(self._S)
+        e = err.get(w, np.zeros(self._S))
+        ok = t >= self.MIN_EVENTS
+        safe = np.maximum(t, 1.0)
+        return np.where(ok, (e / safe - base["p"])
+                        / np.sqrt(base["var"] / safe + base["var_b"]), 0.0)
+
+    def _metric_z(self, w: int) -> np.ndarray:
+        """Per-service metric z: max over the service's series of the
+        SUSTAINED two-window z (min of this window's and the previous
+        window's) — metric sampling noise is window-uncorrelated, fault
+        effects persist, so the min clips single-window spikes that the
+        per-series multiple testing would otherwise surface."""
+        z = np.zeros(self._S)
+        for key, b in self._mm_base["met"].items():
+            zi = min(self._series_z(key, b, w),
+                     self._series_z(key, b, w - 1))
+            s = b["svc"]
+            if zi > z[s]:
+                z[s] = zi
+        return z
+
+    def _modality_z(self, w: int) -> dict:
+        if self._mm_base is None:
+            self._mm_calibrate()
+        out = {}
+        if self._log_tot:
+            out["log"] = self._rate_z(w, self._log_tot, self._log_err,
+                                      self._mm_base["log"])
+        if self._api_tot:
+            out["api"] = self._rate_z(w, self._api_tot, self._api_err,
+                                      self._mm_base["api"])
+        if self._mm_base["met"]:
+            out["metric"] = self._metric_z(w)
+        return out
+
+    def _after_score(self, through: int) -> None:
+        """Bound the per-window host planes: once calibrated, windows
+        older than ``through - 1`` are never read again (counter diffs
+        need one lookback), so evict them — the modality state stays
+        O(ring), matching the span plane's bounded footprint on an
+        unbounded live stream."""
+        if self._mm_base is None:
+            return
+        cut = through - 1
+        for d in (self._log_tot, self._log_err, self._api_tot,
+                  self._api_err):
+            for wv in [k for k in d if k < cut]:
+                del d[wv]
+        for rec in self._met.values():
+            win = rec["win"]
+            for wv in [k for k in win if k < cut]:
+                del win[wv]
+
+
+#: per-batch-type row fields (explicit — a shape heuristic would corrupt
+#: a side table whose length coincidentally equals the sample count,
+#: e.g. MetricBatch.series_service when n_series == n_samples)
+_ROW_FIELDS = {
+    "LogBatch": ("service", "t_s", "level"),
+    "MetricBatch": ("metric", "series", "t_s", "value"),
+    "ApiBatch": ("endpoint", "t_s", "status", "latency_ms",
+                 "content_length"),
+}
+
+
+def _take_nt(nt, mask):
+    """Row-subset of a NamedTuple batch: sample-axis fields masked, side
+    tables kept whole."""
+    fields = _ROW_FIELDS[type(nt).__name__]
+    return nt._replace(**{f: getattr(nt, f)[mask] for f in fields})
+
+
+def stream_experiment_multimodal(exp, cfg: Optional[ReplayConfig] = None,
+                                 slice_s: float = 60.0, **detector_kw):
+    """Replay a full experiment bundle — spans, logs, metrics, API — in
+    arrival order through the multimodal online detector.  One clock
+    slices all four modalities; within each slice the low-volume
+    modalities are pushed first so their windows are populated before the
+    span push closes them.  Returns the finished detector."""
+    batch = exp.spans
+    cfg = cfg or ReplayConfig(n_services=batch.n_services, chunk_size=4096)
+    edges = set()
+    if batch.n_spans:
+        has_parent = batch.parent >= 0
+        edges = set(zip(batch.service[batch.parent[has_parent]].tolist(),
+                        batch.service[has_parent].tolist()))
+    order = np.argsort(batch.start_us, kind="stable")
+    batch = take_spans(batch, order)
+    t0 = int(batch.start_us.min()) if batch.n_spans else 0
+    det = MultimodalDetector(batch.services, cfg, t0, testbed=exp.testbed,
+                             call_edges=edges, **detector_kw)
+    if not batch.n_spans:
+        det.finish()
+        return det
+    t0_s = t0 / 1e6
+    end_s = float(batch.start_us.max()) / 1e6
+    lo_s = t0_s
+    while lo_s <= end_s:
+        hi_s = lo_s + slice_s
+        if exp.logs is not None and exp.logs.n_lines:
+            det.push_logs(_take_nt(exp.logs, (exp.logs.t_s >= lo_s)
+                                   & (exp.logs.t_s < hi_s)))
+        if exp.metrics is not None and exp.metrics.n_samples:
+            det.push_metrics(_take_nt(exp.metrics, (exp.metrics.t_s >= lo_s)
+                                      & (exp.metrics.t_s < hi_s)))
+        if exp.api is not None and exp.api.n_records:
+            det.push_api(_take_nt(exp.api, (exp.api.t_s >= lo_s)
+                                  & (exp.api.t_s < hi_s)))
+        m = (batch.start_us >= lo_s * 1e6) & (batch.start_us < hi_s * 1e6)
+        if m.any():
+            det.push(take_spans(batch, m))
+        lo_s = hi_s
+    det.finish()
+    return det
 
 
 def _explained_by_downstream(call_edges: set, anomalous: set,
@@ -534,13 +865,14 @@ def _explained_by_downstream(call_edges: set, anomalous: set,
 
 def stream_quality(testbed: str = "TT", n_traces: int = 400, seed: int = 0,
                    experiments: Optional[Sequence[str]] = None,
-                   **detector_kw) -> List[dict]:
+                   multimodal: bool = False, **detector_kw) -> List[dict]:
     """Streaming-mode quality over the full fault taxonomy: one row per
     experiment with localization (top1/top3 among alerted services) and
     signed detection latency in windows (fault onset = window 10).  The
     streaming analog of detect.evaluate_corpus — measures what the
     offline sweep cannot: how FAST the fault surfaces.  ``experiments``
-    filters to a subset by name (tests)."""
+    filters to a subset by name (tests); ``multimodal`` fuses the
+    log/metric/api planes (stream_experiment_multimodal)."""
     from anomod import labels, synth
     todo = labels.labels_for_testbed(testbed)
     if experiments is not None:
@@ -553,7 +885,8 @@ def stream_quality(testbed: str = "TT", n_traces: int = 400, seed: int = 0,
     rows = []
     for label in todo:
         exp = synth.generate_experiment(label, n_traces=n_traces, seed=seed)
-        det = stream_experiment(exp.spans, **detector_kw)
+        det = (stream_experiment_multimodal(exp, **detector_kw) if multimodal
+               else stream_experiment(exp.spans, **detector_kw))
         ranked = det.ranked_services()
         row = dict(experiment=label.experiment, testbed=testbed,
                    target_service=label.target_service,

@@ -223,6 +223,75 @@ def test_explained_by_downstream_graph_cases():
     assert ex(chain, {0, 3000}) == {0}
 
 
+def test_multimodal_catches_sparse_kill():
+    """The spans-only information floor (a sub-1-span/window service
+    killed) is closed by the metric plane: request-rate collapse and
+    error-rate series localize media-service directly."""
+    from anomod.stream import stream_experiment_multimodal
+    label = labels.label_for("Svc_Kill_Media")
+    exp = synth.generate_experiment(label, n_traces=300, seed=0)
+    span_only = stream_experiment(exp.spans)
+    assert span_only.first_alert_window("media-service") is None  # the floor
+    det = stream_experiment_multimodal(exp)
+    assert det.ranked_services()[0] == "media-service"
+    fw = det.first_alert_window("media-service")
+    assert fw is not None and 10 <= fw <= 13
+    culprit = [a for a in det.alerts if a.service_name == "media-service"]
+    assert any(a.evidence in ("metric", "log", "api") for a in culprit)
+
+
+def test_multimodal_quiet_on_normal():
+    from anomod.stream import stream_experiment_multimodal
+    exp = synth.generate_experiment(labels.label_for("Normal_Baseline"),
+                                    n_traces=300, seed=0)
+    det = stream_experiment_multimodal(exp)
+    assert len(det.alerts) <= 2
+
+
+def test_multimodal_state_stays_bounded():
+    """The per-window modality planes are pruned as scoring advances —
+    a long stream must not accumulate host state without bound."""
+    from anomod.schemas import LogBatch
+    from anomod.stream import MultimodalDetector
+    cfg = ReplayConfig(n_services=2, n_windows=16, chunk_size=512)
+    det = MultimodalDetector(("svc0", "svc1"), cfg, t0_us=0, testbed="TT")
+    for w in range(40):
+        spans = _uniform_batch(n_per_window=20, n_windows=1)
+        spans = spans._replace(start_us=spans.start_us + w * 60_000_000)
+        t = np.full(10, w * 60.0 + 5.0)
+        det.push_logs(LogBatch(service=np.zeros(10, np.int32), t_s=t,
+                               level=np.zeros(10, np.int8),
+                               services=("svc0", "svc1")))
+        det.push(spans)
+    det.finish()
+    assert len(det._log_tot) <= 4        # pruned, not 40
+
+
+def test_metric_counter_rateification():
+    """A healthy monotone counter (http_requests_total-style) must not
+    drift into a false alert: baseline-detected counters are scored on
+    window DIFFS."""
+    from anomod.stream import MultimodalDetector
+    from anomod.schemas import MetricBatch
+    cfg = ReplayConfig(n_services=2, n_windows=32, chunk_size=512)
+    spans = _uniform_batch(n_per_window=20, n_windows=20)
+    det = MultimodalDetector(spans.services, cfg, t0_us=0, testbed="TT")
+    # counter series for svc0: +240 per window, forever (healthy rate)
+    t = np.arange(0, 20 * 60, 15, dtype=np.float64)
+    mb = MetricBatch(
+        metric=np.zeros(t.shape[0], np.int32),
+        series=np.zeros(t.shape[0], np.int32),
+        t_s=t, value=np.cumsum(np.full(t.shape[0], 60.0)),
+        metric_names=("http_requests_total",), series_keys=('svc="svc0"',),
+        series_service=np.array([0], np.int32), services=spans.services)
+    det.push_metrics(mb)
+    det.push(spans)
+    det.finish()
+    assert det.alerts == []
+    base = det._mm_base["met"]['http_requests_total|svc="svc0"']
+    assert base["counter"]          # detected as a counter
+
+
 def test_consecutive_zero_rejected():
     import pytest
     cfg = ReplayConfig(n_services=2, n_windows=32)
