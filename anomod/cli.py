@@ -192,6 +192,15 @@ def main(argv=None) -> int:
                           help="fuse the log/metric/api planes with the "
                                "span stream (streaming counterpart of the "
                                "offline five-modality detector)")
+    p_stream.add_argument("--severity", type=float, default=1.0,
+                          help="de-saturate the fault effects "
+                               "(synth.HardMode) — the streaming "
+                               "degradation-curve knob")
+    p_stream.add_argument("--noise", type=float, default=0.0,
+                          help="widen baseline distributions (HardMode)")
+    p_stream.add_argument("--confounders", type=int, default=0,
+                          help="decoy services per experiment (--all only; "
+                               "same corpus builder as the quality sweep)")
 
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
@@ -280,6 +289,8 @@ def main(argv=None) -> int:
             rows = stream_quality(
                 args.testbed, n_traces=args.traces, seed=args.seed,
                 multimodal=args.multimodal,
+                severity=args.severity, noise=args.noise,
+                n_confounders=args.confounders,
                 slice_s=args.slice_seconds, z_threshold=args.threshold,
                 baseline_windows=args.baseline_windows,
                 consecutive=args.consecutive)
@@ -308,6 +319,8 @@ def main(argv=None) -> int:
                     device=str(jax.devices()[0]), testbed=args.testbed,
                     params=dict(n_traces=args.traces, seed=args.seed,
                                 multimodal=args.multimodal,
+                                severity=args.severity, noise=args.noise,
+                                confounders=args.confounders,
                                 slice_seconds=args.slice_seconds,
                                 threshold=args.threshold,
                                 baseline_windows=args.baseline_windows,
@@ -331,9 +344,14 @@ def main(argv=None) -> int:
             parser.error(f"{label.experiment} is a {label.testbed} "
                          f"experiment; --testbed {args.testbed} "
                          "contradicts it")
+        if args.confounders:
+            parser.error("--confounders applies to --all (the corpus "
+                         "builder picks per-experiment decoys); it would "
+                         "be silently ignored here")
         _probe_backend(args)
-        exp = synth.generate_experiment(label, n_traces=args.traces,
-                                        seed=args.seed)
+        exp = synth.generate_experiment(
+            label, n_traces=args.traces, seed=args.seed,
+            hard=synth.HardMode(severity=args.severity, noise=args.noise))
         _kw = dict(slice_s=args.slice_seconds, z_threshold=args.threshold,
                    baseline_windows=args.baseline_windows,
                    consecutive=args.consecutive)

@@ -60,9 +60,12 @@ def _pick_confounders(label, services: Tuple[str, ...], seed: int,
 
 def experiment_stream(testbed: str, seed: int, n_traces: int = 80,
                       hard: Optional["synth.HardMode"] = None,
-                      n_confounders: int = 0):
+                      n_confounders: int = 0,
+                      experiments: Optional[Sequence[str]] = None):
     """Yield ``(label, experiment)`` for every label of one seed — THE
-    corpus definition for quality evaluation.
+    corpus definition for quality evaluation.  ``experiments`` filters by
+    name BEFORE generation (a consumer-side filter would still pay the
+    synthesis cost of every skipped bundle).
 
     This is the single builder consumed by both the learned-model dataset
     (:func:`build_dataset`) and the training-free baselines
@@ -78,6 +81,8 @@ def experiment_stream(testbed: str, seed: int, n_traces: int = 80,
     svc_list = synth.SN_SERVICES if testbed == "SN" else synth.TT_SERVICES
     services = tuple(svc_list)
     for label in labels_mod.labels_for_testbed(testbed):
+        if experiments is not None and label.experiment not in experiments:
+            continue
         mode = hard or synth.HardMode()
         if n_confounders and label.is_anomaly:
             mode = dataclasses.replace(

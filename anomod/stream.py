@@ -412,9 +412,10 @@ class OnlineDetector:
     def ranked_services(self) -> List[str]:
         """Culprit ranking: deepest anomalous dependency first.
 
-        Peak alert score per service, but a service with an anomalous
-        service TRANSITIVELY downstream of it (reachable over the call
-        graph) ranks after services with none — a gateway/caller whose
+        SUMMED alert scores per service (persistence is signal — a
+        culprit sustains, a blast victim flickers), but a service with an
+        anomalous service TRANSITIVELY downstream of it (reachable over
+        the call graph) ranks after services with none — a gateway/caller whose
         error spike is (at least partly) explained by a misbehaving
         dependency must not outrank that dependency, no matter how
         statistically loud the blast radius is at the aggregation point,
@@ -425,18 +426,25 @@ class OnlineDetector:
         decides — instead of degenerating the whole ranking.  Needs
         ``call_edges``; without it, pure peak-score order."""
         peak: dict = {}
+        total: dict = {}
         windows: dict = {}
         for a in self.alerts:
             peak[a.service] = max(peak.get(a.service, 0.0), a.score)
+            total[a.service] = total.get(a.service, 0.0) + a.score
             windows.setdefault(a.service, set()).add(a.window)
         anomalous = set(peak)
         explained = _explained_by_downstream(self.call_edges, anomalous,
                                              peaks=peak, windows=windows)
 
+        # ranking key: SUM of alert scores, not the single peak — a
+        # culprit sustains its anomaly across the fault (many windows,
+        # several evidence channels) while a blast-radius victim flickers;
+        # persistence is signal the peak throws away.  Guards above still
+        # compare peaks (comparable instantaneous strength).
         def key(s):
-            return (s in explained, -peak[s])
+            return (s in explained, -total[s])
 
-        return [self.services[s] for s in sorted(peak, key=key)]
+        return [self.services[s] for s in sorted(total, key=key)]
 
     def first_alert_window(self, service_name: Optional[str] = None):
         ws = [a.window for a in self.alerts
@@ -755,7 +763,7 @@ def stream_experiment_multimodal(exp, cfg: Optional[ReplayConfig] = None,
 def _explained_by_downstream(call_edges: set, anomalous: set,
                              peaks: Optional[dict] = None,
                              windows: Optional[dict] = None,
-                             rho: float = 0.6) -> set:
+                             rho: float = 0.5) -> set:
     """Anomalous nodes explained by an anomalous node strictly downstream.
 
     Condense the call graph into strongly-connected components (iterative
@@ -767,14 +775,19 @@ def _explained_by_downstream(call_edges: set, anomalous: set,
       score must be ≥ ``rho`` × the caller's — blame flows downstream
       only onto an anomaly of comparable strength; a marginal noise
       alert deep in the graph must not demote a loud true culprit above
-      it (the discriminating guard: blast-radius pairs score within ~2×
-      of each other, noise explainers sit far below);
-    - **temporal** (``windows``): at least one of the caller's alert
-      windows must be within ±1 of one of the explainer's — blame does
-      not flow onto an anomaly from a different time.  (Any-overlap, not
-      coverage: the sparse culprit's detection LAGS its blast radius, so
-      demanding wide coverage punishes exactly the case the attribution
-      exists for.)
+      it;
+    - **onset** (``windows``): the explanation must start WITH the
+      symptom — the explainer's first alert may lag the caller's by at
+      most 2 windows (sparse-culprit detection lag) but never more: a
+      downstream victim that only turns anomalous 8 windows into the
+      caller's sustained anomaly is a consequence, not a cause (the
+      code-fault-in-the-caller case);
+    - **concentration** (``windows``): the explainer's activity must
+      either mostly fall inside the caller's anomalous interval (±1) or
+      cover at least half of that interval — an "explainer" that mostly
+      fires outside the symptom's period (scattered noise blips) explains
+      nothing, while a sustained culprit that OUTLASTS a briefly-detected
+      symptom still does.
 
     Nodes locked in a cycle with their only anomalous dependency stay
     unexplained — the edge direction carries no blame signal inside an
@@ -855,8 +868,16 @@ def _explained_by_downstream(call_edges: set, anomalous: set,
             return False
         if windows is not None:
             wn, wb = windows.get(n, set()), windows.get(b, set())
-            if not any(x - 1 <= y <= x + 1 for x in wn for y in wb):
+            if not wn or not wb:
                 return False
+            first_n, last_n = min(wn), max(wn)
+            if min(wb) > first_n + 2:          # consequence, not cause
+                return False
+            inside = sum(1 for y in wb
+                         if first_n - 1 <= y <= last_n + 1)
+            span_n = last_n - first_n + 1
+            if inside < 0.5 * len(wb) and inside < 0.5 * span_n:
+                return False                   # scattered blips
         return True
 
     return {n for n in anomalous
@@ -865,26 +886,33 @@ def _explained_by_downstream(call_edges: set, anomalous: set,
 
 def stream_quality(testbed: str = "TT", n_traces: int = 400, seed: int = 0,
                    experiments: Optional[Sequence[str]] = None,
-                   multimodal: bool = False, **detector_kw) -> List[dict]:
+                   multimodal: bool = False, severity: float = 1.0,
+                   noise: float = 0.0, n_confounders: int = 0,
+                   **detector_kw) -> List[dict]:
     """Streaming-mode quality over the full fault taxonomy: one row per
     experiment with localization (top1/top3 among alerted services) and
     signed detection latency in windows (fault onset = window 10).  The
     streaming analog of detect.evaluate_corpus — measures what the
     offline sweep cannot: how FAST the fault surfaces.  ``experiments``
     filters to a subset by name (tests); ``multimodal`` fuses the
-    log/metric/api planes (stream_experiment_multimodal)."""
-    from anomod import labels, synth
-    todo = labels.labels_for_testbed(testbed)
-    if experiments is not None:
-        todo = [l for l in todo if l.experiment in set(experiments)]
+    log/metric/api planes (stream_experiment_multimodal); ``severity`` /
+    ``noise`` / ``n_confounders`` de-saturate the generator via the SAME
+    corpus builder as the offline quality sweep (rca.experiment_stream) —
+    a streaming-vs-offline comparison at matching knobs scores identical
+    difficulty."""
+    from anomod import synth
+    from anomod.rca import experiment_stream
     # fault onset in WINDOWS follows the window width actually in use
     # (synth faults start at 600 s; a custom cfg rescales the grid)
     cfg = detector_kw.get("cfg")
     win_us = cfg.window_us if cfg is not None else 60_000_000
     onset_w = int(600_000_000 // win_us)
+    hard = synth.HardMode(severity=severity, noise=noise)
     rows = []
-    for label in todo:
-        exp = synth.generate_experiment(label, n_traces=n_traces, seed=seed)
+    for label, exp in experiment_stream(testbed, seed, n_traces=n_traces,
+                                        hard=hard,
+                                        n_confounders=n_confounders,
+                                        experiments=experiments):
         det = (stream_experiment_multimodal(exp, **detector_kw) if multimodal
                else stream_experiment(exp.spans, **detector_kw))
         ranked = det.ranked_services()
