@@ -201,6 +201,11 @@ def main(argv=None) -> int:
     p_stream.add_argument("--confounders", type=int, default=0,
                           help="decoy services per experiment (--all only; "
                                "same corpus builder as the quality sweep)")
+    p_stream.add_argument("--from-data", action="store_true",
+                          help="replay the experiment from the archived "
+                               "dataset tree (io.dataset loaders; LFS "
+                               "stubs -> synth) instead of generating — "
+                               "single-experiment mode only")
 
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
@@ -283,6 +288,9 @@ def main(argv=None) -> int:
         from anomod.stream import stream_experiment
         if bool(args.experiment) == bool(args.all):
             parser.error("give an experiment name OR --all")
+        if args.all and args.from_data:
+            parser.error("--from-data is single-experiment only; --all "
+                         "sweeps the generator taxonomy")
         if args.all:
             _probe_backend(args)
             from anomod.stream import stream_quality
@@ -348,10 +356,26 @@ def main(argv=None) -> int:
             parser.error("--confounders applies to --all (the corpus "
                          "builder picks per-experiment decoys); it would "
                          "be silently ignored here")
+        if args.from_data and (args.severity != 1.0 or args.noise != 0.0
+                               or args.seed != 0):
+            parser.error("--severity/--noise/--seed shape the GENERATOR; "
+                         "with --from-data the archived experiment is what "
+                         "it is")
         _probe_backend(args)
-        exp = synth.generate_experiment(
-            label, n_traces=args.traces, seed=args.seed,
-            hard=synth.HardMode(severity=args.severity, noise=args.noise))
+        if args.from_data:
+            from anomod.io import dataset
+            # load only what the detector consumes (coverage is not
+            # time-resolved and never streams)
+            mods = (["traces", "metrics", "logs", "api"]
+                    if args.multimodal else ["traces"])
+            exp = dataset.load_experiment(label.experiment,
+                                          modalities=mods,
+                                          n_synth_traces=args.traces)
+        else:
+            exp = synth.generate_experiment(
+                label, n_traces=args.traces, seed=args.seed,
+                hard=synth.HardMode(severity=args.severity,
+                                    noise=args.noise))
         _kw = dict(slice_s=args.slice_seconds, z_threshold=args.threshold,
                    baseline_windows=args.baseline_windows,
                    consecutive=args.consecutive)
@@ -371,7 +395,12 @@ def main(argv=None) -> int:
             "ranked_services": ranked[:5],
             "alerts": [_dc.asdict(a) for a in det.alerts[:50]],
         }
-        if label.is_anomaly:
+        # onset/latency report only when the corpus satisfies the synth
+        # fault-window invariant (onset 600 s).  Generated corpora always
+        # do; --from-data corpora may mix real archived artifacts (whose
+        # fault timing is arbitrary) with synth fallbacks, so no latency
+        # claim is made for them — localization fields still report.
+        if label.is_anomaly and not args.from_data:
             # synth faults activate in the middle third: onset 600 s
             onset_w = int(600.0 // win_s)
             fw = det.first_alert_window(label.target_service
