@@ -393,6 +393,14 @@ def main(argv=None) -> int:
             "window_seconds": win_s,
             "n_alerts": len(det.alerts),
             "ranked_services": ranked[:5],
+            # steady pipeline cost of the simulated live feed (staging +
+            # jitted chunk steps + modality planes + window scoring);
+            # one-time jit compilation is warmed in the constructor and
+            # reported separately
+            "push_wall_s": round(det.push_wall_s, 4),
+            "compile_s": round(det.replay.compile_s, 3),
+            "spans_per_sec": round(det.replay.n_spans
+                                   / max(det.push_wall_s, 1e-9), 1),
             "alerts": [_dc.asdict(a) for a in det.alerts[:50]],
         }
         # onset/latency report only when the corpus satisfies the synth

@@ -38,6 +38,7 @@ training-free first responder, not a replacement for them.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -102,6 +103,22 @@ class StreamReplay:
             hist=jnp.zeros((cfg.sw, cfg.n_hist_buckets), jnp.float32),
             hll=(jnp.zeros((cfg.n_services, cfg.hll_m), jnp.int32)
                  if with_hll else None))
+        # warm the jit NOW on an all-dead dummy chunk (sid = dead lane,
+        # valid = 0 → numerically a no-op on the state) so push() walls
+        # measure the steady pipeline, not one-time compilation
+        t0 = time.perf_counter()
+        dummy = {
+            "sid": jnp.full((cfg.chunk_size,), cfg.sw, jnp.int32),
+            "dur": jnp.zeros((cfg.chunk_size,), jnp.float32),
+            "dur_raw": jnp.zeros((cfg.chunk_size,), jnp.float32),
+            "err": jnp.zeros((cfg.chunk_size,), jnp.float32),
+            "s5": jnp.zeros((cfg.chunk_size,), jnp.float32),
+            "valid": jnp.zeros((cfg.chunk_size,), jnp.float32),
+            "tid": jnp.zeros((cfg.chunk_size,), jnp.int32),
+        }
+        self.state = self._step(self.state, dummy)
+        np.asarray(self.state.agg)                # compile + execute barrier
+        self.compile_s = time.perf_counter() - t0
 
     def _roll(self, k: int) -> None:
         """Evict the oldest ``k`` windows: shift plane columns left, zero
@@ -193,6 +210,10 @@ class OnlineDetector:
         self.call_edges = {(a, b) for a, b in (call_edges or set())
                            if a != b}
         self.alerts: List[Alert] = []
+        #: accumulated wall time inside push()/push_* (staging + jitted
+        #: chunk steps + window scoring) — the live pipeline's cost;
+        #: spans/sec = replay.n_spans / push_wall_s
+        self.push_wall_s = 0.0
         self._scored_through = -1          # last closed ABSOLUTE window scored
         self._max_seen = -1                # newest absolute window with data
         self._streak = np.zeros(len(batch_services), np.int32)
@@ -209,11 +230,15 @@ class OnlineDetector:
         replay ring rolls past its grid width).  The newest window comes
         from the replay itself — the detector never re-derives binning
         from raw timestamps."""
-        w_max = self.replay.push(batch)
-        if w_max < 0:
-            return []
-        self._max_seen = max(self._max_seen, w_max)
-        return self._score_through(self._max_seen - 1)
+        t0 = time.perf_counter()
+        try:
+            w_max = self.replay.push(batch)
+            if w_max < 0:
+                return []
+            self._max_seen = max(self._max_seen, w_max)
+            return self._score_through(self._max_seen - 1)
+        finally:
+            self.push_wall_s += time.perf_counter() - t0
 
     def finish(self) -> List[Alert]:
         """End of stream: the newest window with data counts as closed.
@@ -506,6 +531,7 @@ class MultimodalDetector(OnlineDetector):
     def push_logs(self, lb) -> None:
         if lb is None or lb.n_lines == 0:
             return
+        t0 = time.perf_counter()
         smap = np.array([self._svc_index.get(n, -1) for n in lb.services],
                         np.int32)
         svc = smap[lb.service]
@@ -519,10 +545,12 @@ class MultimodalDetector(OnlineDetector):
             ev = self._log_err.setdefault(int(wv), np.zeros(self._S))
             me = err & (w == wv)
             np.add.at(ev, svc[me], 1.0)
+        self.push_wall_s += time.perf_counter() - t0
 
     def push_metrics(self, mb) -> None:
         if mb is None or mb.n_samples == 0:
             return
+        t0 = time.perf_counter()
         smap = np.array([self._svc_index.get(n, -1) for n in mb.services],
                         np.int32)
         w = self._windows_of(mb.t_s)
@@ -546,10 +574,12 @@ class MultimodalDetector(OnlineDetector):
                 acc = rec["win"].setdefault(int(wv), [0.0, 0])
                 acc[0] += float(val)
                 acc[1] += 1
+        self.push_wall_s += time.perf_counter() - t0
 
     def push_api(self, ab) -> None:
         if ab is None or ab.n_records == 0:
             return
+        t0 = time.perf_counter()
         from anomod.suite import endpoint_owner
         owner = np.empty(len(ab.endpoints), np.int32)
         for i, e in enumerate(ab.endpoints):
@@ -568,6 +598,7 @@ class MultimodalDetector(OnlineDetector):
             ev = self._api_err.setdefault(int(wv), np.zeros(self._S))
             me = err & (w == wv)
             np.add.at(ev, svc[me], 1.0)
+        self.push_wall_s += time.perf_counter() - t0
 
     # -- modality baselines + per-window z --------------------------------
 
