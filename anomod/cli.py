@@ -201,6 +201,11 @@ def main(argv=None) -> int:
     p_stream.add_argument("--confounders", type=int, default=0,
                           help="decoy services per experiment (--all only; "
                                "same corpus builder as the quality sweep)")
+    p_stream.add_argument("--shift", default="in-dist",
+                          choices=["in-dist", "additive", "tail-only",
+                                   "bursty", "partial-window", "edge-locus"],
+                          help="--all only: evaluate under a shifted "
+                               "generator (quality.SHIFTS axes)")
     p_stream.add_argument("--from-data", action="store_true",
                           help="replay the experiment from the archived "
                                "dataset tree (io.dataset loaders; LFS "
@@ -298,7 +303,7 @@ def main(argv=None) -> int:
                 args.testbed, n_traces=args.traces, seed=args.seed,
                 multimodal=args.multimodal,
                 severity=args.severity, noise=args.noise,
-                n_confounders=args.confounders,
+                n_confounders=args.confounders, shift=args.shift,
                 slice_s=args.slice_seconds, z_threshold=args.threshold,
                 baseline_windows=args.baseline_windows,
                 consecutive=args.consecutive)
@@ -329,6 +334,7 @@ def main(argv=None) -> int:
                                 multimodal=args.multimodal,
                                 severity=args.severity, noise=args.noise,
                                 confounders=args.confounders,
+                                shift=args.shift,
                                 slice_seconds=args.slice_seconds,
                                 threshold=args.threshold,
                                 baseline_windows=args.baseline_windows,
@@ -356,6 +362,9 @@ def main(argv=None) -> int:
             parser.error("--confounders applies to --all (the corpus "
                          "builder picks per-experiment decoys); it would "
                          "be silently ignored here")
+        if args.shift != "in-dist":
+            parser.error("--shift applies to --all; it would be silently "
+                         "ignored here")
         if args.from_data and (args.severity != 1.0 or args.noise != 0.0
                                or args.seed != 0):
             parser.error("--severity/--noise/--seed shape the GENERATOR; "

@@ -103,9 +103,17 @@ class StreamReplay:
             hist=jnp.zeros((cfg.sw, cfg.n_hist_buckets), jnp.float32),
             hll=(jnp.zeros((cfg.n_services, cfg.hll_m), jnp.int32)
                  if with_hll else None))
-        # warm the jit NOW on an all-dead dummy chunk (sid = dead lane,
-        # valid = 0 → numerically a no-op on the state) so push() walls
-        # measure the steady pipeline, not one-time compilation
+        #: one-time jit compile wall, measured at the first push (lazy —
+        #: a detector constructed but never fed must not pay the compile)
+        self.compile_s = 0.0
+        self._warmed = False
+
+    def _warm(self) -> None:
+        """Compile the chunk step on an all-dead dummy chunk (sid = dead
+        lane, valid = 0 → numerically a no-op on the state) so push()
+        walls measure the steady pipeline, not one-time compilation."""
+        import jax.numpy as jnp
+        cfg = self.cfg
         t0 = time.perf_counter()
         dummy = {
             "sid": jnp.full((cfg.chunk_size,), cfg.sw, jnp.int32),
@@ -119,6 +127,7 @@ class StreamReplay:
         self.state = self._step(self.state, dummy)
         np.asarray(self.state.agg)                # compile + execute barrier
         self.compile_s = time.perf_counter() - t0
+        self._warmed = True
 
     def _roll(self, k: int) -> None:
         """Evict the oldest ``k`` windows: shift plane columns left, zero
@@ -152,6 +161,8 @@ class StreamReplay:
         so consumers never re-derive it from raw timestamps."""
         if batch.n_spans == 0:
             return -1
+        if not self._warmed:
+            self._warm()
         w_need = int((int(batch.start_us.max()) - self.t0_us)
                      // self.cfg.window_us)
         if w_need > self.cfg.n_windows - 1:
@@ -230,6 +241,8 @@ class OnlineDetector:
         replay ring rolls past its grid width).  The newest window comes
         from the replay itself — the detector never re-derives binning
         from raw timestamps."""
+        if batch.n_spans and not self.replay._warmed:
+            self.replay._warm()          # compile outside the timed wall
         t0 = time.perf_counter()
         try:
             w_max = self.replay.push(batch)
@@ -919,7 +932,7 @@ def stream_quality(testbed: str = "TT", n_traces: int = 400, seed: int = 0,
                    experiments: Optional[Sequence[str]] = None,
                    multimodal: bool = False, severity: float = 1.0,
                    noise: float = 0.0, n_confounders: int = 0,
-                   **detector_kw) -> List[dict]:
+                   shift: str = "in-dist", **detector_kw) -> List[dict]:
     """Streaming-mode quality over the full fault taxonomy: one row per
     experiment with localization (top1/top3 among alerted services) and
     signed detection latency in windows (fault onset = window 10).  The
@@ -930,15 +943,20 @@ def stream_quality(testbed: str = "TT", n_traces: int = 400, seed: int = 0,
     ``noise`` / ``n_confounders`` de-saturate the generator via the SAME
     corpus builder as the offline quality sweep (rca.experiment_stream) —
     a streaming-vs-offline comparison at matching knobs scores identical
-    difficulty."""
+    difficulty; ``shift`` evaluates under the offline sweep's shifted
+    generators (quality.SHIFTS: effect shape / fault timing / locus) —
+    the detector is training-free, so this measures raw statistic
+    robustness, e.g. whether bursty on/off faults defeat the CUSUM's
+    recovery reset."""
     from anomod import synth
+    from anomod.quality import SHIFTS
     from anomod.rca import experiment_stream
     # fault onset in WINDOWS follows the window width actually in use
     # (synth faults start at 600 s; a custom cfg rescales the grid)
     cfg = detector_kw.get("cfg")
     win_us = cfg.window_us if cfg is not None else 60_000_000
     onset_w = int(600_000_000 // win_us)
-    hard = synth.HardMode(severity=severity, noise=noise)
+    hard = synth.HardMode(severity=severity, noise=noise, **SHIFTS[shift])
     rows = []
     for label, exp in experiment_stream(testbed, seed, n_traces=n_traces,
                                         hard=hard,
