@@ -20,10 +20,16 @@ def _probe_backend(args) -> None:
     first backend touch.  Called AFTER each subcommand's cheap flag
     validation so usage errors stay instant; ANOMOD_PLATFORM=cpu skips it
     by pinning up front, ANOMOD_SKIP_PROBE=1 skips it trusting the
-    backend."""
-    if os.environ.get("ANOMOD_PLATFORM", "").strip().lower() == "cpu":
+    backend.  A process where pin_cpu already ran (the test suite calling
+    main() in-process, any embedder) skips too — via the process-local
+    pin flag, NOT the JAX_PLATFORMS env var, which the container's
+    sitecustomize renders non-binding (a user exporting it with a dead
+    tunnel still needs the probe to pin for real)."""
+    from anomod.utils.platform import (ensure_live_backend, env_number,
+                                       is_pinned)
+    if os.environ.get("ANOMOD_PLATFORM", "").strip().lower() == "cpu" \
+            or is_pinned():
         return
-    from anomod.utils.platform import ensure_live_backend, env_number
     # the fallback mesh must be large enough for an explicitly requested
     # virtual device count (replay --devices N)
     n_fallback = max(env_number("ANOMOD_CPU_DEVICES", 1),

@@ -154,12 +154,26 @@ def with_cpu_failover(fn, n_cpu=None, on_failover=None, _platform=None):
         return fn()
 
 
+#: process-local record that pin_cpu ran — the ONLY trustworthy "we are
+#: on CPU" signal (the JAX_PLATFORMS env var is not binding here: the
+#: container sitecustomize force-registers the TPU platform regardless,
+#: see the module docstring)
+_PINNED = False
+
+
+def is_pinned() -> bool:
+    """True when pin_cpu already pinned THIS process to the CPU backend
+    (probing for a live device backend is pointless then)."""
+    return _PINNED
+
+
 def pin_cpu(n_devices: int = 1) -> None:
     """Pin this process's JAX to ``n_devices`` virtual CPU devices.
 
     Safe to call before or after backend init; must be called before any
     device-touching call to avoid the dead-tunnel hang.
     """
+    global _PINNED
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
@@ -172,3 +186,4 @@ def pin_cpu(n_devices: int = 1) -> None:
     clear_backends()
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", n_devices)
+    _PINNED = True
