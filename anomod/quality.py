@@ -142,6 +142,45 @@ def _zscore_eval(testbed: str, seeds: Sequence[int],
             float(np.mean(aucs)), n)
 
 
+def _stream_eval(testbed: str, seeds: Sequence[int],
+                 hard: "synth.HardMode", n_confounders: int,
+                 n_traces: int) -> Tuple[float, float, float, int]:
+    """Training-free multimodal STREAMING detector over the same corpora.
+
+    Same contract as :func:`_zscore_eval` (identical bundles via
+    rca.experiment_stream, rank-based AUC over per-experiment detection
+    scores) so `stream` sits in the quality table cell-for-cell with the
+    offline rows.  Note the sweep's corpora are much sparser than live
+    traffic (n_traces=60 vs the streaming benchmark's 400) — this row
+    measures the detector under the OFFLINE sweep's density, its hardest
+    setting.
+    """
+    from anomod.stream import stream_experiment_multimodal
+    top1s, top3s, aucs, n = [], [], [], 0
+    for seed in seeds:
+        hits1 = hits3 = cases = 0
+        pos, neg = [], []
+        for label, exp in experiment_stream(
+                testbed, seed, n_traces=n_traces, hard=hard,
+                n_confounders=n_confounders):
+            det = stream_experiment_multimodal(exp)
+            score = max((a.score for a in det.alerts), default=0.0)
+            (pos if label.is_anomaly else neg).append(score)
+            if label.is_anomaly and label.target_service:
+                ranked = det.ranked_services()
+                hits1 += bool(ranked) and ranked[0] == label.target_service
+                hits3 += label.target_service in ranked[:3]
+                cases += 1
+        top1s.append(hits1 / cases if cases else 0.0)
+        top3s.append(hits3 / cases if cases else 0.0)
+        p, q = np.asarray(pos), np.asarray(neg)
+        aucs.append(float((p[:, None] > q[None, :]).mean())
+                    if len(p) and len(q) else 1.0)
+        n += cases
+    return (float(np.mean(top1s)), float(np.mean(top3s)),
+            float(np.mean(aucs)), n)
+
+
 def severity_sweep(testbed: str = "TT",
                    model_names: Sequence[str] = ("zscore", "gcn", "gat",
                                                  "sage", "temporal", "lru",
@@ -203,7 +242,10 @@ def _eval_grid(testbed, model_names, eval_modes: Dict[object, "synth.HardMode"],
     then every model evaluated on every eval-mode corpus.  Returns
     {(model, mode_key): (top1, top3, auc, n_eval)}; corpora per cell are
     identical across models (rca.experiment_stream via build_dataset)."""
-    needs_training = any(name != "zscore" for name in model_names)
+    # zscore and stream are training-free rows — only the learned models
+    # need the mixed-severity training corpus and eval batches
+    needs_training = any(name not in ("zscore", "stream")
+                         for name in model_names)
     train = None
     if needs_training:
         # mixed-severity training corpus: full + mid + low thirds of the seeds
@@ -265,12 +307,13 @@ def _eval_grid(testbed, model_names, eval_modes: Dict[object, "synth.HardMode"],
 
     cells: Dict[Tuple[str, object], Tuple[float, float, float, int]] = {}
     for name in model_names:
-        if name == "zscore":
+        if name in ("zscore", "stream"):
+            ev_fn = _zscore_eval if name == "zscore" else _stream_eval
             for key, mode in eval_modes.items():
-                cells[(name, key)] = _zscore_eval(
+                cells[(name, key)] = ev_fn(
                     testbed, eval_seeds, mode, n_confounders, n_traces)
                 if verbose:
-                    print(f"zscore {key}: top1={cells[(name, key)][0]:.2f}")
+                    print(f"{name} {key}: top1={cells[(name, key)][0]:.2f}")
             continue
         row = platform.with_cpu_failover(
             lambda: _train_and_eval(name),

@@ -52,6 +52,19 @@ def test_model_separation(sweep_points):
             > _point(sweep_points, "zscore", 0.2).top1)
 
 
+def test_stream_row_floor_in_hard_regime():
+    """The training-free multimodal STREAMING detector rides the same
+    sweep contract and must hold its de-saturated floor: measured 0.75
+    top-1 at severity 0.2 on the canonical table (within-experiment
+    temporal calibration beats whole-experiment aggregates at low
+    signal)."""
+    pts = severity_sweep(model_names=("stream",), severities=(0.2,),
+                         eval_seeds=[100], n_traces=60)
+    assert len(pts) == 1
+    assert pts[0].top1 >= 0.5, pts[0]
+    assert pts[0].top3 >= 0.6, pts[0]
+
+
 def test_zscore_and_model_paths_consume_identical_corpora():
     """Round-2 weak #3: both quality-table paths must score the SAME
     experiment bundles.  Records every synth.generate_experiment call made
