@@ -320,6 +320,9 @@ def test_gap_breaks_hysteresis_streak():
     det.push(at(hot, 11))
     det.finish()
     assert det.alerts == []          # 9 and 11 are separated by silence
+
+
+def test_uncalibrated_service_does_not_false_alert():
     """A service with no baseline traffic must not alert on its first busy
     window (its mu/var would be fabricated) — but its drop signal stays
     off too (nothing to drop from)."""
@@ -331,6 +334,42 @@ def test_gap_breaks_hysteresis_streak():
     det.push(batch)
     det.finish()
     assert not [a for a in det.alerts if a.service_name == "svc1"]
+
+
+def test_ring_random_jumps_match_absolute_accumulator():
+    """Property test for the ring math: arbitrary monotone window jumps
+    (including gaps wider than the grid) must leave every retained ring
+    column equal to a naive absolute-window accumulator."""
+    rng = np.random.default_rng(7)
+    W, S = 8, 2
+    cfg = ReplayConfig(n_services=S, n_windows=W, chunk_size=256)
+    sr = StreamReplay(cfg, t0_us=0)
+    truth = {}                      # abs window -> [S] span counts
+    w_abs = 0
+    for _ in range(25):
+        w_abs += int(rng.integers(0, 14))      # jumps 0..13 (> grid ok)
+        n = int(rng.integers(1, 30))
+        svc = rng.integers(0, S, n).astype(np.int32)
+        start = (np.full(n, w_abs, np.int64) * cfg.window_us
+                 + rng.integers(0, cfg.window_us, n))
+        batch = SpanBatch(
+            trace=np.zeros(n, np.int32), parent=np.full(n, -1, np.int32),
+            service=svc, endpoint=np.zeros(n, np.int32),
+            start_us=np.sort(start),
+            duration_us=np.full(n, 1000, np.int64),
+            is_error=np.zeros(n, np.bool_),
+            status=np.full(n, 200, np.int16), kind=np.zeros(n, np.int8),
+            services=("a", "b"), endpoints=("e",), trace_ids=("t",),
+        )
+        got_w = sr.push(batch)
+        assert got_w == w_abs       # true absolute window, post-roll
+        t = truth.setdefault(w_abs, np.zeros(S))
+        np.add.at(t, svc, 1.0)
+    plane = sr.agg_plane()          # [S, W, F]
+    for col in range(W):
+        w = sr.window_offset + col
+        expect = truth.get(w, np.zeros(S))
+        np.testing.assert_array_equal(plane[:, col, 0], expect)
 
 
 def test_cusum_resets_on_recovery():
