@@ -1,0 +1,117 @@
+"""Pod-sharded ONLINE replay: the streaming plane over the device mesh.
+
+A live feed hot enough to saturate one chip shards the same way the batch
+replay does: each push's chunks spread over the mesh's data axis, every
+device scans its chunk with the shared chunk step, and the per-push state
+delta psum-merges over ICI before folding into the running ring
+(anomod.parallel.replay.make_sharded_replay_fn is reused wholesale — one
+definition of the sharded aggregation for batch and stream).
+
+:class:`ShardedStreamReplay` duck-types :class:`anomod.stream.StreamReplay`
+(push / agg_plane / ring roll / compile bookkeeping), so
+``OnlineDetector(..., replay=...)`` runs the full alerting stack over the
+mesh unchanged.  Pushes are processed in fixed groups of ``n_dev`` chunks
+(the last group padded with dead chunks), so the shard_map compiles ONCE
+regardless of micro-batch size.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from anomod.parallel.replay import make_sharded_replay_fn
+from anomod.replay import N_FEATS, ReplayConfig, ReplayState, stage_columns
+from anomod.schemas import SpanBatch
+from anomod.stream import plane_view, roll_ring_state
+
+
+class ShardedStreamReplay:
+    """Mesh-sharded drop-in for the single-chip StreamReplay."""
+
+    def __init__(self, cfg: ReplayConfig, t0_us: int, mesh,
+                 axis: str = "data"):
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.t0_us = int(t0_us)
+        self.window_offset = 0
+        self.n_spans = 0
+        self.mesh = mesh
+        self.axis = axis
+        self.n_dev = int(mesh.shape[axis])
+        self._fn = make_sharded_replay_fn(cfg, mesh, axis=axis)
+        self.state = ReplayState(
+            agg=jnp.zeros((cfg.sw, N_FEATS), jnp.float32),
+            hist=jnp.zeros((cfg.sw, cfg.n_hist_buckets), jnp.float32))
+        self.compile_s = 0.0
+        self._warmed = False
+
+    # -- ring maintenance (the one shared definition) ---------------------
+
+    def _roll(self, k: int) -> None:
+        self.state = roll_ring_state(self.state, self.cfg, k)
+        self.t0_us += k * self.cfg.window_us
+        self.window_offset += k
+
+    # -- push -------------------------------------------------------------
+
+    def _dead_chunk(self) -> dict:
+        c = self.cfg.chunk_size
+        return dict(sid=np.full((1, c), self.cfg.sw, np.int32),
+                    dur=np.zeros((1, c), np.float32),
+                    dur_raw=np.zeros((1, c), np.float32),
+                    err=np.zeros((1, c), np.float32),
+                    s5=np.zeros((1, c), np.float32),
+                    valid=np.zeros((1, c), np.float32),
+                    tid=np.zeros((1, c), np.int32))
+
+    def _run_group(self, group: dict) -> ReplayState:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        dev = {k: jax.device_put(v, sharding) for k, v in group.items()}
+        return self._fn(dev)
+
+    def _warm(self) -> None:
+        t0 = time.perf_counter()
+        dead = self._dead_chunk()
+        group = {k: np.repeat(v, self.n_dev, axis=0)
+                 for k, v in dead.items()}
+        np.asarray(self._run_group(group).agg)     # compile barrier
+        self.compile_s = time.perf_counter() - t0
+        self._warmed = True
+
+    def push(self, batch: SpanBatch) -> int:
+        """Same contract as StreamReplay.push: fold, return the newest
+        ABSOLUTE window binned (-1 for empty)."""
+        import jax.numpy as jnp
+        if batch.n_spans == 0:
+            return -1
+        if not self._warmed:
+            self._warm()
+        w_need = int((int(batch.start_us.max()) - self.t0_us)
+                     // self.cfg.window_us)
+        if w_need > self.cfg.n_windows - 1:
+            self._roll(w_need - (self.cfg.n_windows - 1))
+            w_need = self.cfg.n_windows - 1
+        chunks, n = stage_columns(batch, self.cfg, t0_us=self.t0_us)
+        n_chunks = chunks["sid"].shape[0]
+        dead = self._dead_chunk()
+        for lo in range(0, n_chunks, self.n_dev):
+            group = {k: v[lo:lo + self.n_dev] for k, v in chunks.items()}
+            short = self.n_dev - group["sid"].shape[0]
+            if short:
+                group = {k: np.concatenate(
+                    [v, np.repeat(dead[k], short, axis=0)])
+                    for k, v in group.items()}
+            delta = self._run_group(group)
+            self.state = ReplayState(
+                agg=self.state.agg + delta.agg,
+                hist=self.state.hist + jnp.asarray(delta.hist))
+        self.n_spans += n
+        return self.window_offset + max(w_need, 0)
+
+    def agg_plane(self) -> np.ndarray:
+        return plane_view(self.state, self.cfg)

@@ -74,6 +74,33 @@ class Alert:
     #                          multimodal detector
 
 
+def roll_ring_state(state: ReplayState, cfg: ReplayConfig,
+                    k: int) -> ReplayState:
+    """Evict the oldest ``k`` windows from a ring-shaped ReplayState:
+    shift plane columns left, zero the tail (anchor bookkeeping is the
+    caller's).  ONE definition of the ring-eviction math, shared by the
+    single-chip and mesh-sharded streaming planes.  HLL registers are
+    per-service (not per-window) and pass through untouched."""
+    import jax.numpy as jnp
+    shift = min(k, cfg.n_windows)
+
+    def roll2(x, width):
+        x = np.asarray(x).reshape(cfg.n_services, cfg.n_windows, width)
+        out = np.zeros_like(x)
+        if shift < cfg.n_windows:
+            out[:, :cfg.n_windows - shift] = x[:, shift:]
+        return jnp.asarray(out.reshape(cfg.sw, width))
+
+    return state._replace(agg=roll2(state.agg, N_FEATS),
+                          hist=roll2(state.hist, cfg.n_hist_buckets))
+
+
+def plane_view(state: ReplayState, cfg: ReplayConfig) -> np.ndarray:
+    """Host copy of the aggregate plane as [S, W, F]."""
+    return np.asarray(state.agg).reshape(
+        cfg.n_services, cfg.n_windows, N_FEATS)
+
+
 class StreamReplay:
     """Incremental replay state over arrival-ordered span micro-batches.
 
@@ -130,27 +157,13 @@ class StreamReplay:
         self._warmed = True
 
     def _roll(self, k: int) -> None:
-        """Evict the oldest ``k`` windows: shift plane columns left, zero
-        the tail, advance the anchor.  The anchor advances by the FULL
-        ``k`` even when that clears the whole plane (a feed gap wider than
-        the grid) — only the column shift clamps, so later spans always
-        bin into their true absolute window.  HLL registers are
-        per-service (not per-window) and keep accumulating across rolls."""
-        import jax.numpy as jnp
-        cfg = self.cfg
-        shift = min(k, cfg.n_windows)
-
-        def roll2(x, width):
-            x = np.asarray(x).reshape(cfg.n_services, cfg.n_windows, width)
-            out = np.zeros_like(x)
-            if shift < cfg.n_windows:
-                out[:, :cfg.n_windows - shift] = x[:, shift:]
-            return jnp.asarray(out.reshape(cfg.sw, width))
-
-        self.state = self.state._replace(
-            agg=roll2(self.state.agg, N_FEATS),
-            hist=roll2(self.state.hist, self.cfg.n_hist_buckets))
-        self.t0_us += k * cfg.window_us
+        """Evict the oldest ``k`` windows (roll_ring_state) and advance
+        the anchor.  The anchor advances by the FULL ``k`` even when that
+        clears the whole plane (a feed gap wider than the grid) — only
+        the column shift clamps, so later spans always bin into their
+        true absolute window."""
+        self.state = roll_ring_state(self.state, self.cfg, k)
+        self.t0_us += k * self.cfg.window_us
         self.window_offset += k
 
     def push(self, batch: SpanBatch) -> int:
@@ -178,9 +191,7 @@ class StreamReplay:
     def agg_plane(self) -> np.ndarray:
         """Host copy of the aggregate plane as [S, W, F] (column w holds
         absolute window ``window_offset + w``)."""
-        cfg = self.cfg
-        return np.asarray(self.state.agg).reshape(
-            cfg.n_services, cfg.n_windows, N_FEATS)
+        return plane_view(self.state, self.cfg)
 
 
 class OnlineDetector:
@@ -200,7 +211,7 @@ class OnlineDetector:
                  z_threshold: float = 4.0, min_count: float = 5.0,
                  consecutive: int = 1, drop_memory: int = 8,
                  call_edges: Optional[set] = None,
-                 with_hll: bool = False):
+                 replay=None, with_hll: bool = False):
         if baseline_windows < 2:
             raise ValueError("need >= 2 baseline windows for a sigma")
         if baseline_windows >= cfg.n_windows:
@@ -209,7 +220,19 @@ class OnlineDetector:
         if consecutive < 1:
             raise ValueError("consecutive must be >= 1 (0 would alert "
                              "every service in every window)")
-        self.replay = StreamReplay(cfg, t0_us, with_hll=with_hll)
+        # ``replay`` injects an alternative plane with the same contract —
+        # e.g. anomod.parallel.stream.ShardedStreamReplay runs this whole
+        # alerting stack over a device mesh unchanged
+        if replay is not None and (replay.cfg != cfg
+                                   or replay.t0_us != int(t0_us)):
+            raise ValueError("injected replay's cfg/t0 disagree with the "
+                             "detector's")
+        if replay is not None and with_hll:
+            raise ValueError("with_hll configures the detector's OWN "
+                             "plane; an injected replay manages its own "
+                             "HLL state")
+        self.replay = replay if replay is not None else \
+            StreamReplay(cfg, t0_us, with_hll=with_hll)
         self.services = tuple(batch_services)
         self.baseline_windows = baseline_windows
         self.z_threshold = z_threshold

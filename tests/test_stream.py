@@ -336,6 +336,44 @@ def test_uncalibrated_service_does_not_false_alert():
     assert not [a for a in det.alerts if a.service_name == "svc1"]
 
 
+def test_sharded_stream_replay_matches_single_chip():
+    """The mesh-sharded streaming plane (psum-merged per-push deltas over
+    the 8-device CPU mesh) is numerically interchangeable with the
+    single-chip StreamReplay, and the detector runs on it unchanged."""
+    from anomod.parallel import make_mesh
+    from anomod.parallel.stream import ShardedStreamReplay
+
+    label = labels.label_for("Svc_Kill_UserTimeline")
+    exp = synth.generate_experiment(label, n_traces=200, seed=0)
+    batch = exp.spans
+    cfg = ReplayConfig(n_services=batch.n_services, chunk_size=1024)
+    order = np.argsort(batch.start_us, kind="stable")
+    batch = take_spans(batch, order)
+    t0 = int(batch.start_us.min())
+
+    single = StreamReplay(cfg, t0)
+    mesh = make_mesh()
+    sharded = ShardedStreamReplay(cfg, t0, mesh)
+    cuts = [0, 3000, 3001, 9000, batch.n_spans]
+    for lo, hi in zip(cuts, cuts[1:]):
+        mb = take_spans(batch, slice(lo, hi))
+        assert single.push(mb) == sharded.push(mb)
+    assert sharded.n_spans == single.n_spans
+    np.testing.assert_array_equal(np.asarray(sharded.state.hist),
+                                  np.asarray(single.state.hist))
+    np.testing.assert_allclose(np.asarray(sharded.state.agg),
+                               np.asarray(single.state.agg),
+                               rtol=1e-5, atol=1e-3)
+
+    # the full detector stack over the mesh: same culprit
+    det = OnlineDetector(batch.services, cfg, t0,
+                         replay=ShardedStreamReplay(cfg, t0, mesh))
+    for lo, hi in zip(cuts, cuts[1:]):
+        det.push(take_spans(batch, slice(lo, hi)))
+    det.finish()
+    assert det.first_alert_window(label.target_service) is not None
+
+
 def test_ring_random_jumps_match_absolute_accumulator():
     """Property test for the ring math: arbitrary monotone window jumps
     (including gaps wider than the grid) must leave every retained ring
