@@ -14,25 +14,33 @@ This module provides it on top of the existing replay machinery:
   so everything downstream of the aggregate plane (percentiles, HLL
   distinct-trace counts, detectors) works unchanged on a live stream.
 - :class:`OnlineDetector` scores each *closed* 60 s window per service
-  against leading baseline windows (z-scores on log-latency mean and
-  error rate read straight off the aggregate plane) and raises
-  :class:`Alert` rows with hysteresis.  Detection latency — windows from
-  fault onset to first alert on the culprit — is the streaming-mode
-  quality metric the offline sweep cannot measure.
+  with four plane-derived z statistics (SE-of-mean log-latency, smoothed
+  binomial error rate, per-window drop, recovery-resetting CUSUM) and
+  raises :class:`Alert` rows with hysteresis; culprit ranking sums alert
+  scores under dependency-chain attribution over the observed call
+  graph.  Detection latency — windows from fault onset to first alert on
+  the culprit — is the streaming-mode quality metric the offline sweep
+  cannot measure.
+- :class:`MultimodalDetector` fuses the log / metric / API planes — the
+  streaming counterpart of the offline detector's five-modality
+  features — which closes the span statistics' sparse-service floor.
 
 TPU notes: the hot path is the shared chunk step (one bf16 MXU matmul per
 micro-batch chunk); window scoring reads the tiny [S*W, F] plane back to
 host, which is the natural cadence point (once per closed window, not per
-span).
+span).  The plane itself shards over a device mesh
+(anomod.parallel.stream.ShardedStreamReplay, injectable via
+``OnlineDetector(replay=...)``).
 
-Operating envelope: the z statistics need traffic density — around ≥10
-spans per (service, window) the full fault taxonomy localizes with 0-4
-window latency and the normal baselines stay quiet (tests pin this at the
-default 300-400 traces / 30 windows); at a few spans per window the tests
-lose power honestly (wider nulls, a rare service killed mid-run may never
-alert).  Sparse regimes are what the offline learned models are for
-(docs/BENCHMARKS.md quality tables) — the streaming detector is the
-training-free first responder, not a replacement for them.
+Operating envelope: the SPAN z statistics need traffic density — around
+≥10 spans per (service, window) the taxonomy localizes with 0-4 window
+latency and the normal baselines stay quiet; below that, span evidence
+loses power honestly (a sub-1-span/window service killed mid-run may
+never alert from spans alone — CUSUM z ≈ 1.6 at best).  The multimodal
+planes close exactly that gap (request-rate collapse and error-rate
+series localize the quiet kills: both testbeds reach top-1 = 1.0), and
+the trained graph models remain the answer where node evidence carries
+no signal at all (edge-locus faults — see docs/BENCHMARKS.md).
 """
 
 from __future__ import annotations
