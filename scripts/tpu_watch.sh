@@ -20,20 +20,31 @@ for i in $(seq 1 200); do
     # Mosaic-compiled kernel parity at the current tree (writes its own
     # bench_runs/ record via the tpu_tests conftest)
     timeout 600 python -m pytest tpu_tests/ -q
-    # On-chip quality shift sweep if no TPU record of it exists yet (the
-    # round-3 tunnel death killed this exact capture; ~25 min budget).
+    # On-chip quality shift sweeps, PER TESTBED (the record filename is not
+    # testbed-tagged, so grep the record bodies): the round-3 tunnel deaths
+    # killed these exact captures; ~6 min each when the tunnel holds.
     # ANOMOD_SKIP_PROBE: the watcher just proved the backend live, and the
     # CLI's own probe would burn another subprocess init.
-    if ! ls bench_runs/*_quality_shift_sweep_tpu.json >/dev/null 2>&1; then
-      ANOMOD_SKIP_PROBE=1 timeout 2400 \
-        python -m anomod.cli quality --testbed TT --sweep shift --json \
-        > /tmp/tpu_watch_shift.log 2>&1
-      echo "=== TT shift sweep rc: $? (log /tmp/tpu_watch_shift.log) ==="
-      ANOMOD_SKIP_PROBE=1 timeout 2400 \
-        python -m anomod.cli quality --testbed SN --sweep shift --json \
-        > /tmp/tpu_watch_shift_sn.log 2>&1
-      echo "=== SN shift sweep rc: $? ==="
-    fi
+    for tb in TT SN; do
+      if ! grep -l "\"testbed\": \"$tb\"" \
+          bench_runs/*_quality_shift_sweep_tpu.json >/dev/null 2>&1; then
+        ANOMOD_SKIP_PROBE=1 timeout 2400 \
+          python -m anomod.cli quality --testbed "$tb" --sweep shift --json \
+          > "/tmp/tpu_watch_shift_$tb.log" 2>&1
+        echo "=== $tb shift sweep rc: $? ==="
+      fi
+    done
+    # On-chip streaming-quality records (multimodal, both testbeds): cheap
+    # (~2 min each), still missing TPU-side agreement evidence.
+    for tb in TT SN; do
+      if ! grep -l "\"testbed\": \"$tb\"" \
+          bench_runs/*_stream_quality_tpu.json >/dev/null 2>&1; then
+        ANOMOD_SKIP_PROBE=1 timeout 900 \
+          python -m anomod.cli stream --all --testbed "$tb" --multimodal \
+          > "/tmp/tpu_watch_stream_$tb.log" 2>&1
+        echo "=== $tb stream rc: $? ==="
+      fi
+    done
     after=$(ls bench_runs/*_tpu.json 2>/dev/null | wc -l)
     new=$((after - before))
     echo "=== capture rc: pallas=$rc1 xla=$rc2; new TPU records: $new ==="
