@@ -151,11 +151,14 @@ def main(argv=None) -> int:
     p_replay.add_argument("--testbed", choices=["SN", "TT"], default="TT")
     p_replay.add_argument("--traces", type=int, default=2000)
     p_replay.add_argument("--replicate", type=int, default=1)
-    p_replay.add_argument("--kernel", choices=["xla", "pallas", "numpy"],
+    p_replay.add_argument("--kernel",
+                          choices=["xla", "pallas", "pallas-sorted", "numpy"],
                           default="xla",
                           help="aggregation path: XLA scan (default; runs "
                                "anywhere), the fused pallas kernel (the "
-                               "TPU fast path; interpret-mode off-TPU), or "
+                               "TPU fast path; interpret-mode off-TPU), its "
+                               "sorted-window variant (128-lane one-hot via "
+                               "host pre-sort; single-chip only), or "
                                "the numpy cpu-backend engine (fastest on a "
                                "host core; single-chip only)")
     p_replay.add_argument("--percentiles", action="store_true",
@@ -696,6 +699,9 @@ def main(argv=None) -> int:
         if args.devices and args.kernel == "numpy":
             parser.error("--kernel numpy is the single-chip host engine; "
                          "the sharded path needs a device kernel")
+        if args.devices and args.kernel == "pallas-sorted":
+            parser.error("--kernel pallas-sorted stages on the host for one "
+                         "chip; the sharded path uses 'xla' or 'pallas'")
         # a pure-host run (numpy engine, no mesh, no digest plane) touches
         # no jax — don't pay the backend probe for it
         if args.kernel != "numpy" or args.devices or args.percentiles:

@@ -43,6 +43,35 @@ PLANES = ("valid", "err", "s5", "dur_raw", "dur", "dur2")
 N_PLANES = len(PLANES)
 
 
+def _build_rhs_t(planes, block, n_hist):
+    """Shared kernel-body stage for both replay kernels: the [3+6+H, B]
+    bf16 right-hand side — exact 0/1 planes, two-way hi/lo split of the
+    latency moments, and the in-kernel histogram bucket one-hot.  Traced
+    inside a pallas kernel (plain jnp ops only)."""
+    import jax
+    import jax.numpy as jnp
+
+    exact = planes[0:3].astype(jnp.bfloat16)  # valid / err / 5xx
+    moments = planes[3:6]                     # dur_raw / dur / dur^2
+    hi = moments.astype(jnp.bfloat16)
+    lo = (moments - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    valid = planes[0]
+    bucket = jnp.clip(planes[4].astype(jnp.int32), 0, n_hist - 1)
+    h_iota = jax.lax.broadcasted_iota(jnp.int32, (n_hist, block), 0)
+    bucket_oh = jnp.where(h_iota == bucket[None, :], valid[None, :],
+                          0.0).astype(jnp.bfloat16)       # [H, B]
+    return jnp.concatenate([exact, hi, lo, bucket_oh], axis=0)
+
+
+def _recombine_moments(acc, n_segments):
+    """Shared epilogue: recombine hi+lo moment rows, drop the dead lane,
+    transpose back to [SW, F+H]."""
+    import jax.numpy as jnp
+
+    agg_t = jnp.concatenate([acc[0:3], acc[3:6] + acc[6:9], acc[9:]], axis=0)
+    return agg_t.T[:n_segments]
+
+
 def make_pallas_replay_fn(n_segments: int, n_hist: int = 16,
                           block: int = 4096, interpret: bool = False,
                           inner_repeats: int = 1):
@@ -72,17 +101,8 @@ def make_pallas_replay_fn(n_segments: int, n_hist: int = 16,
             out_ref[:] = jnp.zeros_like(out_ref)
 
         sid = sid_ref[:]                          # [B] int32
-        planes = planes_ref[:]                    # [6, B] f32, natural layout
-        exact = planes[0:3].astype(jnp.bfloat16)  # valid / err / 5xx
-        moments = planes[3:6]                     # dur_raw / dur / dur^2
-        hi = moments.astype(jnp.bfloat16)
-        lo = (moments - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-        valid = planes[0]
-        bucket = jnp.clip(planes[4].astype(jnp.int32), 0, n_hist - 1)
-        h_iota = jax.lax.broadcasted_iota(jnp.int32, (n_hist, block), 0)
-        bucket_oh = jnp.where(h_iota == bucket[None, :], valid[None, :],
-                              0.0).astype(jnp.bfloat16)       # [H, B]
-        rhs_t = jnp.concatenate([exact, hi, lo, bucket_oh], axis=0)
+        # [6, B] f32 natural layout -> shared bf16 rhs build
+        rhs_t = _build_rhs_t(planes_ref[:], block, n_hist)
         seg_iota = jax.lax.broadcasted_iota(jnp.int32, (block, SW1), 1)
         onehot = (seg_iota == sid[:, None]).astype(jnp.bfloat16)
         out_ref[:] += jax.lax.dot_general(
@@ -109,10 +129,116 @@ def make_pallas_replay_fn(n_segments: int, n_hist: int = 16,
                 dimension_semantics=("arbitrary", "arbitrary")),
             interpret=interpret,
         )(sid, planes)
-        # recombine hi+lo moments, drop the dead lane, back to [SW, F+H]
-        agg_t = jnp.concatenate(
-            [acc[0:3], acc[3:6] + acc[6:9], acc[9:]], axis=0)
-        return agg_t.T[:n_segments]
+        return _recombine_moments(acc, n_segments)
+
+    return run
+
+
+def stage_sorted_planes(sid, planes, n_segments, k: int = 128,
+                        block: int = 4096):
+    """Host-side re-staging for the sorted-window kernel: sort spans by
+    segment id, bucket them into aligned windows of ``k`` segments
+    (window w owns segments [w*k, (w+1)*k)), and pad each window's span run
+    to a ``block`` multiple so every kernel block touches exactly one
+    window.
+
+    Returns ``(sid_local[T], planes[6, T], wids[T // block])`` where
+    ``sid_local = sid - wid*k`` ∈ [0, k) and padding rows carry
+    ``sid_local = 0`` with all-zero planes (they contribute nothing to any
+    output plane — including the count — because every aggregated value is
+    a plane-weighted sum).  One-time cost, O(N log N) on the host: replay
+    measurement loops never re-stage.
+    """
+    sid = np.asarray(sid, np.int32)
+    planes = np.asarray(planes, np.float32)
+    n = sid.shape[0]
+    nw = (n_segments + 1 + k - 1) // k      # + dead lane
+    order = np.argsort(sid, kind="stable")
+    sid_s = sid[order]
+    wid_s = sid_s // k
+    counts = np.bincount(wid_s, minlength=nw)
+    padded = -(-counts // block) * block    # per-window ceil to block
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pad_starts = np.concatenate([[0], np.cumsum(padded)[:-1]])
+    total = int(padded.sum())
+    dst = (pad_starts[wid_s] + (np.arange(n) - starts[wid_s])).astype(np.int64)
+    sid_local = np.zeros(total, np.int32)
+    sid_local[dst] = sid_s - wid_s * k
+    planes_out = np.zeros((planes.shape[0], total), np.float32)
+    planes_out[:, dst] = planes[:, order]
+    wids = np.repeat(np.arange(nw, dtype=np.int32), padded // block)
+    return sid_local, planes_out, wids
+
+
+def make_pallas_replay_sorted_fn(n_segments: int, n_hist: int = 16,
+                                 k: int = 128, block: int = 4096,
+                                 interpret: bool = False,
+                                 inner_repeats: int = 1):
+    """Sorted-window variant of :func:`make_pallas_replay_fn`:
+    ``fn(sid_local[T], planes[6, T], wids[T // block]) -> agg[SW, 6+H]``
+    over arrays staged by :func:`stage_sorted_planes`.
+
+    Same fused pipeline (bf16 hi/lo split, in-kernel bucketing, resident
+    VMEM accumulator), but the one-hot and the MXU matmul are ``k`` lanes
+    wide instead of ``n_segments + 1``: each block's spans all live in one
+    aligned k-segment window (host staging guarantees it), so the block's
+    [ROWS, k] partial accumulates into a dynamic k-wide slice of the
+    accumulator at the window's column offset (``wids`` rides scalar
+    prefetch into the index-map/kernel).  For the TT bench corpus
+    (SW+1 = 1441, k = 128) that is ~11x less one-hot construction and MXU
+    work per span for ~5% padding — aligned windows keep global segment s
+    at column s, so the epilogue is unchanged."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nw = (n_segments + 1 + k - 1) // k
+    NWK = nw * k
+    ROWS = 3 + 6 + n_hist         # exact + (hi, lo) moments + histogram
+
+    def kernel(wids_ref, sid_ref, planes_ref, out_ref):
+        @pl.when((pl.program_id(0) == 0) & (pl.program_id(1) == 0))
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        sid = sid_ref[:]                          # [B] int32, window-local
+        # [6, B] f32 -> shared bf16 rhs build (same split as the unsorted
+        # kernel, so the two paths cannot diverge numerically)
+        rhs_t = _build_rhs_t(planes_ref[:], block, n_hist)
+        seg_iota = jax.lax.broadcasted_iota(jnp.int32, (block, k), 1)
+        onehot = (seg_iota == sid[:, None]).astype(jnp.bfloat16)  # [B, k]
+        partial = jax.lax.dot_general(
+            rhs_t, onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [ROWS, k]
+        col = wids_ref[pl.program_id(1)] * k
+        out_ref[:, pl.ds(col, k)] += partial
+
+    @jax.jit
+    def run(sid_local, planes, wids):
+        t = sid_local.shape[0]
+        assert planes.shape == (N_PLANES, t), \
+            "planes must be feature-major [6, T]"
+        assert t % block == 0, f"span count {t} must be a multiple of {block}"
+        assert wids.shape == (t // block,)
+        grid = (inner_repeats, t // block)
+        acc = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=grid,
+                in_specs=[
+                    pl.BlockSpec((block,), lambda r, i, w: (i,)),
+                    pl.BlockSpec((N_PLANES, block), lambda r, i, w: (0, i)),
+                ],
+                out_specs=pl.BlockSpec((ROWS, NWK), lambda r, i, w: (0, 0)),
+            ),
+            out_shape=jax.ShapeDtypeStruct((ROWS, NWK), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(wids, sid_local, planes)
+        return _recombine_moments(acc, n_segments)
 
     return run
 

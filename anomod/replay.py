@@ -361,15 +361,18 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
     steady-state number without inflating the host arrays or the HBM
     working set.  ``kernel`` selects the aggregation path: "xla" (scan +
     one-hot matmuls), "pallas" (the fused anomod.ops.pallas_replay
-    kernel), or "numpy" — the framework's cpu-backend engine
+    kernel), "pallas-sorted" (its sorted-window variant — one-time host
+    pre-sort into aligned 128-segment windows so the kernel's one-hot is
+    128 lanes wide instead of SW+1), or "numpy" — the framework's
+    cpu-backend engine
     (BASELINE.json's backend switch): direct scatter-add over the staged
     columns, which is the right shape for a host core (~13x the XLA scan
     on one CPU core, where one-hot matmuls are wasted work) and doubles as
     the parity oracle both device kernels are tested against.
     """
-    if kernel not in ("xla", "pallas", "numpy"):
-        raise ValueError(f"unknown replay kernel {kernel!r} "
-                         "(expected 'xla', 'pallas' or 'numpy')")
+    if kernel not in ("xla", "pallas", "pallas-sorted", "numpy"):
+        raise ValueError(f"unknown replay kernel {kernel!r} (expected "
+                         "'xla', 'pallas', 'pallas-sorted' or 'numpy')")
     cfg = cfg or ReplayConfig(n_services=len(batch.services))
     chunks_np, n = stage_columns(batch, cfg)
     n *= replicate
@@ -397,6 +400,27 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
                                     interpret=interpret)
         def run_once():
             agg = np.asarray(pfn(sid, planes))
+            return float(agg[:, F_COUNT].astype(np.float64).sum())
+    elif kernel == "pallas-sorted":
+        import jax
+        from anomod.ops.pallas_replay import (make_pallas_replay_sorted_fn,
+                                              stage_sorted_planes)
+        sid_np, planes_np = stage_pallas_planes(chunks_np)
+        block = pallas_block(cfg.chunk_size)
+        # one-time host re-stage: sort spans into aligned 128-segment
+        # windows so the kernel's one-hot is 128 lanes wide, not SW+1
+        sid_l, planes_s, wids = stage_sorted_planes(
+            sid_np, planes_np, cfg.sw, block=block)
+        sid_d = jax.device_put(sid_l)
+        planes_d = jax.device_put(planes_s)
+        wids_d = jax.device_put(wids)
+        interpret = jax.devices()[0].platform != "tpu"
+        pfn = make_pallas_replay_sorted_fn(cfg.sw, cfg.n_hist_buckets,
+                                           block=block,
+                                           inner_repeats=replicate,
+                                           interpret=interpret)
+        def run_once():
+            agg = np.asarray(pfn(sid_d, planes_d, wids_d))
             return float(agg[:, F_COUNT].astype(np.float64).sum())
     else:
         import jax

@@ -100,10 +100,12 @@ def main() -> int:
             ("numpy" if platform == "cpu" else "xla")
         kernel = os.environ.get("ANOMOD_BENCH_KERNEL", "").strip().lower() \
             or default_kernel
-        if kernel == "pallas" and not on_tpu:
-            kernel = "numpy" if platform == "cpu" else "xla"
-            out["kernel_note"] = ("ANOMOD_BENCH_KERNEL=pallas requires a TPU "
-                                  f"backend (Mosaic); downgraded to {kernel}")
+        if kernel.startswith("pallas") and not on_tpu:
+            requested, kernel = kernel, ("numpy" if platform == "cpu"
+                                         else "xla")
+            out["kernel_note"] = (f"ANOMOD_BENCH_KERNEL={requested} requires "
+                                  f"a TPU backend (Mosaic); downgraded to "
+                                  f"{kernel}")
         if kernel == "numpy":
             # host engine: device-sized replication would be 64 full host
             # passes per repeat — size the work for one core

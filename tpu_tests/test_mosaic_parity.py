@@ -70,6 +70,31 @@ def test_replay_kernel_compiled_inner_repeats(tt_corpus):
                                atol=3e-2)
 
 
+def test_replay_sorted_kernel_compiled(tt_corpus):
+    """Sorted-window kernel, Mosaic-compiled at production shape: the
+    128-lane local one-hot, the scalar-prefetched window ids, and the
+    dynamic-slice accumulate into the resident block — vs the numpy
+    oracle, including inner_repeats accumulation."""
+    from anomod.ops.pallas_replay import (make_pallas_replay_sorted_fn,
+                                          stage_sorted_planes)
+    from anomod.replay import pallas_block, replay_numpy, stage_pallas_planes
+
+    _, cfg, chunks, _ = tt_corpus
+    sid, planes = stage_pallas_planes(chunks)
+    block = pallas_block(cfg.chunk_size)
+    sid_l, planes_s, wids = stage_sorted_planes(sid, planes, cfg.sw,
+                                                block=block)
+    r = 2
+    fn = make_pallas_replay_sorted_fn(cfg.sw, cfg.n_hist_buckets,
+                                      block=block, inner_repeats=r)
+    out = np.asarray(fn(sid_l, planes_s, wids))
+    ref = replay_numpy(chunks, cfg)
+    np.testing.assert_allclose(out[:, :3], r * ref.agg[:, :3], rtol=0, atol=0)
+    np.testing.assert_allclose(out[:, 6:], r * ref.hist, rtol=0, atol=0)
+    np.testing.assert_allclose(out[:, 3:6], r * ref.agg[:, 3:6], rtol=2e-3,
+                               atol=3e-2)
+
+
 def test_sharded_replay_pallas_compiled(tt_corpus):
     """make_sharded_replay_fn(kernel='pallas') on a real-device mesh: the
     compiled kernel inside shard_map with check_vma=False, psum merge."""
