@@ -100,7 +100,7 @@ def main() -> int:
             ("numpy" if platform == "cpu" else "xla")
         kernel = os.environ.get("ANOMOD_BENCH_KERNEL", "").strip().lower() \
             or default_kernel
-        if kernel.startswith("pallas") and not on_tpu:
+        if kernel in ("pallas", "pallas-sorted") and not on_tpu:
             requested, kernel = kernel, ("numpy" if platform == "cpu"
                                          else "xla")
             out["kernel_note"] = (f"ANOMOD_BENCH_KERNEL={requested} requires "

@@ -47,24 +47,52 @@ def main() -> int:
     sid = jax.device_put(np.asarray(sid_np))
     planes = jax.device_put(np.asarray(planes_np))
 
+    def time_fn(run):
+        """Shared measurement policy for every sweep point: warm/compile,
+        then 3 timed runs with the out[:1] host read-back barrier, median
+        wall.  Returns (wall, raw_walls)."""
+        jax.block_until_ready(run())
+        walls = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(run()[:1])           # host read-back barrier
+            walls.append(time.perf_counter() - t0)
+        return sorted(walls)[1], walls
+
     points = []
     for block in (1024, 2048, 4096, 8192):
         fn = make_pallas_replay_fn(cfg.sw, cfg.n_hist_buckets, block=block,
                                    inner_repeats=replicate)
-        out = fn(sid, planes)
-        jax.block_until_ready(out)          # compile + warm
-        walls = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            out = fn(sid, planes)
-            np.asarray(out[:1])             # host read-back barrier
-            walls.append(time.perf_counter() - t0)
-        wall = sorted(walls)[1]
+        wall, walls = time_fn(lambda: fn(sid, planes))
         points.append({"block": block,
                        "spans_per_sec": round(n * replicate / wall, 1),
                        "wall_s": round(wall, 4),
                        "raw_wall_s": [round(w, 4) for w in walls]})
         print(json.dumps(points[-1]))
+
+    # sorted-window variant: sweep (block, k) over the same corpus — its
+    # one-hot is k lanes wide, so block can grow without VMEM pressure
+    from anomod.ops.pallas_replay import (make_pallas_replay_sorted_fn,
+                                          stage_sorted_planes)
+    sorted_points = []
+    for block in (1024, 2048, 4096, 8192, 16384):
+        for k in (128, 256):
+            sid_l, planes_s, wids = stage_sorted_planes(
+                sid_np, planes_np, cfg.sw, k=k, block=block)
+            sid_d = jax.device_put(sid_l)
+            planes_d = jax.device_put(planes_s)
+            wids_d = jax.device_put(wids)
+            fn = make_pallas_replay_sorted_fn(cfg.sw, cfg.n_hist_buckets,
+                                              k=k, block=block,
+                                              inner_repeats=replicate)
+            wall, walls = time_fn(
+                lambda: fn(sid_d, planes_d, wids_d))
+            sorted_points.append({
+                "block": block, "k": k, "staged_rows": int(sid_l.shape[0]),
+                "spans_per_sec": round(n * replicate / wall, 1),
+                "wall_s": round(wall, 4),
+                "raw_wall_s": [round(w, 4) for w in walls]})
+            print(json.dumps(sorted_points[-1]))
 
     xla = measure_throughput(batch, cfg, repeats=3, replicate=replicate,
                              kernel="xla")
@@ -74,6 +102,8 @@ def main() -> int:
         "pallas_block_sweep", best, "spans/sec/chip",
         device=str(jax.devices()[0]), n_spans=n * replicate,
         points=points, flatness=round(worst / best, 4),
+        sorted_points=sorted_points,
+        sorted_best=max(p["spans_per_sec"] for p in sorted_points),
         xla_spans_per_sec=round(xla.spans_per_sec, 1),
         xla_raw_wall_s=[round(w, 4) for w in xla.raw_wall_s])
     path = write_capture(rec)
