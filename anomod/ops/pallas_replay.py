@@ -115,6 +115,9 @@ def make_pallas_replay_fn(n_segments: int, n_hist: int = 16,
         assert planes.shape == (N_PLANES, n), \
             "planes must be feature-major [6, N]"
         assert n % block == 0, f"span count {n} must be a multiple of {block}"
+        if n == 0:
+            # zero-block grid would skip the init step and return garbage
+            return jnp.zeros((n_segments, N_PLANES + n_hist), jnp.float32)
         grid = (inner_repeats, n // block)
         acc = pl.pallas_call(
             kernel,
@@ -221,6 +224,9 @@ def make_pallas_replay_sorted_fn(n_segments: int, n_hist: int = 16,
             "planes must be feature-major [6, T]"
         assert t % block == 0, f"span count {t} must be a multiple of {block}"
         assert wids.shape == (t // block,)
+        if t == 0:
+            # zero-block grid would skip the init step and return garbage
+            return jnp.zeros((n_segments, N_PLANES + n_hist), jnp.float32)
         grid = (inner_repeats, t // block)
         acc = pl.pallas_call(
             kernel,

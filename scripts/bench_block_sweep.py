@@ -94,6 +94,25 @@ def main() -> int:
                 "raw_wall_s": [round(w, 4) for w in walls]})
             print(json.dumps(sorted_points[-1]))
 
+    # replicate scaling at the default sorted config: if spans/sec keeps
+    # rising with on-device replication, the fixed dispatch/read-back
+    # overhead (tunnel RPC) still dominates the wall and the kernel's true
+    # rate is higher than the headline
+    replicate_points = []
+    sid_l, planes_s, wids = stage_sorted_planes(sid_np, planes_np, cfg.sw)
+    sid_d, planes_d, wids_d = (jax.device_put(sid_l),
+                               jax.device_put(planes_s),
+                               jax.device_put(wids))
+    for rep in (64, 256, 1024):
+        fn = make_pallas_replay_sorted_fn(cfg.sw, cfg.n_hist_buckets,
+                                          inner_repeats=rep)
+        wall, walls = time_fn(lambda: fn(sid_d, planes_d, wids_d))
+        replicate_points.append({
+            "replicate": rep, "spans_per_sec": round(n * rep / wall, 1),
+            "wall_s": round(wall, 4),
+            "raw_wall_s": [round(w, 4) for w in walls]})
+        print(json.dumps(replicate_points[-1]))
+
     xla = measure_throughput(batch, cfg, repeats=3, replicate=replicate,
                              kernel="xla")
     best = max(p["spans_per_sec"] for p in points)
@@ -104,6 +123,7 @@ def main() -> int:
         points=points, flatness=round(worst / best, 4),
         sorted_points=sorted_points,
         sorted_best=max(p["spans_per_sec"] for p in sorted_points),
+        replicate_points=replicate_points,
         xla_spans_per_sec=round(xla.spans_per_sec, 1),
         xla_raw_wall_s=[round(w, 4) for w in xla.raw_wall_s])
     path = write_capture(rec)

@@ -165,6 +165,44 @@ def test_pallas_sorted_kernel_matches_oracle():
                                rtol=2e-3, atol=1e-2)
 
 
+def test_pallas_sorted_kernel_sparse_windows():
+    """Corpora that leave whole segment windows empty (clustered service
+    traffic) must still aggregate correctly: windows with no spans get no
+    blocks, and their accumulator columns stay zero.  Also pins the
+    zero-span guard."""
+    from anomod.ops.pallas_replay import (make_pallas_replay_sorted_fn,
+                                          pallas_replay_numpy,
+                                          stage_sorted_planes)
+    rng = np.random.default_rng(11)
+    SW, H, K, BLOCK = 600, 16, 128, 256
+    n = 1500
+    sid = rng.integers(260, 380, n).astype(np.int32)   # one window only
+    planes = np.abs(rng.normal(size=(6, n))).astype(np.float32)
+    planes[0] = 1.0
+    planes[1] = (rng.random(n) < 0.2).astype(np.float32)
+    planes[2] = 0.0
+    planes[4] = rng.uniform(0, 15, n).astype(np.float32)
+    sid_l, planes_s, wids = stage_sorted_planes(sid, planes, SW,
+                                                k=K, block=BLOCK)
+    assert set(wids.tolist()) == {2}                   # only window 2 staged
+    fn = make_pallas_replay_sorted_fn(SW, H, k=K, block=BLOCK,
+                                      interpret=True)
+    got = np.asarray(fn(sid_l, planes_s, wids))
+    want = pallas_replay_numpy(sid, planes, SW, H)
+    np.testing.assert_array_equal(got[:, :3], want[:, :3])
+    assert (got[:256] == 0).all() and (got[384:] == 0).all()
+    # zero-span corpus: defined all-zero output, not uninitialized memory
+    # (both kernels share the guard)
+    empty = fn(np.zeros(0, np.int32), np.zeros((6, 0), np.float32),
+               np.zeros(0, np.int32))
+    assert np.asarray(empty).shape == (SW, 6 + H)
+    assert (np.asarray(empty) == 0).all()
+    from anomod.ops.pallas_replay import make_pallas_replay_fn
+    fn_full = make_pallas_replay_fn(SW, H, block=BLOCK, interpret=True)
+    empty_full = fn_full(np.zeros(0, np.int32), np.zeros((6, 0), np.float32))
+    assert (np.asarray(empty_full) == 0).all()
+
+
 def test_measure_throughput_pallas_sorted_kernel(tt_batch):
     """End-to-end: the pallas-sorted path stages, runs (interpret on the
     CPU mesh), and passes the span-count audit."""
