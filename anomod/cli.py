@@ -407,7 +407,7 @@ def main(argv=None) -> int:
         out = {
             "experiment": label.experiment, "testbed": label.testbed,
             "target_service": label.target_service,
-            "n_spans": det.replay.n_spans,
+            "n_spans": det.n_spans_in,
             "window_seconds": win_s,
             "n_alerts": len(det.alerts),
             "ranked_services": ranked[:5],
@@ -417,7 +417,7 @@ def main(argv=None) -> int:
             # reported separately
             "push_wall_s": round(det.push_wall_s, 4),
             "compile_s": round(det.replay.compile_s, 3),
-            "spans_per_sec": round(det.replay.n_spans
+            "spans_per_sec": round(det.n_spans_in
                                    / max(det.push_wall_s, 1e-9), 1),
             "alerts": [_dc.asdict(a) for a in det.alerts[:50]],
         }

@@ -38,9 +38,17 @@ latency and the normal baselines stay quiet; below that, span evidence
 loses power honestly (a sub-1-span/window service killed mid-run may
 never alert from spans alone — CUSUM z ≈ 1.6 at best).  The multimodal
 planes close exactly that gap (request-rate collapse and error-rate
-series localize the quiet kills: both testbeds reach top-1 = 1.0), and
-the trained graph models remain the answer where node evidence carries
-no signal at all (edge-locus faults — see docs/BENCHMARKS.md).
+series localize the quiet kills: both testbeds reach top-1 = 1.0).
+Edge-locus faults (the callee side of the culprit's outgoing calls
+degrades while its node-scoped evidence stays healthy) are covered by
+the OUT-EDGE plane (``edge_attribution``, default on): every span is
+pushed twice through the same jitted chunk scan — once keyed by its
+service, once by caller-resolved edge slot — and a hot out-edge slot
+with cool callee self-edges alerts the CALLER with evidence="edge"
+(11/12 at live density/severity).  The residual edge-locus gap is the
+de-saturated sparse regime (pooled out-edge windows against an 8-window
+baseline cap the z below threshold at ~1 span/window) — there the
+trained graph models remain the answer (see docs/BENCHMARKS.md).
 """
 
 from __future__ import annotations
@@ -107,6 +115,20 @@ def plane_view(state: ReplayState, cfg: ReplayConfig) -> np.ndarray:
     """Host copy of the aggregate plane as [S, W, F]."""
     return np.asarray(state.agg).reshape(
         cfg.n_services, cfg.n_windows, N_FEATS)
+
+
+def resolve_parent_services(batch: SpanBatch) -> np.ndarray:
+    """Per-span PARENT-service id (-1 for roots).
+
+    ``SpanBatch.parent`` holds batch-global row indices, so this must run
+    on the FULL corpus BEFORE any row slicing (``take_spans`` does not
+    remap parents).  A live collector does the same join at ingest from
+    the wire format's parentSpanId (Jaeger/SkyWalking both carry it) —
+    this helper is that join for the offline stand-in corpora."""
+    psvc = np.full(batch.n_spans, -1, np.int32)
+    has = batch.parent >= 0
+    psvc[has] = batch.service[batch.parent[has]]
+    return psvc
 
 
 class StreamReplay:
@@ -219,7 +241,9 @@ class OnlineDetector:
                  z_threshold: float = 4.0, min_count: float = 5.0,
                  consecutive: int = 1, drop_memory: int = 8,
                  call_edges: Optional[set] = None,
-                 replay=None, with_hll: bool = False):
+                 replay=None, with_hll: bool = False,
+                 edge_attribution: Optional[bool] = None,
+                 edge_pool: int = 8):
         if baseline_windows < 2:
             raise ValueError("need >= 2 baseline windows for a sigma")
         if baseline_windows >= cfg.n_windows:
@@ -239,9 +263,50 @@ class OnlineDetector:
             raise ValueError("with_hll configures the detector's OWN "
                              "plane; an injected replay manages its own "
                              "HLL state")
+        if edge_attribution and replay is not None:
+            raise ValueError("edge attribution needs the detector's own "
+                             "combined-id replay; an injected replay "
+                             "keeps the node-keyed contract")
+        self.services = tuple(batch_services)
+        S = len(self.services)
+        self._n_svc = S
+        #: EDGE-LOCUS coverage (default on when the detector owns its
+        #: replay): the replay id space widens from S node ids to a
+        #: STATIC 3S — S node ids ⊕ S self-edge slots ⊕ S out-edge
+        #: slots — every span pushed twice (node id + edge slot) through
+        #: the SAME jitted chunk scan.  A span whose parent belongs to a
+        #: DIFFERENT service keys its edge copy to the CALLER's out-edge
+        #: slot (2S + caller); own-parented and root spans key to their
+        #: service's self-edge slot (S + svc).  A link fault
+        #: (anomod.synth fault_locus="edge") degrades only the
+        #: callee-side spans of the culprit's outgoing calls — node
+        #: statistics then blame the callees, but the edge plane shows
+        #: the signature directly: the culprit's OUT-edge slot goes hot
+        #: while every callee's SELF-edge slot stays cool, so the
+        #: detector alerts on the CALLER with evidence="edge" and
+        #: ranking marks the callees edge-explained.  (Per-caller
+        #: aggregation, not per-(caller, callee): out-edge traffic is a
+        #: fraction of node traffic, and splitting it S-ways again would
+        #: starve the z statistics at realistic densities; which callee
+        #: is degraded is not needed to name the culprit.)
+        self.edge_attribution = (replay is None) if edge_attribution is None \
+            else bool(edge_attribution)
+        if edge_pool < 1:
+            raise ValueError("edge_pool must be >= 1 window")
+        self.edge_pool = edge_pool
+        if self.edge_attribution:
+            K = 3 * S
+            cfg = dataclasses.replace(cfg, n_services=K)
+            self._edge_hot: dict = {}       # caller id -> summed hot score
+            self._self_hot = np.zeros(S, bool)
+        else:
+            K = S
+        self._K = K
         self.replay = replay if replay is not None else \
             StreamReplay(cfg, t0_us, with_hll=with_hll)
-        self.services = tuple(batch_services)
+        #: spans fed by the caller (the combined-id replay counts each
+        #: span twice internally; pipeline metrics use THIS number)
+        self.n_spans_in = 0
         self.baseline_windows = baseline_windows
         self.z_threshold = z_threshold
         self.min_count = min_count
@@ -254,31 +319,79 @@ class OnlineDetector:
         self.alerts: List[Alert] = []
         #: accumulated wall time inside push()/push_* (staging + jitted
         #: chunk steps + window scoring) — the live pipeline's cost;
-        #: spans/sec = replay.n_spans / push_wall_s
+        #: spans/sec = n_spans_in / push_wall_s (NOT replay.n_spans: the
+        #: combined-id replay counts each span twice in edge mode)
         self.push_wall_s = 0.0
         self._scored_through = -1          # last closed ABSOLUTE window scored
         self._max_seen = -1                # newest absolute window with data
-        self._streak = np.zeros(len(batch_services), np.int32)
+        self._callees_cache: dict = {}
+        self._streak = np.zeros(self._K, np.int32)
         self._baseline = None              # frozen calibration snapshot
         # CUSUM state for the cumulative drop signal: accumulated span
-        # deficit + length of the current deficit run, per service
-        self._cusum = np.zeros(len(batch_services), np.float64)
-        self._cusum_k = np.zeros(len(batch_services), np.int32)
+        # deficit + length of the current deficit run, per row (the drop
+        # signals are consumed for node rows only)
+        self._cusum = np.zeros(self._K, np.float64)
+        self._cusum_k = np.zeros(self._K, np.int32)
 
-    def push(self, batch: SpanBatch) -> List[Alert]:
+    def _callees_of(self, p: int) -> frozenset:
+        """Observed callees of service ``p`` (from ``call_edges``)."""
+        got = self._callees_cache.get(p)
+        if got is None:
+            got = frozenset(c for a, c in self.call_edges if a == p)
+            self._callees_cache[p] = got
+        return got
+
+    def _edge_ids(self, svc: np.ndarray,
+                  psvc: Optional[np.ndarray]) -> np.ndarray:
+        """Edge slot per span: the CALLER's out-edge slot 2S+p for spans
+        whose parent belongs to a different service, else the service's
+        self-edge slot S+c (roots, own-parented spans, and every span
+        when the pusher has no parent info — node-degraded, honest)."""
+        S = self._n_svc
+        out = (S + svc).astype(np.int32)
+        if psvc is None:
+            return out
+        cross = (psvc >= 0) & (psvc != svc)
+        if cross.any():
+            out[cross] = (2 * S + psvc[cross]).astype(np.int32)
+        return out
+
+    _DUP_FIELDS = ("trace", "parent", "endpoint", "start_us",
+                   "duration_us", "is_error", "status", "kind")
+
+    def push(self, batch: SpanBatch,
+             parent_service: Optional[np.ndarray] = None) -> List[Alert]:
         """Feed a micro-batch; returns alerts for newly closed windows.
 
         Window indices in alerts are ABSOLUTE (they keep growing after the
         replay ring rolls past its grid width).  The newest window comes
         from the replay itself — the detector never re-derives binning
-        from raw timestamps."""
+        from raw timestamps.
+
+        ``parent_service`` (optional, len n_spans, -1 = root) feeds the
+        edge plane; resolve it on the FULL corpus with
+        :func:`resolve_parent_services` BEFORE slicing (a live collector
+        resolves it at ingest from parentSpanId).  Without it, spans land
+        on their self-edge slot and edge attribution degrades to node
+        evidence."""
         if batch.n_spans and not self.replay._warmed:
             self.replay._warm()          # compile outside the timed wall
         t0 = time.perf_counter()
         try:
+            if self.edge_attribution and batch.n_spans:
+                svc = batch.service.astype(np.int32)
+                psvc = None if parent_service is None else \
+                    np.asarray(parent_service, np.int32)
+                eids = self._edge_ids(svc, psvc)
+                batch = batch._replace(
+                    service=np.concatenate([svc, eids]),
+                    **{f: np.concatenate([getattr(batch, f)] * 2)
+                       for f in self._DUP_FIELDS})
             w_max = self.replay.push(batch)
             if w_max < 0:
                 return []
+            self.n_spans_in += batch.n_spans // (
+                2 if self.edge_attribution else 1)
             self._max_seen = max(self._max_seen, w_max)
             return self._score_through(self._max_seen - 1)
         finally:
@@ -347,9 +460,39 @@ class OnlineDetector:
             m = (per_window * bvalid).sum(axis=1) / nb
             return ((per_window - m[:, None]) ** 2 * bvalid).sum(axis=1) / nb
 
+        # Sparse-row drift variance for the POOLED edge z: var_bl/var_be
+        # above average only windows with >= min_count spans, so a row
+        # whose every baseline window is thinner (the ~1 span/window edge
+        # regime the pooled z exists for) gets 0 — no between-window
+        # protection at all.  For those rows estimate drift from ALL
+        # non-empty windows and subtract the sampling noise a window mean
+        # of n̄ spans carries (E[observed between-var] = drift +
+        # var_within/n̄), clamping at 0: a pure-Poisson sparse row prices
+        # ~0 drift (keeping sensitivity), a genuinely bursty one keeps
+        # its real drift term.
+        bvalid1 = cnt[:, :B] >= 1.0
+        nb1 = np.maximum(bvalid1.sum(axis=1), 1)
+        nbar1 = np.maximum((cnt[:, :B] * bvalid1).sum(axis=1) / nb1, 1.0)
+
+        def _between_var_any(per_window):
+            m = (per_window * bvalid1).sum(axis=1) / nb1
+            return ((per_window - m[:, None]) ** 2
+                    * bvalid1).sum(axis=1) / nb1
+
+        drift_l = np.maximum(
+            _between_var_any(plane[:, :B, F_LOGLAT] / bsafe)
+            - var_span / nbar1, 0.0)
+        drift_e = np.maximum(
+            _between_var_any(plane[:, :B, F_ERR] / bsafe)
+            - err_var / nbar1, 0.0)
+        var_bl = _between_var(plane[:, :B, F_LOGLAT] / bsafe)
+        var_be = _between_var(plane[:, :B, F_ERR] / bsafe)
+
         return dict(
             mu_l=mu_l, var_span=var_span, p_err=p_err, err_var=err_var,
-            rate0=rate0,
+            rate0=rate0, C0=C0,
+            var_bl_pool=np.where(var_bl > 0, var_bl, drift_l),
+            var_be_pool=np.where(var_be > 0, var_be, drift_e),
             active=rate0 >= self.min_count,   # per-window drop needs traffic
             # the cumulative drop accumulates evidence across windows, so
             # even ~1 span/window suffices — but a service with a near-zero
@@ -359,8 +502,7 @@ class OnlineDetector:
             # (or barely seen) during calibration has a fabricated mu/var
             # and its first busy window would be a guaranteed false alert
             calibrated=C0 >= 2.0 * self.min_count,
-            var_bl=_between_var(plane[:, :B, F_LOGLAT] / bsafe),
-            var_be=_between_var(plane[:, :B, F_ERR] / bsafe),
+            var_bl=var_bl, var_be=var_be,
             sd_cnt=np.sqrt(np.maximum(cnt[:, :B].var(axis=1),
                                       np.maximum(rate0, 1.0))))
 
@@ -375,11 +517,14 @@ class OnlineDetector:
         if self._baseline is None:
             self._baseline = self._calibrate(plane)
         b = self._baseline
+        S, K = self._n_svc, self._K
         cnt = plane[..., F_COUNT]
         off = self.replay.window_offset
         # fleet-activity per column: a window where nobody reported is
-        # feed silence, skipped below (never evidence for any service)
-        fleet = cnt.sum(axis=0) > 0
+        # feed silence, skipped below (never evidence for any service).
+        # Node rows [0, S) see every span exactly once, so they alone
+        # define fleet activity (edge rows are the same spans re-keyed).
+        fleet = cnt[:S].sum(axis=0) > 0
         out: List[Alert] = []
         for w in range(start, through + 1):
             col = w - off
@@ -443,6 +588,11 @@ class OnlineDetector:
             frac_w = np.clip(1.0 - n_w / np.maximum(b["rate0"], 1e-9),
                              0.0, 1.0)
             extras = self._modality_z(w)
+            if K > S:
+                # modality planes are node-scoped by construction; edge
+                # rows carry span evidence only
+                extras = {k: np.concatenate([v, np.zeros(K - S)])
+                          for k, v in extras.items()}
             det_parts = dict(latency=zl, error=ze, drop=zd, cusum=zdc,
                              **extras)
             rank_parts = dict(latency=zl, error=ze, drop=zd * frac_w,
@@ -453,8 +603,58 @@ class OnlineDetector:
             ev_names = list(rank_parts)
             ev_idx = rank_stack.argmax(axis=0)
             hot = detect_z >= self.z_threshold
+            if K > S:
+                # Edge rows alert on span latency/error only: a per-edge
+                # drop just mirrors node evidence (caller died / callee
+                # died) at lower counts, and the drop z's blast-radius
+                # caveats would apply per edge with no extra signal.
+                # Edge traffic is a fraction of node traffic (each span
+                # keys to ONE edge), so per-window edge counts sit below
+                # min_count at realistic densities — the edge z therefore
+                # POOLS the last ``edge_pool`` closed windows (same SE /
+                # binomial math on the pooled sums; the between-window
+                # term uses var_*_pool — the regular var_bl where it
+                # exists, else the sparse-row drift estimate — unscaled
+                # by the pool width, conservative).  The fault's sustain
+                # makes the pooled z converge to the per-window z within
+                # edge_pool windows of onset.
+                P = self.edge_pool
+                plo = max(col - P + 1, 0)
+                seg = plane[S:, plo:col + 1]
+                n_p = seg[..., F_COUNT].sum(axis=1)
+                safe_p = np.maximum(n_p, 1.0)
+                # pooled scoring earns a softer calibration gate than the
+                # per-window node z (min_count baseline spans instead of
+                # 2x): the pooled window widens the evidence side, and
+                # the Laplace error prior + between-window variance terms
+                # already price a thin baseline into the denominator
+                ok_p = (n_p >= self.min_count) & \
+                    (b["C0"][S:] >= self.min_count)
+                # two-sample form: the pooled window can hold MORE spans
+                # than the thin edge baseline, so the baseline mean's own
+                # sampling variance (var/C0) must be priced in — without
+                # it a 5-span baseline against a 40-span pool mints fake
+                # 4-sigma heat from baseline noise alone
+                C0e = np.maximum(b["C0"][S:], 1.0)
+                zl_p = np.where(
+                    ok_p,
+                    (seg[..., F_LOGLAT].sum(axis=1) / safe_p - b["mu_l"][S:])
+                    / np.sqrt(b["var_span"][S:] / safe_p
+                              + b["var_span"][S:] / C0e
+                              + b["var_bl_pool"][S:]),
+                    0.0)
+                ze_p = np.where(
+                    ok_p,
+                    (seg[..., F_ERR].sum(axis=1) / safe_p - b["p_err"][S:])
+                    / np.sqrt(b["err_var"][S:] / safe_p
+                              + b["err_var"][S:] / C0e
+                              + b["var_be_pool"][S:]),
+                    0.0)
+                span_z = np.concatenate(
+                    [np.maximum(zl, ze)[:S], np.maximum(zl_p, ze_p)])
+                hot[S:] = span_z[S:] >= self.z_threshold
             self._streak = np.where(hot, self._streak + 1, 0)
-            for s in np.nonzero(self._streak >= self.consecutive)[0]:
+            for s in np.nonzero(self._streak[:S] >= self.consecutive)[0]:
                 out.append(Alert(window=w, service=int(s),
                                  service_name=self.services[s],
                                  score=float(score[s]),
@@ -463,6 +663,34 @@ class OnlineDetector:
                                  z_drop=float(zd[s]),
                                  z_drop_cum=float(zdc[s]),
                                  evidence=ev_names[int(ev_idx[s])]))
+            if K > S:
+                # self-edge heat is the node-vs-edge locus discriminator:
+                # a NODE fault inflates the culprit's own-parented/root
+                # spans (self-edge hot); a LINK fault leaves every self
+                # -edge cool and only the culprit's out-edge slot hot
+                self._self_hot |= span_z[S:2 * S] >= self.z_threshold
+                for pi in np.nonzero(
+                        self._streak[2 * S:] >= self.consecutive)[0]:
+                    p = int(pi)
+                    # if any callee of p shows a hot SELF-edge, the
+                    # degradation is node-borne in that callee and the
+                    # out-edge heat is its reflection — the node path
+                    # owns the blame
+                    callees = self._callees_of(p)
+                    if callees and bool(
+                            (span_z[S + np.fromiter(callees, np.int64)]
+                             >= self.z_threshold).any()):
+                        continue
+                    slot = 2 * S + p
+                    sc = float(span_z[slot])
+                    self._edge_hot[p] = self._edge_hot.get(p, 0.0) + sc
+                    out.append(Alert(window=w, service=p,
+                                     service_name=self.services[p],
+                                     score=sc,
+                                     z_latency=float(zl_p[slot - S]),
+                                     z_error=float(ze_p[slot - S]),
+                                     z_drop=0.0, z_drop_cum=0.0,
+                                     evidence="edge"))
         self._scored_through = through
         self._after_score(through)
         self.alerts.extend(out)
@@ -501,9 +729,83 @@ class OnlineDetector:
             peak[a.service] = max(peak.get(a.service, 0.0), a.score)
             total[a.service] = total.get(a.service, 0.0) + a.score
             windows.setdefault(a.service, set()).add(a.window)
-        anomalous = set(peak)
+        # edge-explained callees: a service whose anomaly is edge-borne —
+        # hot incoming cross edge(s), self-edge never hot, and no direct
+        # node-scoped modality evidence (a NODE fault degrades the
+        # service's own logs/metrics; a link fault cannot) — is a blast
+        # victim of the edge's CALLER, which already carries the edge
+        # alerts.  It must neither outrank the caller nor "explain" the
+        # caller away in the downstream walk.
+        edge_explained: set = set()
+        edge_dom: set = set()
+        direct_node_ev: set = set()
+        if self.edge_attribution and self._edge_hot:
+            # node-borne modality evidence must SUSTAIN (>= 2 distinct
+            # windows): a single 4-sigma log/metric window across S
+            # services x W windows is expected multiple-testing noise,
+            # and letting it certify a service as node-borne would both
+            # shield blast victims from edge-explanation and explain
+            # away a genuine edge culprit upstream of the noise
+            mod_windows: dict = {}
+            for a in self.alerts:
+                if a.evidence in ("log", "metric", "api"):
+                    mod_windows.setdefault(a.service, set()).add(a.window)
+            direct_node_ev = {s for s, ws in mod_windows.items()
+                              if len(ws) >= 2}
+            hot_children = {c for p in self._edge_hot
+                            for c in self._callees_of(p)}
+            for c in hot_children:
+                if c in peak and not self._self_hot[c] \
+                        and c not in direct_node_ev:
+                    edge_explained.add(c)
+            #: callers whose evidence is mostly edge-borne — their
+            #: anomaly is ABOUT their outgoing links, so it must not be
+            #: explained away by the blast those same links cause
+            #: downstream (stalled traces thin downstream throughput,
+            #: firing drop/cusum on the callees' subtrees)
+            edge_dom = {p for p, eh in self._edge_hot.items()
+                        if p in total and eh >= 0.5 * total[p]}
+            if edge_dom:
+                # upstream blast: callers of a link-faulted service stall
+                # (their traces wait on the slow edge), firing drop/cusum
+                # with peaks that can dwarf the culprit's edge z — the
+                # walk's magnitude guard then refuses to explain them.
+                # A service whose evidence is neither node-borne nor
+                # edge-dominant, and from which an edge-dominant caller
+                # is reachable, is that caller's blast radius.
+                direct = {}
+                for a, c in self.call_edges:
+                    direct.setdefault(a, set()).add(c)
+
+                def _reaches_edge_dom(q):
+                    seen, frontier = {q}, [q]
+                    while frontier:
+                        nxt = direct.get(frontier.pop(), ())
+                        for r in nxt:
+                            if r in edge_dom:
+                                return True
+                            if r not in seen:
+                                seen.add(r)
+                                frontier.append(r)
+                    return False
+
+                for q in set(peak) - edge_dom - edge_explained:
+                    if not self._self_hot[q] and q not in direct_node_ev \
+                            and _reaches_edge_dom(q):
+                        edge_explained.add(q)
+        anomalous = set(peak) - edge_explained
         explained = _explained_by_downstream(self.call_edges, anomalous,
                                              peaks=peak, windows=windows)
+        if edge_dom:
+            # an edge-dominant caller yields only to NODE-borne anomalies
+            # downstream (hot self-edge or direct modality evidence — a
+            # real culprit living deeper), not to its own blast radius
+            node_borne = {s for s in anomalous
+                          if self._self_hot[s] or s in direct_node_ev}
+            strict = _explained_by_downstream(
+                self.call_edges, node_borne | edge_dom,
+                peaks=peak, windows=windows)
+            explained = (explained - edge_dom) | (strict & edge_dom)
 
         # ranking key: SUM of alert scores, not the single peak — a
         # culprit sustains its anomaly across the fault (many windows,
@@ -511,7 +813,7 @@ class OnlineDetector:
         # persistence is signal the peak throws away.  Guards above still
         # compare peaks (comparable instantaneous strength).
         def key(s):
-            return (s in explained, -total[s])
+            return (s in explained or s in edge_explained, -total[s])
 
         return [self.services[s] for s in sorted(total, key=key)]
 
@@ -805,8 +1107,10 @@ def stream_experiment_multimodal(exp, cfg: Optional[ReplayConfig] = None,
         has_parent = batch.parent >= 0
         edges = set(zip(batch.service[batch.parent[has_parent]].tolist(),
                         batch.service[has_parent].tolist()))
+    psvc = resolve_parent_services(batch)
     order = np.argsort(batch.start_us, kind="stable")
     batch = take_spans(batch, order)
+    psvc = psvc[order]
     t0 = int(batch.start_us.min()) if batch.n_spans else 0
     det = MultimodalDetector(batch.services, cfg, t0, testbed=exp.testbed,
                              call_edges=edges, **detector_kw)
@@ -829,7 +1133,7 @@ def stream_experiment_multimodal(exp, cfg: Optional[ReplayConfig] = None,
                                   & (exp.api.t_s < hi_s)))
         m = (batch.start_us >= lo_s * 1e6) & (batch.start_us < hi_s * 1e6)
         if m.any():
-            det.push(take_spans(batch, m))
+            det.push(take_spans(batch, m), parent_service=psvc[m])
         lo_s = hi_s
     det.finish()
     return det
@@ -1030,8 +1334,12 @@ def stream_experiment(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
         callees = batch.service[has_parent]
         edges = set(zip(callers.tolist(), callees.tolist()))
         detector_kw = dict(detector_kw, call_edges=edges)
+    # parent services resolve on the FULL batch (same reason as edges:
+    # slicing breaks the parent row indices), then ride the sort order
+    psvc = resolve_parent_services(batch)
     order = np.argsort(batch.start_us, kind="stable")
     batch = take_spans(batch, order)
+    psvc = psvc[order]
     t0 = int(batch.start_us.min()) if batch.n_spans else 0
     det = OnlineDetector(batch.services, cfg, t0, **detector_kw)
     if batch.n_spans:
@@ -1041,6 +1349,7 @@ def stream_experiment(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
         for lo, hi in zip(np.concatenate([[0], bounds]),
                           np.concatenate([bounds, [batch.n_spans]])):
             if hi > lo:
-                det.push(take_spans(batch, slice(int(lo), int(hi))))
+                sl = slice(int(lo), int(hi))
+                det.push(take_spans(batch, sl), parent_service=psvc[sl])
     det.finish()
     return det

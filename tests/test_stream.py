@@ -438,3 +438,73 @@ def test_detector_flags_throughput_drop():
     assert dead and dead[0].window in (10, 11)
     assert dead[0].z_drop >= det.z_threshold
     assert not [a for a in det.alerts if a.service_name == "svc0"]
+
+
+# -- edge-locus attribution (the out-edge plane) ---------------------------
+
+
+def test_edge_ids_self_vs_cross_vs_missing():
+    """Slot mapping: cross spans key to the CALLER's out-edge slot 2S+p;
+    roots / own-parented spans (and every span when parent info is
+    absent) key to their service's self-edge slot S+c."""
+    cfg = ReplayConfig(n_services=3, n_windows=16, chunk_size=256)
+    det = OnlineDetector(("a", "b", "c"), cfg, t0_us=0)
+    S = 3
+    svc = np.array([0, 1, 2, 1], np.int32)
+    psvc = np.array([-1, 0, 1, 1], np.int32)   # root, a->b, b->c, self b
+    got = det._edge_ids(svc, psvc)
+    assert got.tolist() == [S + 0, 2 * S + 0, 2 * S + 1, S + 1]
+    assert det._edge_ids(svc, None).tolist() == [S + 0, S + 1, S + 2, S + 1]
+
+
+def test_edge_mode_node_alerts_match_node_only_detector():
+    """The combined id space must not change NODE behavior: the node rows
+    see the same spans with the same binning, so the non-edge alert
+    stream is identical to an edge_attribution=False detector's."""
+    label = labels.label_for("Lv_P_CPU_preserve")
+    exp = synth.generate_experiment(label, n_traces=200, seed=3)
+    det_on = stream_experiment(exp.spans)
+    det_off = stream_experiment(exp.spans, edge_attribution=False)
+    node_on = [a for a in det_on.alerts if a.evidence != "edge"]
+    assert [(a.window, a.service, a.evidence, round(a.score, 6))
+            for a in node_on] == \
+           [(a.window, a.service, a.evidence, round(a.score, 6))
+            for a in det_off.alerts]
+
+
+def test_edge_locus_fault_attributed_to_caller():
+    """A link fault (callee-side degradation of the culprit's outgoing
+    calls, anomod/synth.py fault_locus='edge') leaves every node-scoped
+    statistic of the culprit healthy — only the out-edge plane names it.
+    The detector must rank the CALLER first with evidence='edge'."""
+    label = labels.label_for("Lv_C_travel_detail_failure")
+    hard = synth.HardMode(severity=1.0, noise=0.0, fault_locus="edge")
+    exp = synth.generate_experiment(label, n_traces=400, seed=0, hard=hard)
+    det = stream_experiment(exp.spans)
+    ranked = det.ranked_services()
+    assert ranked and ranked[0] == label.target_service
+    edge_alerts = [a for a in det.alerts if a.evidence == "edge"]
+    assert any(a.service_name == label.target_service for a in edge_alerts)
+    # propagated errors legitimately heat ancestor out-slots too (failed
+    # callee spans error their parents' entry spans, which ride the
+    # grandparent's out-edge slot) — the CULPRIT must carry the max
+    tgt = list(det.services).index(label.target_service)
+    assert det._edge_hot[tgt] == max(det._edge_hot.values())
+    # detection latency through the edge plane stays bounded (pooled
+    # windows add a few windows over the node path's 0-4)
+    fw = det.first_alert_window(label.target_service)
+    assert fw is not None and 10 <= fw <= 10 + det.edge_pool
+
+
+def test_node_fault_not_misattributed_to_caller():
+    """Under a NODE fault the culprit's self-edge goes hot, so the
+    callee-self-hot guard must suppress out-edge blame on its callers:
+    the culprit still ranks first and no caller outranks it via edge
+    evidence."""
+    label = labels.label_for("Lv_P_CPU_preserve")
+    exp = synth.generate_experiment(label, n_traces=300, seed=0)
+    det = stream_experiment(exp.spans)
+    ranked = det.ranked_services()
+    assert ranked and ranked[0] == label.target_service
+    tgt = list(det.services).index(label.target_service)
+    assert det._self_hot[tgt]                 # locus discriminator fired
