@@ -68,14 +68,24 @@ for i in $(seq 1 200); do
       fi
     done
     # On-chip streaming-quality records (multimodal, both testbeds): cheap
-    # (~2 min each), still missing TPU-side agreement evidence.
+    # (~2-4 min each).  SHA-gated, not existence-gated: the streaming
+    # detector evolves (edge attribution landed after the last on-chip
+    # captures), so agreement evidence must track the current tree.
+    sha=$(git rev-parse HEAD)
     for tb in TT SN; do
-      if ! grep -l "\"testbed\": \"$tb\"" \
-          bench_runs/*_stream_quality_tpu.json >/dev/null 2>&1; then
+      if ! grep -l "\"git_sha\": \"$sha\"" \
+          $(grep -l "\"testbed\": \"$tb\"" \
+            bench_runs/*_stream_quality_tpu.json 2>/dev/null /dev/null) \
+          >/dev/null 2>&1; then
         ANOMOD_SKIP_PROBE=1 timeout 900 \
           python -m anomod.cli stream --all --testbed "$tb" --multimodal \
           > "/tmp/tpu_watch_stream_$tb.log" 2>&1
         echo "=== $tb stream rc: $? ==="
+        ANOMOD_SKIP_PROBE=1 timeout 900 \
+          python -m anomod.cli stream --all --testbed "$tb" --multimodal \
+          --severity 0.3 --noise 0.5 --confounders 2 --shift edge-locus \
+          > "/tmp/tpu_watch_stream_edge_$tb.log" 2>&1
+        echo "=== $tb stream edge-locus rc: $? ==="
       fi
     done
     after=$(ls bench_runs/*_tpu.json 2>/dev/null | wc -l)
