@@ -71,7 +71,11 @@ def main() -> int:
         print(json.dumps(points[-1]))
 
     # sorted-window variant: sweep (block, k) over the same corpus — its
-    # one-hot is k lanes wide, so block can grow without VMEM pressure
+    # one-hot is k lanes wide, so block can grow without VMEM pressure.
+    # At replicate 64 the ~70 ms fixed tunnel dispatch/read-back overhead
+    # masks block preferences (every point lands ~0.09-0.10 s), so the
+    # sweep also runs each point at replicate 512 (~0.2 s/dispatch,
+    # kernel-dominated) — that column is the one that ranks configs.
     from anomod.ops.pallas_replay import (make_pallas_replay_sorted_fn,
                                           stage_sorted_planes)
     sorted_points = []
@@ -82,17 +86,21 @@ def main() -> int:
             sid_d = jax.device_put(sid_l)
             planes_d = jax.device_put(planes_s)
             wids_d = jax.device_put(wids)
-            fn = make_pallas_replay_sorted_fn(cfg.sw, cfg.n_hist_buckets,
-                                              k=k, block=block,
-                                              inner_repeats=replicate)
-            wall, walls = time_fn(
-                lambda: fn(sid_d, planes_d, wids_d))
-            sorted_points.append({
-                "block": block, "k": k, "staged_rows": int(sid_l.shape[0]),
-                "spans_per_sec": round(n * replicate / wall, 1),
-                "wall_s": round(wall, 4),
-                "raw_wall_s": [round(w, 4) for w in walls]})
-            print(json.dumps(sorted_points[-1]))
+            point = {"block": block, "k": k,
+                     "staged_rows": int(sid_l.shape[0])}
+            for rep in (replicate, 512):
+                fn = make_pallas_replay_sorted_fn(cfg.sw,
+                                                  cfg.n_hist_buckets,
+                                                  k=k, block=block,
+                                                  inner_repeats=rep)
+                wall, walls = time_fn(
+                    lambda: fn(sid_d, planes_d, wids_d))
+                tag = "" if rep == replicate else f"_r{rep}"
+                point[f"spans_per_sec{tag}"] = round(n * rep / wall, 1)
+                point[f"wall_s{tag}"] = round(wall, 4)
+                point[f"raw_wall_s{tag}"] = [round(w, 4) for w in walls]
+            sorted_points.append(point)
+            print(json.dumps(point))
 
     # replicate scaling at the default sorted config: if spans/sec keeps
     # rising with on-device replication, the fixed dispatch/read-back
@@ -123,6 +131,8 @@ def main() -> int:
         points=points, flatness=round(worst / best, 4),
         sorted_points=sorted_points,
         sorted_best=max(p["spans_per_sec"] for p in sorted_points),
+        sorted_best_r512=max(p["spans_per_sec_r512"]
+                             for p in sorted_points),
         replicate_points=replicate_points,
         xla_spans_per_sec=round(xla.spans_per_sec, 1),
         xla_raw_wall_s=[round(w, 4) for w in xla.raw_wall_s])

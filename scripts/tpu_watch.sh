@@ -67,6 +67,16 @@ for i in $(seq 1 200); do
         echo "=== $tb shift sweep rc: $? ==="
       fi
     done
+    # Kernel-dominated block sweep (sorted kernel ranked at replicate 512
+    # where dispatch overhead no longer masks block preferences): once,
+    # keyed on the record field that only the extended sweep writes
+    if ! grep -l '"sorted_best_r512"' \
+        bench_runs/*_pallas_block_sweep_tpu.json /dev/null >/dev/null 2>&1
+    then
+      timeout 1200 python scripts/bench_block_sweep.py \
+        > /tmp/tpu_watch_blocksweep.log 2>&1
+      echo "=== block sweep rc: $? ==="
+    fi
     # On-chip streaming-quality records (multimodal, both testbeds): cheap
     # (~2-4 min each).  SHA-gated, not existence-gated: the streaming
     # detector evolves (edge attribution landed after the last on-chip
