@@ -45,10 +45,14 @@ the OUT-EDGE plane (``edge_attribution``, default on): every span is
 pushed twice through the same jitted chunk scan — once keyed by its
 service, once by caller-resolved edge slot — and a hot out-edge slot
 with cool callee self-edges alerts the CALLER with evidence="edge"
-(11/12 at live density/severity).  The residual edge-locus gap is the
-de-saturated sparse regime (pooled out-edge windows against an 8-window
-baseline cap the z below threshold at ~1 span/window) — there the
-trained graph models remain the answer (see docs/BENCHMARKS.md).
+(11/12 at live density/severity).  This plane is the framework's ONLY
+working edge-locus detector: the offline models consume per-service
+aggregates, so link faults are architecturally outside their evidence
+(every node-feature model ≤ 0.06 once the generator's coverage/API
+target-identity leak was gated — see docs/BENCHMARKS.md, "Generator-leak
+retraction").  The residual gap is the de-saturated sparse regime, where
+pooled out-edge windows against an 8-window baseline cap the z below
+threshold at ~1 span/window.
 """
 
 from __future__ import annotations

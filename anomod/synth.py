@@ -273,7 +273,11 @@ class HardMode:
     - ``fault_locus``: where the fault manifests — "node" (the culprit
       service's own spans) or "edge" (the callee side of the culprit's
       outgoing calls, like a link fault: node-scoped metrics/logs stay
-      healthy and attribution must come from trace structure).
+      healthy, coverage does not shift, API routes degrade only when the
+      target actually has outgoing calls, and attribution must come from
+      trace structure; a target with NO outgoing calls faults no edge, so
+      its corpus carries no localizing signal at all — the honest floor
+      for every detector).
     """
     severity: float = 1.0
     noise: float = 0.0
@@ -1061,7 +1065,17 @@ def generate_api(label: FaultLabel, n_records: int = 600,
     lat = rng.lognormal(np.log(40.0), 0.5 * (1.0 + hard.noise),
                         n_records).astype(np.float32)
     status = np.full(n_records, 200, np.int16)
-    if label.is_anomaly:
+    # An edge-locus fault lives on the target's OUTGOING links.  End-to-end
+    # API routes through the target still slow down (the route waits on the
+    # slow downstream call) — but ONLY if the target has outgoing calls: a
+    # leaf target faults no edge, so the whole API surface stays healthy.
+    # Without this gate the api artifact named the culprit for corpora
+    # that carry zero fault signal anywhere else (a target-identity leak
+    # the learned models exploited to fake 1.00 on edge-locus leaf kills).
+    edge_inert = (hard.fault_locus == "edge" and label.target_service
+                  and not any(a == label.target_service
+                              for a, _c in _topology(label.testbed)[1]))
+    if label.is_anomaly and not edge_inert:
         # endpoints routed through the culprit service bear the brunt; a
         # host-level fault (no target) hits the whole surface (matches how
         # the reference's monitor sees chaos: per-endpoint p95/p99 spikes on
@@ -1116,8 +1130,14 @@ def generate_coverage(label: FaultLabel, files_per_service: int = 6,
         for i in range(files_per_service):
             total, base_ratio = _file_coverage_base(svc, i)
             ratio = base_ratio + float(rng.uniform(-0.02, 0.02))  # run jitter
-            if label.is_anomaly and label.target_service == svc:
-                # injected faults shift executed paths on the culprit
+            if label.is_anomaly and label.target_service == svc \
+                    and hard.fault_locus != "edge":
+                # injected faults shift executed paths on the culprit — but
+                # only NODE faults: a link fault is in the network between
+                # services, the culprit's own code runs the same paths
+                # (leaving this ungated leaked the target's identity into
+                # edge-locus corpora through an artifact no real link
+                # fault would move)
                 ratio = max(0.05, ratio - 0.15 * hard.severity)
             ext = "cpp" if label.testbed == "SN" else "java"
             files.append(FileCoverage(

@@ -293,3 +293,34 @@ def test_bursty_profile_is_cross_modality():
     off = v[(t_rel >= 660) & (t_rel < 720)]
     assert len(on) and len(off)
     assert on.mean() > 2 * off.mean()  # fault active only during bursts
+
+
+def test_edge_locus_no_artifact_leak_for_leaf_target():
+    """A zero-out-edge target under edge locus faults NO edge — its corpus
+    must carry no localizing artifact.  Coverage and API previously leaked
+    the target's identity here (coverage ratio drop was not locus-gated;
+    api degraded target-owned routes regardless of out-edges), which let
+    trained models 'recover' culprits from corpora with zero fault signal."""
+    lab = labels.label_for("Svc_Kill_Media")          # media has no callees
+    assert not any(a == lab.target_service for a, _c in synth.SN_EDGES)
+    hard = synth.HardMode(fault_locus="edge")
+    # coverage: target ratio must match the node-locus baseline jitter band
+    cov_e = synth.generate_coverage(lab, hard=hard)
+    cov_n = synth.generate_coverage(labels.label_for("Normal_Baseline"))
+    def ratio(cb, svc):
+        return float(cb.service_ratio()[cb.services.index(svc)])
+    assert abs(ratio(cov_e, lab.target_service)
+               - ratio(cov_n, lab.target_service)) < 0.05
+    # api: no 5xx concentration and no latency inflation anywhere
+    api_e = synth.generate_api(lab, hard=hard)
+    assert (api_e.status >= 500).mean() < 0.01
+    # a target WITH out-edges keeps the end-to-end route degradation
+    lab2 = labels.label_for("Svc_Kill_UserTimeline")
+    assert any(a == lab2.target_service for a, _c in synth.SN_EDGES)
+    api2 = synth.generate_api(lab2, hard=hard)
+    assert (api2.status >= 500).mean() > 0.01
+    # node-locus coverage still shifts on the culprit (the gate is
+    # locus-scoped, not a blanket removal)
+    cov_node = synth.generate_coverage(lab)
+    assert ratio(cov_n, lab.target_service) \
+        - ratio(cov_node, lab.target_service) > 0.05
