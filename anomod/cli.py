@@ -243,6 +243,12 @@ def main(argv=None) -> int:
                           "generators (effect shape / fault timing / locus)")
     p_q.add_argument("--shift-severity", type=float, default=0.3,
                      help="fixed fault severity for the shift sweep")
+    p_q.add_argument("--edge-aware", action="store_true",
+                     help="--sweep shift only: out-edge feature blocks + "
+                          "node+edge mixed-locus training (the supervised "
+                          "counterpart of the streaming out-edge plane; "
+                          "the canonical table keeps node features and "
+                          "node-locus training)")
     p_q.add_argument("--json", action="store_true",
                      help="emit one JSON object per sweep point")
 
@@ -458,6 +464,8 @@ def main(argv=None) -> int:
                          "use --shift-severity for the shift sweep")
         if args.sweep == "severity" and args.shift_severity != 0.3:
             parser.error("--shift-severity applies to --sweep shift")
+        if args.sweep == "severity" and args.edge_aware:
+            parser.error("--edge-aware applies to --sweep shift")
         _probe_backend(args)
         common = dict(
             testbed=args.testbed, model_names=args.models,
@@ -466,7 +474,8 @@ def main(argv=None) -> int:
             n_traces=args.traces, epochs=args.epochs, noise=args.noise,
             n_confounders=args.confounders, verbose=not args.json)
         if args.sweep == "shift":
-            pts = shift_sweep(severity=args.shift_severity, **common)
+            pts = shift_sweep(severity=args.shift_severity,
+                              edge_aware=args.edge_aware, **common)
             render = render_shift_markdown
         else:
             pts = severity_sweep(severities=args.severities, **common)
@@ -491,7 +500,8 @@ def main(argv=None) -> int:
                 params={**{k: (list(v) if isinstance(v, range) else v)
                            for k, v in common.items()
                            if k not in ("verbose", "testbed", "model_names")},
-                        **({"shift_severity": args.shift_severity}
+                        **({"shift_severity": args.shift_severity,
+                            "edge_aware": bool(args.edge_aware)}
                            if args.sweep == "shift"
                            else {"severities": args.severities})},
                 points=[_dc.asdict(p) for p in pts], **failover)

@@ -216,20 +216,30 @@ def shift_sweep(testbed: str = "TT",
                 eval_seeds: Sequence[int] = range(100, 103),
                 n_traces: int = 60, epochs: int = 120,
                 noise: float = 0.5, n_confounders: int = 2,
-                verbose: bool = False) -> List[QualityPoint]:
+                verbose: bool = False,
+                edge_aware: bool = False) -> List[QualityPoint]:
     """Train-shift/eval-shift table (round-2 weak #4): models train ONCE on
     the default effect model (the same mixed-severity corpus as
     severity_sweep) and are evaluated under each shifted generator in
     :data:`SHIFTS` at one fixed severity.  A ranking that only holds
     in-distribution is a statement about the generator; this sweep shows
     which model ordering survives effect-shape, fault-timing, and
-    fault-locus shift."""
+    fault-locus shift.
+
+    ``edge_aware``: opt-in variant — out-edge feature blocks plus a
+    node+edge mixed-locus training corpus, the supervised counterpart of
+    the streaming out-edge plane.  The canonical table keeps node
+    features and node-locus training (the honest shift premise); this
+    variant answers "CAN the models attribute link faults when given the
+    evidence channel and training exposure"."""
     eval_modes = {name: synth.HardMode(severity=severity, noise=noise,
                                        **SHIFTS[name])
                   for name in shifts}
     cells = _eval_grid(testbed, model_names, eval_modes, train_seeds,
                        eval_seeds, n_traces, epochs, noise, n_confounders,
-                       verbose)
+                       verbose, edge_features=edge_aware,
+                       train_loci=("node", "edge") if edge_aware
+                       else ("node",))
     return [QualityPoint(name, severity, noise, n_confounders, *cell,
                          shift=shift)
             for (name, shift), cell in cells.items()]
@@ -237,11 +247,19 @@ def shift_sweep(testbed: str = "TT",
 
 def _eval_grid(testbed, model_names, eval_modes: Dict[object, "synth.HardMode"],
                train_seeds, eval_seeds, n_traces, epochs, noise,
-               n_confounders, verbose=False):
+               n_confounders, verbose=False, edge_features=False,
+               train_loci=("node",)):
     """Shared sweep engine: one unshifted mixed-severity training pass,
     then every model evaluated on every eval-mode corpus.  Returns
     {(model, mode_key): (top1, top3, auc, n_eval)}; corpora per cell are
-    identical across models (rca.experiment_stream via build_dataset)."""
+    identical across models (rca.experiment_stream via build_dataset).
+
+    ``edge_features`` / ``train_loci`` configure the EDGE-AWARE variant:
+    out-edge feature blocks plus a training mixture that includes
+    edge-locus corpora — without both, link-fault attribution is
+    architecturally outside the models' evidence (training on node
+    faults alone leaves the out-edge channel with nothing to learn
+    from).  The canonical tables keep the defaults."""
     # zscore and stream are training-free rows — only the learned models
     # need the mixed-severity training corpus and eval batches
     needs_training = any(name not in ("zscore", "stream")
@@ -254,11 +272,14 @@ def _eval_grid(testbed, model_names, eval_modes: Dict[object, "synth.HardMode"],
         for sev, part in zip((1.0, 0.4, 0.15), thirds):
             if len(part) == 0:
                 continue
-            samples, services = build_dataset(
-                testbed, [int(s) for s in part], n_traces=n_traces,
-                hard=synth.HardMode(severity=sev, noise=noise),
-                n_confounders=n_confounders)
-            train_parts.append(_stack(samples))
+            for locus in train_loci:
+                samples, services = build_dataset(
+                    testbed, [int(s) for s in part], n_traces=n_traces,
+                    hard=synth.HardMode(severity=sev, noise=noise,
+                                        fault_locus=locus),
+                    n_confounders=n_confounders,
+                    edge_features=edge_features)
+                train_parts.append(_stack(samples))
         e_max = max(p["edge_src"].shape[1] for p in train_parts)
         for p in train_parts:
             _repad_edges(p, e_max)
@@ -271,7 +292,8 @@ def _eval_grid(testbed, model_names, eval_modes: Dict[object, "synth.HardMode"],
         eval_batches: Dict[object, Dict[str, np.ndarray]] = {}
         for key, mode in eval_modes.items():
             samples, _ = build_dataset(testbed, eval_seeds, n_traces=n_traces,
-                                       hard=mode, n_confounders=n_confounders)
+                                       hard=mode, n_confounders=n_confounders,
+                                       edge_features=edge_features)
             ev = _stack(samples)
             e_max = max(e_max, ev["edge_src"].shape[1])
             eval_batches[key] = ev

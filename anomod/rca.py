@@ -33,12 +33,10 @@ class RCASample:
     is_anomaly: bool
 
 
-def _windowed_features(batch, services, cfg: ReplayConfig) -> np.ndarray:
+def _agg_feature_block(batch, services, cfg: ReplayConfig,
+                       t0_us=None) -> np.ndarray:
     """[S, W, 4]: count, err_rate, mean log-latency, 5xx rate per window."""
-    svc_index = {s: i for i, s in enumerate(services)}
-    remap = np.array([svc_index.get(s, 0) for s in batch.services] or [0], np.int32)
-    batch = batch._replace(service=remap[batch.service], services=tuple(services))
-    chunks, _ = stage_columns(batch, cfg)
+    chunks, _ = stage_columns(batch, cfg, t0_us=t0_us)
     st = replay_numpy(chunks, cfg)
     from anomod.replay import F_ERR, F_LOGLAT, F_STATUS5XX
     agg = st.agg.reshape(len(services), cfg.n_windows, -1)
@@ -48,6 +46,39 @@ def _windowed_features(batch, services, cfg: ReplayConfig) -> np.ndarray:
         np.log1p(count), agg[..., F_ERR] / safe, agg[..., F_LOGLAT] / safe,
         agg[..., F_STATUS5XX] / safe,
     ], axis=-1).astype(np.float32)
+
+
+def _windowed_features(batch, services, cfg: ReplayConfig,
+                       edge_features: bool = False) -> np.ndarray:
+    """[S, W, 4] node features — or [S, W, 8] with ``edge_features``: the
+    same four aggregates computed a second time over each service's
+    OUT-EDGE spans (spans whose parent belongs to that service, i.e. the
+    callee side of its outgoing calls).  The out-edge block is the
+    offline counterpart of the streaming detector's caller-keyed
+    out-edge plane: a link fault (synth fault_locus="edge") is invisible
+    in every node aggregate but lands exactly in the culprit's out-edge
+    block — without it the models have no evidence channel for edge
+    faults at all (see docs/BENCHMARKS.md, generator-leak retraction)."""
+    svc_index = {s: i for i, s in enumerate(services)}
+    remap = np.array([svc_index.get(s, 0) for s in batch.services] or [0], np.int32)
+    batch = batch._replace(service=remap[batch.service], services=tuple(services))
+    # one time origin for BOTH blocks: the edge subset excludes root
+    # spans, so letting stage_columns re-derive t0 from it would slide
+    # the edge block's window grid relative to the node block's
+    t0_us = int(batch.start_us.min()) if batch.n_spans else 0
+    node = _agg_feature_block(batch, services, cfg, t0_us=t0_us)
+    if not edge_features:
+        return node
+    from anomod.schemas import take_spans
+    psvc = np.full(batch.n_spans, -1, np.int32)
+    has = batch.parent >= 0
+    psvc[has] = batch.service[batch.parent[has]]
+    cross = (psvc >= 0) & (psvc != batch.service)
+    if not cross.any():
+        return np.concatenate([node, np.zeros_like(node)], axis=-1)
+    edge_batch = take_spans(batch, cross)._replace(service=psvc[cross])
+    edge = _agg_feature_block(edge_batch, services, cfg, t0_us=t0_us)
+    return np.concatenate([node, edge], axis=-1)
 
 
 def _pick_confounders(label, services: Tuple[str, ...], seed: int,
@@ -96,14 +127,19 @@ def experiment_stream(testbed: str, seed: int, n_traces: int = 80,
 def build_dataset(testbed: str, seeds: Sequence[int], n_traces: int = 80,
                   n_windows: int = 8,
                   hard: Optional["synth.HardMode"] = None,
-                  n_confounders: int = 0) -> Tuple[List[RCASample], Tuple[str, ...]]:
+                  n_confounders: int = 0,
+                  edge_features: bool = False
+                  ) -> Tuple[List[RCASample], Tuple[str, ...]]:
     """One sample per (fault label, seed), features relative to the same-seed
     normal baseline.
 
     ``hard`` applies HardMode difficulty (severity/noise) to the FAULT
     experiments; the normal baseline stays easy (it is the healthy profile).
     ``n_confounders`` > 0 additionally plants that many per-(label, seed)
-    decoy services into each fault experiment.
+    decoy services into each fault experiment.  ``edge_features`` doubles
+    the windowed block with per-service OUT-EDGE aggregates (opt-in: the
+    canonical quality tables use node features; the edge-aware variant
+    needs this channel to learn link-fault attribution).
     """
     svc_list = synth.SN_SERVICES if testbed == "SN" else synth.TT_SERVICES
     services = tuple(svc_list)
@@ -118,12 +154,14 @@ def build_dataset(testbed: str, seeds: Sequence[int], n_traces: int = 80,
         normal = synth.generate_experiment(normal_label, n_traces=n_traces,
                                            seed=seed * 1000)
         base_x = detect.extract_features(normal, services).x
-        base_t = _windowed_features(normal.spans, services, cfg)
+        base_t = _windowed_features(normal.spans, services, cfg,
+                                    edge_features=edge_features)
         for label, exp in experiment_stream(testbed, seed, n_traces=n_traces,
                                             hard=hard,
                                             n_confounders=n_confounders):
             x = detect.extract_features(exp, services).x - base_x
-            x_t = _windowed_features(exp.spans, services, cfg) - base_t
+            x_t = _windowed_features(exp.spans, services, cfg,
+                                     edge_features=edge_features) - base_t
             g = build_service_graph(exp.spans, services=services)
             e_max = max(e_max, g.n_edges)
             target = (services.index(label.target_service)

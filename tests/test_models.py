@@ -66,3 +66,37 @@ def test_train_rca_end_to_end_fast():
     # GCN must localize culprits on held-out seeds (numpy baseline gets 1.0)
     assert r.top1 >= 0.7, (r.top1, r.top3)
     assert r.detection_auc >= 0.8
+
+
+def test_edge_feature_block_opt_in():
+    """edge_features doubles the windowed block with out-edge aggregates:
+    a link fault that is invisible in the target's NODE features lands in
+    its OUT-EDGE error-rate block (the evidence channel the edge-aware
+    training variant learns from)."""
+    import numpy as np
+    from anomod import labels, synth
+    from anomod.rca import _windowed_features
+    from anomod.replay import ReplayConfig
+
+    lab = labels.label_for("Lv_C_travel_detail_failure")
+    services = tuple(synth.TT_SERVICES)
+    cfg = ReplayConfig(n_services=len(services), n_windows=8,
+                       chunk_size=2048, window_us=300_000_000)
+    hard = synth.HardMode(severity=1.0, fault_locus="edge")
+    spans = synth.generate_spans(lab, n_traces=120, seed=3, hard=hard)
+    f4 = _windowed_features(spans, services, cfg)
+    f8 = _windowed_features(spans, services, cfg, edge_features=True)
+    assert f4.shape[-1] == 4 and f8.shape[-1] == 8
+    assert np.array_equal(f8[..., :4], f4)      # node block unchanged
+    ti = services.index(lab.target_service)
+    # fault window (middle third of 8 coarse windows); at full severity
+    # the culprit's out-edge error rate carries the direct fault signal
+    # (its node error rate also rises, but only via parent-ward error
+    # propagation — the same blast every ancestor sees)
+    node_err = f8[ti, 3:6, 1].max()
+    edge_err = f8[ti, 3:6, 5].max()
+    assert edge_err > 0.3 and edge_err > 1.5 * max(node_err, 0.02)
+    # spans with no parent info at all -> zero edge block, same shape
+    orphans = spans._replace(parent=np.full(spans.n_spans, -1, np.int32))
+    fz = _windowed_features(orphans, services, cfg, edge_features=True)
+    assert fz.shape == f8.shape and not fz[..., 4:].any()
