@@ -149,3 +149,18 @@ def test_shift_sweep_plumbing_zscore():
     assert {p.shift for p in pts} == {"in-dist", "edge-locus"}
     by = {p.shift: p for p in pts}
     assert by["edge-locus"].top1 <= by["in-dist"].top1
+
+
+def test_edge_aware_sweep_plumbing():
+    """--edge-aware smoke (tiny corpora, one cheap model): the mixed-locus
+    training corpus builds with the doubled out-edge feature block, the
+    trained model evaluates on every requested shift, and the sweep is
+    deterministic plumbing end to end (no quality floor asserted at this
+    budget)."""
+    from anomod.quality import shift_sweep
+    pts = shift_sweep(model_names=("gcn",),
+                      shifts=("edge-locus",), severity=0.6,
+                      train_seeds=range(2), eval_seeds=[100], n_traces=20,
+                      epochs=5, edge_aware=True)
+    assert len(pts) == 1 and pts[0].shift == "edge-locus"
+    assert pts[0].n_eval > 0
