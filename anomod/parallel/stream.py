@@ -13,6 +13,15 @@ definition of the sharded aggregation for batch and stream).
 mesh unchanged.  Pushes are processed in fixed groups of ``n_dev`` chunks
 (the last group padded with dead chunks), so the shard_map compiles ONCE
 regardless of micro-batch size.
+
+The plane is id-space agnostic — it scans whatever ``batch.service``
+holds against ``cfg.sw`` — so EDGE ATTRIBUTION runs over the mesh too:
+construct it on the combined id space
+(``ShardedStreamReplay(stream.edge_combined_cfg(cfg, S), t0, mesh)``)
+and pass ``edge_attribution=True``; the detector's doubled span rows
+(node id + caller-keyed edge slot) shard across devices like any other
+rows, and the alert stream matches the single-chip edge detector
+(parity-tested on the 8-device CPU mesh).
 """
 
 from __future__ import annotations

@@ -121,6 +121,15 @@ def plane_view(state: ReplayState, cfg: ReplayConfig) -> np.ndarray:
         cfg.n_services, cfg.n_windows, N_FEATS)
 
 
+def edge_combined_cfg(cfg: ReplayConfig, n_services: int) -> ReplayConfig:
+    """The COMBINED-id-space config an edge-attributing detector runs its
+    replay on: node ids ⊕ self-edge slots ⊕ out-edge slots = 3S rows.
+    Use this to construct an injectable plane (e.g.
+    ``ShardedStreamReplay(edge_combined_cfg(cfg, S), t0, mesh)``) for
+    ``OnlineDetector(..., replay=..., edge_attribution=True)``."""
+    return dataclasses.replace(cfg, n_services=3 * n_services)
+
+
 def resolve_parent_services(batch: SpanBatch) -> np.ndarray:
     """Per-span PARENT-service id (-1 for roots).
 
@@ -256,21 +265,10 @@ class OnlineDetector:
         if consecutive < 1:
             raise ValueError("consecutive must be >= 1 (0 would alert "
                              "every service in every window)")
-        # ``replay`` injects an alternative plane with the same contract —
-        # e.g. anomod.parallel.stream.ShardedStreamReplay runs this whole
-        # alerting stack over a device mesh unchanged
-        if replay is not None and (replay.cfg != cfg
-                                   or replay.t0_us != int(t0_us)):
-            raise ValueError("injected replay's cfg/t0 disagree with the "
-                             "detector's")
         if replay is not None and with_hll:
             raise ValueError("with_hll configures the detector's OWN "
                              "plane; an injected replay manages its own "
                              "HLL state")
-        if edge_attribution and replay is not None:
-            raise ValueError("edge attribution needs the detector's own "
-                             "combined-id replay; an injected replay "
-                             "keeps the node-keyed contract")
         self.services = tuple(batch_services)
         S = len(self.services)
         self._n_svc = S
@@ -300,12 +298,26 @@ class OnlineDetector:
         self.edge_pool = edge_pool
         if self.edge_attribution:
             K = 3 * S
-            cfg = dataclasses.replace(cfg, n_services=K)
+            cfg = edge_combined_cfg(cfg, S)
             self._edge_hot: dict = {}       # caller id -> summed hot score
             self._self_hot = np.zeros(S, bool)
         else:
             K = S
         self._K = K
+        # ``replay`` injects an alternative plane with the same contract —
+        # e.g. anomod.parallel.stream.ShardedStreamReplay runs this whole
+        # alerting stack over a device mesh unchanged.  With edge
+        # attribution (pass edge_attribution=True explicitly; the default
+        # only auto-enables for the detector's own plane) the injected
+        # replay must be built on the COMBINED id space:
+        # ``detector cfg with n_services = 3 * len(services)``.
+        if replay is not None and (replay.cfg != cfg
+                                   or replay.t0_us != int(t0_us)):
+            raise ValueError(
+                "injected replay's cfg/t0 disagree with the detector's"
+                + (" (edge attribution widens the id space: build the "
+                   f"replay with n_services = 3*S = {K})"
+                   if self.edge_attribution else ""))
         self.replay = replay if replay is not None else \
             StreamReplay(cfg, t0_us, with_hll=with_hll)
         #: spans fed by the caller (the combined-id replay counts each

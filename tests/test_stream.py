@@ -508,3 +508,51 @@ def test_node_fault_not_misattributed_to_caller():
     assert ranked and ranked[0] == label.target_service
     tgt = list(det.services).index(label.target_service)
     assert det._self_hot[tgt]                 # locus discriminator fired
+
+
+def test_sharded_edge_attribution_matches_single_chip():
+    """Edge attribution over the mesh: an injected ShardedStreamReplay
+    built on the COMBINED id space (edge_combined_cfg) runs the full
+    edge-alerting stack, and the alert stream matches the single-chip
+    edge detector's on an edge-locus corpus."""
+    from anomod.parallel import make_mesh
+    from anomod.parallel.stream import ShardedStreamReplay
+    from anomod.stream import (edge_combined_cfg, resolve_parent_services,
+                               stream_experiment)
+
+    label = labels.label_for("Lv_C_travel_detail_failure")
+    hard = synth.HardMode(severity=1.0, noise=0.0, fault_locus="edge")
+    exp = synth.generate_spans(label, n_traces=300, seed=0, hard=hard)
+    cfg = ReplayConfig(n_services=exp.n_services, chunk_size=1024)
+    psvc = resolve_parent_services(exp)
+    order = np.argsort(exp.start_us, kind="stable")
+    batch, psvc = take_spans(exp, order), psvc[order]
+    t0 = int(batch.start_us.min())
+    edges = set(zip(batch.service[batch.parent[batch.parent >= 0]].tolist(),
+                    batch.service[batch.parent >= 0].tolist()))
+
+    mesh = make_mesh()
+    combined = edge_combined_cfg(cfg, batch.n_services)
+    det_mesh = OnlineDetector(
+        batch.services, cfg, t0, call_edges=edges,
+        replay=ShardedStreamReplay(combined, t0, mesh),
+        edge_attribution=True)
+    det_one = OnlineDetector(batch.services, cfg, t0, call_edges=edges)
+    cuts = [0, 4000, 11000, batch.n_spans]
+    for lo, hi in zip(cuts, cuts[1:]):
+        sl = slice(lo, hi)
+        det_mesh.push(take_spans(batch, sl), parent_service=psvc[sl])
+        det_one.push(take_spans(batch, sl), parent_service=psvc[sl])
+    det_mesh.finish(); det_one.finish()
+    key = [(a.window, a.service, a.evidence) for a in det_one.alerts]
+    assert [(a.window, a.service, a.evidence)
+            for a in det_mesh.alerts] == key
+    assert any(a.evidence == "edge" for a in det_mesh.alerts)
+    assert det_mesh.ranked_services()[0] == label.target_service
+    # a node-keyed injected replay with edge_attribution=True is rejected
+    # with the combined-cfg hint
+    import pytest
+    with pytest.raises(ValueError, match="3\\*S"):
+        OnlineDetector(batch.services, cfg, t0,
+                       replay=ShardedStreamReplay(cfg, t0, mesh),
+                       edge_attribution=True)
