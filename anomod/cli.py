@@ -201,6 +201,11 @@ def main(argv=None) -> int:
                           help="fuse the log/metric/api planes with the "
                                "span stream (streaming counterpart of the "
                                "offline five-modality detector)")
+    p_stream.add_argument("--devices", type=int, default=0,
+                          help="shard the streaming replay plane (incl. "
+                               "the edge-attribution id space) over an "
+                               "N-device mesh (use ANOMOD_PLATFORM=cpu + "
+                               "ANOMOD_CPU_DEVICES=N for a virtual mesh)")
     p_stream.add_argument("--severity", type=float, default=1.0,
                           help="de-saturate the fault effects "
                                "(synth.HardMode) — the streaming "
@@ -314,6 +319,10 @@ def main(argv=None) -> int:
         if args.all:
             _probe_backend(args)
             from anomod.stream import stream_quality
+            mesh_kw = {}
+            if args.devices:
+                from anomod.parallel import make_mesh
+                mesh_kw["mesh"] = make_mesh(args.devices)
             rows = stream_quality(
                 args.testbed, n_traces=args.traces, seed=args.seed,
                 multimodal=args.multimodal,
@@ -321,7 +330,7 @@ def main(argv=None) -> int:
                 n_confounders=args.confounders, shift=args.shift,
                 slice_s=args.slice_seconds, z_threshold=args.threshold,
                 baseline_windows=args.baseline_windows,
-                consecutive=args.consecutive)
+                consecutive=args.consecutive, **mesh_kw)
             for r in rows:
                 print(json.dumps(r))
             import statistics
@@ -403,6 +412,9 @@ def main(argv=None) -> int:
         _kw = dict(slice_s=args.slice_seconds, z_threshold=args.threshold,
                    baseline_windows=args.baseline_windows,
                    consecutive=args.consecutive)
+        if args.devices:
+            from anomod.parallel import make_mesh
+            _kw["mesh"] = make_mesh(args.devices)
         if args.multimodal:
             from anomod.stream import stream_experiment_multimodal
             det = stream_experiment_multimodal(exp, **_kw)

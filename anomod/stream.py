@@ -256,7 +256,7 @@ class OnlineDetector:
                  call_edges: Optional[set] = None,
                  replay=None, with_hll: bool = False,
                  edge_attribution: Optional[bool] = None,
-                 edge_pool: int = 8):
+                 edge_pool: int = 8, mesh=None):
         if baseline_windows < 2:
             raise ValueError("need >= 2 baseline windows for a sigma")
         if baseline_windows >= cfg.n_windows:
@@ -269,6 +269,11 @@ class OnlineDetector:
             raise ValueError("with_hll configures the detector's OWN "
                              "plane; an injected replay manages its own "
                              "HLL state")
+        if mesh is not None and replay is not None:
+            raise ValueError("give a mesh OR a pre-built replay, not both")
+        if mesh is not None and with_hll:
+            raise ValueError("the mesh streaming plane carries no HLL "
+                             "state (psum-merged agg/hist only)")
         self.services = tuple(batch_services)
         S = len(self.services)
         self._n_svc = S
@@ -291,6 +296,9 @@ class OnlineDetector:
         #: fraction of node traffic, and splitting it S-ways again would
         #: starve the z statistics at realistic densities; which callee
         #: is degraded is not needed to name the culprit.)
+        # ``mesh`` builds the detector's own mesh-sharded plane (the
+        # combined-cfg bookkeeping stays in one place); edge attribution
+        # auto-enables for any detector-owned plane, mesh or single-chip
         self.edge_attribution = (replay is None) if edge_attribution is None \
             else bool(edge_attribution)
         if edge_pool < 1:
@@ -318,6 +326,9 @@ class OnlineDetector:
                 + (" (edge attribution widens the id space: build the "
                    f"replay with n_services = 3*S = {K})"
                    if self.edge_attribution else ""))
+        if replay is None and mesh is not None:
+            from anomod.parallel.stream import ShardedStreamReplay
+            replay = ShardedStreamReplay(cfg, t0_us, mesh)
         self.replay = replay if replay is not None else \
             StreamReplay(cfg, t0_us, with_hll=with_hll)
         #: spans fed by the caller (the combined-id replay counts each
