@@ -165,6 +165,11 @@ def main(argv=None) -> int:
                           help="also report corpus-wide p50/p95/p99 from the "
                                "per-segment t-digest plane (Mosaic kernel on "
                                "TPU, host build elsewhere)")
+    p_replay.add_argument("--edge-percentiles", action="store_true",
+                          help="also report the slowest call-graph edges by "
+                               "p99 from the PER-EDGE t-digest plane "
+                               "(caller->callee keyed segments; the "
+                               "per-edge featurization view)")
     p_replay.add_argument("--devices", type=int, default=0,
                           help="shard the stream over an N-device 1-D mesh "
                                "(shard_map + psum merge over ICI) instead of "
@@ -726,7 +731,8 @@ def main(argv=None) -> int:
                          "chip; the sharded path uses 'xla' or 'pallas'")
         # a pure-host run (numpy engine, no mesh, no digest plane) touches
         # no jax — don't pay the backend probe for it
-        if args.kernel != "numpy" or args.devices or args.percentiles:
+        if args.kernel != "numpy" or args.devices or args.percentiles \
+                or args.edge_percentiles:
             _probe_backend(args)
         from anomod import labels, synth
         from anomod.replay import ReplayConfig, measure_throughput
@@ -765,6 +771,22 @@ def main(argv=None) -> int:
                 name: round(float(np.expm1(tdigest_quantile(corpus, q))), 1)
                 for name, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99))
             } if float(d.weight.sum()) > 0 else {}
+        if args.edge_percentiles:
+            import numpy as np
+
+            from anomod.replay import replay_edge_percentiles
+            pct, table = replay_edge_percentiles(batch, cfg)
+            W = cfg.n_windows
+            # per-edge p99 = worst window's p99 with traffic; rank the
+            # cross edges (self-edges are the node view)
+            p99 = np.nan_to_num(pct[:, -1].reshape(len(table), W))
+            worst = p99.max(axis=1)
+            rows = sorted(
+                ((float(worst[i]), a, b) for i, (a, b) in enumerate(table)
+                 if a != b and worst[i] > 0), reverse=True)
+            out["edge_p99_us_top"] = [
+                {"edge": f"{batch.services[a]}->{batch.services[b]}",
+                 "p99_us": round(v, 1)} for v, a, b in rows[:5]]
         print(json.dumps(out))
         return 0
 

@@ -306,6 +306,50 @@ def replay_percentiles(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
     return out.astype(np.float32)
 
 
+def edge_keyed_batch(batch: SpanBatch):
+    """Re-key spans to observed call-graph edges: each span maps to the
+    (parent-service, own-service) edge (roots and own-parented spans to
+    the (svc, svc) self-edge).  Returns ``(batch', edge_table)`` where
+    ``batch'.service`` holds dense edge ids and ``edge_table[i]`` is the
+    (caller, callee) service-id pair of edge ``i``.
+
+    Parent resolution uses the batch-global ``parent`` row indices, so
+    this must run on a FULL corpus (anomod.stream.resolve_parent_services
+    has the same contract for the streaming path)."""
+    psvc = batch.service.copy()            # default: self-edge
+    has = batch.parent >= 0
+    psvc[has] = batch.service[batch.parent[has]]
+    pairs = psvc.astype(np.int64) * len(batch.services) + batch.service
+    uniq, inv = np.unique(pairs, return_inverse=True)
+    table = tuple((int(p // len(batch.services)),
+                   int(p % len(batch.services))) for p in uniq.tolist())
+    return batch._replace(service=inv.astype(np.int32)), table
+
+
+def replay_edge_percentiles(batch: SpanBatch,
+                            cfg: Optional[ReplayConfig] = None,
+                            qs: Tuple[float, ...] = (0.5, 0.95, 0.99),
+                            k: int = 64, engine: str = "auto"):
+    """PER-EDGE latency percentiles: the t-digest plane built over
+    (call-graph edge, window) segments instead of (service, window) —
+    the per-edge featurization the BASELINE north star names, through
+    the same Mosaic-kernel dispatch (engine="auto").
+
+    Returns ``(percentiles, edge_table)``: [E*W, len(qs)] float32 µs plus
+    the edge id → (caller, callee) service-id table.  Per-edge p99 is
+    the reporting view that localizes a slow LINK (the callee side of
+    one caller's calls) that per-service percentiles smear across the
+    callee's whole traffic mix."""
+    from anomod.ops.tdigest import tdigest_quantile
+    eb, table = edge_keyed_batch(batch)
+    base = cfg or ReplayConfig(n_services=len(batch.services))
+    cfg_e = dataclasses.replace(base, n_services=len(table))
+    digests = replay_digests(eb, cfg_e, k=k, engine=engine)
+    out = np.stack([np.expm1(tdigest_quantile(digests, q)) for q in qs],
+                   axis=-1)
+    return out.astype(np.float32), table
+
+
 def stage_pallas_planes(chunks, xp=np):
     """Flatten staged chunk columns into the fused pallas kernel's layout:
     sid [N] plus the feature-major [6, N] plane stack (anomod.ops.
