@@ -774,19 +774,24 @@ def main(argv=None) -> int:
         if args.edge_percentiles:
             import numpy as np
 
-            from anomod.replay import replay_edge_percentiles
+            from anomod.replay import (replay_edge_distinct,
+                                       replay_edge_percentiles)
             pct, table = replay_edge_percentiles(batch, cfg)
+            distinct, _ = replay_edge_distinct(batch, cfg)
             W = cfg.n_windows
             # per-edge p99 = worst window's p99 with traffic; rank the
             # cross edges (self-edges are the node view)
             p99 = np.nan_to_num(pct[:, -1].reshape(len(table), W))
             worst = p99.max(axis=1)
             rows = sorted(
-                ((float(worst[i]), a, b) for i, (a, b) in enumerate(table)
+                ((float(worst[i]), i, a, b)
+                 for i, (a, b) in enumerate(table)
                  if a != b and worst[i] > 0), reverse=True)
             out["edge_p99_us_top"] = [
                 {"edge": f"{batch.services[a]}->{batch.services[b]}",
-                 "p99_us": round(v, 1)} for v, a, b in rows[:5]]
+                 "p99_us": round(v, 1),
+                 "distinct_traces": round(float(distinct[i]), 1)}
+                for v, i, a, b in rows[:5]]
         print(json.dumps(out))
         return 0
 

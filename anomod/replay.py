@@ -326,6 +326,28 @@ def edge_keyed_batch(batch: SpanBatch):
     return batch._replace(service=inv.astype(np.int32)), table
 
 
+def replay_edge_distinct(batch: SpanBatch,
+                         cfg: Optional[ReplayConfig] = None):
+    """PER-EDGE distinct-trace counts via the HLL register plane: how many
+    distinct traces cross each observed call-graph edge — the HLL half of
+    the BASELINE's per-edge featurization (the t-digest half is
+    :func:`replay_edge_percentiles`).  Runs the spans re-keyed to dense
+    edge ids through the same jitted chunk step the per-service HLL
+    uses; registers merge by max, so shards/streams combine exactly.
+
+    Returns ``(counts, edge_table)``: float64 [E] HLL estimates plus the
+    edge id → (caller, callee) service-id table."""
+    from anomod.ops.hll import hll_estimate
+    eb, table = edge_keyed_batch(batch)
+    base = cfg or ReplayConfig(n_services=len(batch.services))
+    cfg_e = dataclasses.replace(base, n_services=len(table))
+    chunks, _ = stage_columns(eb, cfg_e)
+    state = make_replay_fn(cfg_e, with_hll=True)(chunks)
+    counts = np.asarray(
+        [hll_estimate(r) for r in np.asarray(state.hll)], np.float64)
+    return counts, table
+
+
 def replay_edge_percentiles(batch: SpanBatch,
                             cfg: Optional[ReplayConfig] = None,
                             qs: Tuple[float, ...] = (0.5, 0.95, 0.99),
