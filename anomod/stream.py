@@ -779,6 +779,19 @@ class OnlineDetector:
                     mod_windows.setdefault(a.service, set()).add(a.window)
             direct_node_ev = {s for s, ws in mod_windows.items()
                               if len(ws) >= 2}
+            # NOTE a known, irreducible single-modality corner: a leaf
+            # callee with no own-parented spans (entry-only service)
+            # shows IDENTICAL span evidence under "node fault in me" and
+            # "link fault from my caller" — its self-edge has no traffic
+            # to stay cool or go hot.  The ranking prefers the CALLER
+            # (link) reading, which wins every edge-locus benchmark and
+            # costs exactly one spans-only cell on SN (the multimodal
+            # planes disambiguate it: node faults degrade the callee's
+            # logs/metrics, link faults cannot — SN multimodal stays
+            # 9/9).  Fan-out-parsimony and self-traffic gating were both
+            # tried and measured WORSE on the edge benchmarks (they
+            # surrender the caller attribution exactly where the link
+            # signal is spread across thin callees).
             hot_children = {c for p in self._edge_hot
                             for c in self._callees_of(p)}
             for c in hot_children:
