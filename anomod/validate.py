@@ -99,6 +99,16 @@ def validate_experiment(exp: Experiment) -> ValidationReport:
             error("traces", f"{orphan} out-of-range parent references")
         if (exp.spans.duration_us < 0).any():
             error("traces", "negative span durations")
+        # parent-resolution rate: the call-graph, edge-attribution, and
+        # per-edge featurization planes all key spans by caller — a
+        # collection whose parentSpanId join mostly failed silently
+        # degrades every edge view to node evidence
+        resolved = float((exp.spans.parent >= 0).mean())
+        counts["parent_resolution_rate"] = round(resolved, 4)
+        if resolved < 0.5:
+            warn("traces", f"only {resolved:.0%} of spans have a resolved "
+                 "parent — edge-keyed planes (stream edge attribution, "
+                 "per-edge percentiles) degrade toward node evidence")
 
     # metrics
     if exp.metrics is None or exp.metrics.n_samples == 0:

@@ -40,3 +40,24 @@ def test_dedup_noop_on_clean_batch():
     b = synth.generate_spans(labels.label_for("Normal_case"), n_traces=20)
     dd = dedup_traces(b)
     assert dd.n_spans == b.n_spans
+
+
+def test_parent_resolution_rate_reported_and_warned():
+    """The report carries the parent-resolution rate (the edge planes'
+    prerequisite) and warns when the parentSpanId join mostly failed."""
+    import numpy as np
+    from anomod import labels, synth
+    from anomod.validate import validate_experiment
+
+    exp = synth.generate_experiment(labels.label_for("Normal_case"),
+                                    n_traces=40)
+    rep = validate_experiment(exp)
+    rate = rep.counts["parent_resolution_rate"]
+    assert 0.5 < rate < 1.0                # roots exist, joins resolve
+    assert not any("resolved parent" in i.message for i in rep.issues)
+    import dataclasses
+    broken = dataclasses.replace(exp, spans=exp.spans._replace(
+        parent=np.full(exp.spans.n_spans, -1, np.int32)))
+    rep2 = validate_experiment(broken)
+    assert rep2.counts["parent_resolution_rate"] == 0.0
+    assert any("resolved parent" in i.message for i in rep2.issues)
