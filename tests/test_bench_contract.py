@@ -45,3 +45,33 @@ def test_bench_cpu_fallback_contract(tmp_path):
                   "jax_version", "device", "kernel", "raw_wall_s"):
         assert field in rec, field
     assert rec["device"] == out["device"]
+
+
+def test_bench_replicate_override_contract(tmp_path):
+    """ANOMOD_BENCH_REPLICATE: a valid override is recorded in
+    replicate_used (on non-CPU platforms it resizes the dispatch; the CPU
+    fallback ignores it — device-sized replication would run for hours on
+    a host core) and a malformed value is rejected with a note instead of
+    burning the capture."""
+    base = dict(os.environ)
+    base["ANOMOD_BENCH_PLATFORM"] = "cpu"
+    base["ANOMOD_BENCH_RUNS_DIR"] = str(tmp_path / "runs")
+
+    env = dict(base, ANOMOD_BENCH_REPLICATE="7")
+    r = subprocess.run(
+        [sys.executable, str(Path(__file__).parent.parent / "bench.py"),
+         "200"], capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, r.stderr[-500:]
+    out = json.loads([l for l in r.stdout.strip().splitlines()
+                      if l.startswith("{")][0])
+    assert out["replicate_used"] == 2      # CPU fallback keeps its sizing
+    assert "replicate_note" not in out
+
+    env = dict(base, ANOMOD_BENCH_REPLICATE="4k")
+    r = subprocess.run(
+        [sys.executable, str(Path(__file__).parent.parent / "bench.py"),
+         "200"], capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, r.stderr[-500:]
+    out = json.loads([l for l in r.stdout.strip().splitlines()
+                      if l.startswith("{")][0])
+    assert out["value"] > 0                # capture survived the bad value
