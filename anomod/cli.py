@@ -230,6 +230,13 @@ def main(argv=None) -> int:
                                "dataset tree (io.dataset loaders; LFS "
                                "stubs -> synth) instead of generating — "
                                "single-experiment mode only")
+    p_stream.add_argument("--no-edge-attribution", action="store_true",
+                          help="disable the out-edge attribution plane "
+                               "(default on): skips the per-push span-batch "
+                               "duplication and the 3x replay-plane rows, "
+                               "restoring pre-edge-plane throughput (and "
+                               "spans_per_sec comparability with those "
+                               "records) at the cost of edge-locus RCA")
 
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
@@ -328,6 +335,8 @@ def main(argv=None) -> int:
             if args.devices:
                 from anomod.parallel import make_mesh
                 mesh_kw["mesh"] = make_mesh(args.devices)
+            if args.no_edge_attribution:
+                mesh_kw["edge_attribution"] = False
             rows = stream_quality(
                 args.testbed, n_traces=args.traces, seed=args.seed,
                 multimodal=args.multimodal,
@@ -367,7 +376,9 @@ def main(argv=None) -> int:
                                 slice_seconds=args.slice_seconds,
                                 threshold=args.threshold,
                                 baseline_windows=args.baseline_windows,
-                                consecutive=args.consecutive),
+                                consecutive=args.consecutive,
+                                edge_attribution=not
+                                args.no_edge_attribution),
                     summary=summary, rows=rows)
                 path = write_capture(rec)
                 if path:
@@ -417,6 +428,8 @@ def main(argv=None) -> int:
         _kw = dict(slice_s=args.slice_seconds, z_threshold=args.threshold,
                    baseline_windows=args.baseline_windows,
                    consecutive=args.consecutive)
+        if args.no_edge_attribution:
+            _kw["edge_attribution"] = False
         if args.devices:
             from anomod.parallel import make_mesh
             _kw["mesh"] = make_mesh(args.devices)
@@ -774,10 +787,8 @@ def main(argv=None) -> int:
         if args.edge_percentiles:
             import numpy as np
 
-            from anomod.replay import (replay_edge_distinct,
-                                       replay_edge_percentiles)
-            pct, table = replay_edge_percentiles(batch, cfg)
-            distinct, _ = replay_edge_distinct(batch, cfg)
+            from anomod.replay import replay_edge_features
+            pct, distinct, table = replay_edge_features(batch, cfg)
             W = cfg.n_windows
             # per-edge p99 = worst window's p99 with traffic; rank the
             # cross edges (self-edges are the node view)

@@ -59,18 +59,28 @@ class ReplayConfig:
         return 1 << self.hll_p
 
 
-def stage_columns(batch: SpanBatch, cfg: ReplayConfig, t0_us: Optional[int] = None):
-    """Host-side packing: SpanBatch -> padded int32/float32 chunk arrays."""
+def segment_ids(batch: SpanBatch, cfg: ReplayConfig,
+                t0_us: Optional[int] = None) -> np.ndarray:
+    """[n] int32 (service, window) segment id per span — the ONE definition
+    of the replay's segment binning, shared by :func:`stage_columns` and
+    lightweight consumers (e.g. bench.py's f32-exactness replicate clamp)
+    that need segment occupancy without paying the full staging pass."""
     n = batch.n_spans
     t0 = int(batch.start_us.min()) if t0_us is None and n else (t0_us or 0)
     window = np.minimum((batch.start_us - t0) // cfg.window_us,
                         cfg.n_windows - 1).astype(np.int32)
     window = np.maximum(window, 0)
+    return batch.service.astype(np.int32) * cfg.n_windows + window
+
+
+def stage_columns(batch: SpanBatch, cfg: ReplayConfig, t0_us: Optional[int] = None):
+    """Host-side packing: SpanBatch -> padded int32/float32 chunk arrays."""
+    n = batch.n_spans
     pad = (-n) % cfg.chunk_size
     def p(a, fill=0):
         return np.pad(a, (0, pad), constant_values=fill)
     cols = dict(
-        sid=p(batch.service.astype(np.int32) * cfg.n_windows + window,
+        sid=p(segment_ids(batch, cfg, t0_us),
               fill=cfg.sw),  # padding rows target a dead segment
         dur=p(np.log1p(batch.duration_us.astype(np.float32))),
         dur_raw=p(batch.duration_us.astype(np.float32)),
@@ -260,21 +270,12 @@ def _resolve_tdigest_engine(engine: str) -> str:
     return engine
 
 
-def replay_digests(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
-                   k: int = 64, engine: str = "auto"):
-    """The per-(service, window) t-digest plane over the exact segments the
-    replay aggregates: [S*W, K] log1p-µs digests (TDigest NamedTuple,
-    host-resident numpy arrays — one device transfer regardless of how many
-    quantiles are queried afterwards).
-
-    This is the featurization entry the BASELINE mandates a Pallas kernel
-    for: on a TPU backend (engine="auto") the build runs through the
-    Mosaic kernel (anomod.ops.pallas_tdigest); elsewhere the numpy build.
-    Digests are built in log1p domain — service latencies are heavy-tailed
-    and linear-domain centroids smear the p99 tail."""
+def _digests_from_staged(chunks, cfg: ReplayConfig, k: int, engine: str):
+    """Per-segment t-digest plane from already-staged chunk columns — the
+    one engine dispatch shared by every digest entry so a caller that
+    already paid ``stage_columns`` (e.g. the combined per-edge reporting
+    pass) never re-stages for the digest plane."""
     from anomod.ops.tdigest import TDigest
-    cfg = cfg or ReplayConfig(n_services=len(batch.services))
-    chunks, _ = stage_columns(batch, cfg)
     sid = chunks["sid"].reshape(-1)
     dur = chunks["dur"].reshape(-1)       # log1p(duration_us), staged
     real = sid < cfg.sw
@@ -287,6 +288,23 @@ def replay_digests(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
         digests = tdigest_by_segment(dur[real], sid[real], cfg.sw, k=k)
     return TDigest(mean=np.asarray(digests.mean),
                    weight=np.asarray(digests.weight))
+
+
+def replay_digests(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
+                   k: int = 64, engine: str = "auto"):
+    """The per-(service, window) t-digest plane over the exact segments the
+    replay aggregates: [S*W, K] log1p-µs digests (TDigest NamedTuple,
+    host-resident numpy arrays — one device transfer regardless of how many
+    quantiles are queried afterwards).
+
+    This is the featurization entry the BASELINE mandates a Pallas kernel
+    for: on a TPU backend (engine="auto") the build runs through the
+    Mosaic kernel (anomod.ops.pallas_tdigest); elsewhere the numpy build.
+    Digests are built in log1p domain — service latencies are heavy-tailed
+    and linear-domain centroids smear the p99 tail."""
+    cfg = cfg or ReplayConfig(n_services=len(batch.services))
+    chunks, _ = stage_columns(batch, cfg)
+    return _digests_from_staged(chunks, cfg, k, engine)
 
 
 def replay_percentiles(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
@@ -326,6 +344,32 @@ def edge_keyed_batch(batch: SpanBatch):
     return batch._replace(service=inv.astype(np.int32)), table
 
 
+def _edge_staged(batch: SpanBatch, cfg: Optional[ReplayConfig]):
+    """One edge re-key + staging pass shared by every per-edge plane."""
+    eb, table = edge_keyed_batch(batch)
+    base = cfg or ReplayConfig(n_services=len(batch.services))
+    cfg_e = dataclasses.replace(base, n_services=len(table))
+    chunks, _ = stage_columns(eb, cfg_e)
+    return chunks, cfg_e, table
+
+
+def _edge_distinct_from_staged(chunks, cfg_e: ReplayConfig):
+    from anomod.ops.hll import hll_estimate
+    state = make_replay_fn(cfg_e, with_hll=True)(chunks)
+    return np.asarray(
+        [hll_estimate(r) for r in np.asarray(state.hll)], np.float64)
+
+
+def _edge_percentiles_from_staged(chunks, cfg_e: ReplayConfig,
+                                  qs: Tuple[float, ...], k: int,
+                                  engine: str) -> np.ndarray:
+    from anomod.ops.tdigest import tdigest_quantile
+    digests = _digests_from_staged(chunks, cfg_e, k, engine)
+    out = np.stack([np.expm1(tdigest_quantile(digests, q)) for q in qs],
+                   axis=-1)
+    return out.astype(np.float32)
+
+
 def replay_edge_distinct(batch: SpanBatch,
                          cfg: Optional[ReplayConfig] = None):
     """PER-EDGE distinct-trace counts via the HLL register plane: how many
@@ -337,15 +381,8 @@ def replay_edge_distinct(batch: SpanBatch,
 
     Returns ``(counts, edge_table)``: float64 [E] HLL estimates plus the
     edge id → (caller, callee) service-id table."""
-    from anomod.ops.hll import hll_estimate
-    eb, table = edge_keyed_batch(batch)
-    base = cfg or ReplayConfig(n_services=len(batch.services))
-    cfg_e = dataclasses.replace(base, n_services=len(table))
-    chunks, _ = stage_columns(eb, cfg_e)
-    state = make_replay_fn(cfg_e, with_hll=True)(chunks)
-    counts = np.asarray(
-        [hll_estimate(r) for r in np.asarray(state.hll)], np.float64)
-    return counts, table
+    chunks, cfg_e, table = _edge_staged(batch, cfg)
+    return _edge_distinct_from_staged(chunks, cfg_e), table
 
 
 def replay_edge_percentiles(batch: SpanBatch,
@@ -362,14 +399,26 @@ def replay_edge_percentiles(batch: SpanBatch,
     the reporting view that localizes a slow LINK (the callee side of
     one caller's calls) that per-service percentiles smear across the
     callee's whole traffic mix."""
-    from anomod.ops.tdigest import tdigest_quantile
-    eb, table = edge_keyed_batch(batch)
-    base = cfg or ReplayConfig(n_services=len(batch.services))
-    cfg_e = dataclasses.replace(base, n_services=len(table))
-    digests = replay_digests(eb, cfg_e, k=k, engine=engine)
-    out = np.stack([np.expm1(tdigest_quantile(digests, q)) for q in qs],
-                   axis=-1)
-    return out.astype(np.float32), table
+    chunks, cfg_e, table = _edge_staged(batch, cfg)
+    return _edge_percentiles_from_staged(chunks, cfg_e, qs, k, engine), table
+
+
+def replay_edge_features(batch: SpanBatch,
+                         cfg: Optional[ReplayConfig] = None,
+                         qs: Tuple[float, ...] = (0.5, 0.95, 0.99),
+                         k: int = 64, engine: str = "auto"):
+    """Both per-edge planes — t-digest percentiles AND HLL distinct-trace
+    counts — from ONE edge re-key + staging pass (the combined reporting
+    view ``anomod replay --edge-percentiles`` serves; running the two
+    single-plane entries back-to-back would re-key, re-stage and re-scan
+    the full corpus twice for the same answer).
+
+    Returns ``(percentiles, counts, edge_table)`` with the same shapes and
+    semantics as :func:`replay_edge_percentiles` /
+    :func:`replay_edge_distinct`."""
+    chunks, cfg_e, table = _edge_staged(batch, cfg)
+    pct = _edge_percentiles_from_staged(chunks, cfg_e, qs, k, engine)
+    return pct, _edge_distinct_from_staged(chunks, cfg_e), table
 
 
 def stage_pallas_planes(chunks, xp=np):

@@ -80,17 +80,32 @@ for i in $(seq 1 200); do
     # On-chip streaming-quality records (multimodal, both testbeds): cheap
     # (~2-4 min each).  SHA-gated, not existence-gated: the streaming
     # detector evolves (edge attribution landed after the last on-chip
-    # captures), so agreement evidence must track the current tree.
+    # captures), so agreement evidence must track the current tree.  The
+    # SHA matches as a PREFIX (no closing quote) because a capture from a
+    # tree with modified tracked files is stamped "<sha>-dirty"; the plain
+    # and edge-locus captures gate independently (a landed plain record
+    # must not retire a failed edge-locus one).
     sha=$(git rev-parse HEAD)
+    has_stream_rec() {  # $1 = testbed, $2 = shift value ("in-dist"/"edge-locus")
+      # each narrowing step checks its own emptiness: a tail command fed an
+      # empty list (xargs -r, grep with no files) exits 0 and would misread
+      # "no record at all" as "record present"
+      local by_tb by_shift
+      by_tb=$(grep -l "\"testbed\": \"$1\"" \
+              bench_runs/*_stream_quality_tpu.json 2>/dev/null)
+      [[ -n "$by_tb" ]] || return 1
+      by_shift=$(grep -l "\"shift\": \"$2\"" $by_tb 2>/dev/null)
+      [[ -n "$by_shift" ]] || return 1
+      grep -l "\"git_sha\": \"$sha" $by_shift >/dev/null 2>&1
+    }
     for tb in TT SN; do
-      if ! grep -l "\"git_sha\": \"$sha\"" \
-          $(grep -l "\"testbed\": \"$tb\"" \
-            bench_runs/*_stream_quality_tpu.json 2>/dev/null /dev/null) \
-          >/dev/null 2>&1; then
+      if ! has_stream_rec "$tb" in-dist; then
         ANOMOD_SKIP_PROBE=1 timeout 900 \
           python -m anomod.cli stream --all --testbed "$tb" --multimodal \
           > "/tmp/tpu_watch_stream_$tb.log" 2>&1
         echo "=== $tb stream rc: $? ==="
+      fi
+      if ! has_stream_rec "$tb" edge-locus; then
         ANOMOD_SKIP_PROBE=1 timeout 900 \
           python -m anomod.cli stream --all --testbed "$tb" --multimodal \
           --severity 0.3 --noise 0.5 --confounders 2 --shift edge-locus \

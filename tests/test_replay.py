@@ -436,6 +436,24 @@ def test_edge_percentiles_match_numpy_oracle():
     assert hot > 3 * cool
 
 
+def test_edge_features_single_pass_matches_single_plane_entries():
+    """The combined reporting entry (one re-key + staging pass) returns
+    bit-identical planes to the two single-plane entries run separately —
+    the CLI's --edge-percentiles view must not drift from them."""
+    from anomod import labels, synth
+    from anomod.replay import (replay_edge_distinct, replay_edge_features,
+                               replay_edge_percentiles)
+
+    batch = synth.generate_spans(labels.label_for("Normal_case"),
+                                 n_traces=120, seed=3)
+    pct, counts, table = replay_edge_features(batch)
+    pct1, table1 = replay_edge_percentiles(batch)
+    counts1, table2 = replay_edge_distinct(batch)
+    assert table == table1 == table2
+    np.testing.assert_array_equal(pct, pct1)
+    np.testing.assert_array_equal(counts, counts1)
+
+
 def test_edge_distinct_traces_match_exact():
     """Per-edge HLL distinct-trace counts track the exact per-edge trace
     cardinality within sketch error (p=8: exact-ish at small counts via
