@@ -92,6 +92,34 @@ def main(argv=None) -> int:
     p_camp.add_argument("--traces", type=int, default=200)
     p_camp.add_argument("--experiments", nargs="*", default=None)
 
+    p_coll = sub.add_parser(
+        "collect", help="live-transport collection: pull from a running "
+        "Prometheus / Jaeger / SkyWalking / Elasticsearch endpoint and "
+        "write loader-compatible artifacts (anomod.io.live)")
+    p_coll.add_argument("kind", choices=["prometheus", "jaeger",
+                                         "skywalking", "es"])
+    p_coll.add_argument("--url", required=True,
+                        help="base URL (prometheus/jaeger/es) or the "
+                             "GraphQL endpoint (skywalking)")
+    p_coll.add_argument("--out", required=True,
+                        help="output dir (prometheus) or artifact file "
+                             "path (jaeger/skywalking/es)")
+    p_coll.add_argument("--testbed", choices=["SN", "TT"], default="SN",
+                        help="prometheus only: SN = per-query CSV dir from "
+                             "the SN catalog; TT = one long CSV from the "
+                             "TT catalog")
+    p_coll.add_argument("--hours-back", type=float, default=1.0)
+    p_coll.add_argument("--step", default="15s",
+                        help="prometheus query_range step")
+    p_coll.add_argument("--limit", type=int, default=1000,
+                        help="jaeger: traces per service; skywalking: "
+                             "total trace budget; es: segment budget")
+    p_coll.add_argument("--experiment", default="live",
+                        help="skywalking: experiment name stamped into "
+                             "the artifact metadata")
+    p_coll.add_argument("--timeout", type=float, default=30.0)
+    p_coll.add_argument("--retries", type=int, default=3)
+
     p_val = sub.add_parser("validate", help="data-quality validation report "
                            "over a corpus (reference-style embedded checks)")
     p_val.add_argument("--testbed", choices=["SN", "TT"], default="TT")
@@ -573,6 +601,43 @@ def main(argv=None) -> int:
         if failover:
             out["device_failover"] = failover
         print(json.dumps(out))
+        return 0
+
+    if args.cmd == "collect":
+        import time as _time
+
+        from anomod.io.live import (ElasticsearchClient, HttpTransport,
+                                    JaegerClient, PrometheusClient,
+                                    SkyWalkingClient)
+        tp = HttpTransport(timeout=args.timeout, max_retries=args.retries)
+        now = _time.time()
+        start = now - args.hours_back * 3600.0
+        if args.kind == "prometheus":
+            client = PrometheusClient(args.url, transport=tp)
+            if args.testbed == "SN":
+                # catalog names double as identity queries against a stub
+                # or relabeling proxy; a real deployment maps names to the
+                # recorded PromQL (collect_metric.sh's query table)
+                from anomod.metrics_catalog import SN_METRIC_FILES
+                rep = client.collect_sn({n: n for n in SN_METRIC_FILES},
+                                        args.out, start, now,
+                                        step=args.step)
+            else:
+                from anomod.metrics_catalog import TT_ALL_QUERIES
+                rep = client.collect_tt(TT_ALL_QUERIES, args.out,
+                                        start, now, step=args.step)
+        elif args.kind == "jaeger":
+            rep = JaegerClient(args.url, transport=tp).collect_all(
+                args.out, limit=args.limit,
+                lookback_ms=int(args.hours_back * 3_600_000))
+        elif args.kind == "skywalking":
+            rep = SkyWalkingClient(args.url, transport=tp).collect(
+                args.out, experiment=args.experiment, limit=args.limit,
+                hours_back=args.hours_back)
+        else:
+            rep = ElasticsearchClient(args.url, transport=tp).collect(
+                args.out, size=args.limit, hours_back=args.hours_back)
+        print(json.dumps(rep.to_json()))
         return 0
 
     if args.cmd == "validate":

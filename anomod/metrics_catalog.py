@@ -175,6 +175,14 @@ TT_SPECIFIC_METRICS: Tuple[str, ...] = _dedup(
 TT_ALL_METRIC_NAMES: Tuple[str, ...] = _dedup(
     (*TT_METRIC_NAMES, *TT_SPECIFIC_METRICS))
 
+#: Deduped RAW query strings (rate() wrappers and selectors intact) across
+#: the level groups + kube-state — what a live collection actually sends to
+#: Prometheus (metric_collector.py:421-425 iterates these, and each row's
+#: ``metric_name`` is the raw query).
+TT_ALL_QUERIES: Tuple[str, ...] = _dedup(
+    (*(e for group in TT_METRIC_CATEGORIES.values() for e in group),
+     *TT_SPECIFIC_QUERIES))
+
 # Per-service (per-pod/container) TT families — carry per-service series.
 TT_PER_SERVICE_METRICS: Tuple[str, ...] = (
     "container_cpu_usage_seconds_total", "container_memory_usage_bytes",

@@ -1,0 +1,464 @@
+"""Live-transport adapter tests: every client driven against an in-process
+stub HTTP server replaying reference-shaped payloads, and every written
+artifact round-tripped through the matching offline loader.
+
+This is the wire-level contract the reference exercises against real infra
+(Prometheus / Jaeger / SkyWalking OAP / Elasticsearch); the stubs make it a
+CI property: client -> artifact -> loader == directly-loaded truth.
+"""
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from anomod import labels, synth
+from anomod.io.live import (CollectReport, ElasticsearchClient,
+                            HttpTransport, JaegerClient, PrometheusClient,
+                            SkyWalkingClient, TransportError)
+
+
+class JsonStub:
+    """Minimal threaded JSON-over-HTTP stub: ``route(method, path, params,
+    body) -> (status, doc)``; records every request for assertions."""
+
+    def __init__(self, route):
+        stub = self
+        stub.requests = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def _serve(self, method):
+                parsed = urllib.parse.urlparse(self.path)
+                params = {k: v[0] for k, v in
+                          urllib.parse.parse_qs(parsed.query).items()}
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length)) if length else None
+                stub.requests.append((method, parsed.path, params, body))
+                status, doc = route(method, parsed.path, params, body)
+                payload = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._serve("GET")
+
+            def do_POST(self):
+                self._serve("POST")
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.base_url = f"http://127.0.0.1:{self.server.server_port}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def stub_factory():
+    stubs = []
+
+    def make(route):
+        s = JsonStub(route)
+        stubs.append(s)
+        return s
+
+    yield make
+    for s in stubs:
+        s.close()
+
+
+def _fast_transport():
+    """No real sleeping in tests; the recorded schedule is asserted."""
+    slept = []
+    return HttpTransport(timeout=5.0, sleep=slept.append), slept
+
+
+# ---------------------------------------------------------------------------
+# Transport retry/backoff
+# ---------------------------------------------------------------------------
+
+def test_transport_retries_with_reference_backoff(stub_factory):
+    """First attempt 500s, second succeeds; the wait is the reference's
+    min(3*attempt, 10) schedule (trace_collector.py:279-291)."""
+    calls = {"n": 0}
+
+    def route(method, path, params, body):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return 500, {"err": "boom"}
+        return 200, {"ok": True}
+
+    stub = stub_factory(route)
+    tp, slept = _fast_transport()
+    assert tp.request_json(stub.base_url + "/x") == {"ok": True}
+    assert slept == [3.0]
+
+
+def test_transport_exhausts_and_raises(stub_factory):
+    stub = stub_factory(lambda *a: (500, {}))
+    tp, slept = _fast_transport()
+    with pytest.raises(TransportError):
+        tp.request_json(stub.base_url + "/x")
+    assert slept == [3.0, 6.0]          # attempts 1 and 2; 3rd raises
+    assert len(stub.requests) == 3
+
+
+# ---------------------------------------------------------------------------
+# Prometheus
+# ---------------------------------------------------------------------------
+
+def _prom_payload(series):
+    """Reference-shaped query_range success doc."""
+    return {"status": "success",
+            "data": {"resultType": "matrix",
+                     "result": [{"metric": labels_, "values": values}
+                                for labels_, values in series]}}
+
+
+def test_prometheus_sn_csv_roundtrips_through_loader(stub_factory, tmp_path):
+    """collect_sn -> per-query CSVs -> load_sn_metric_dir recovers values,
+    labels, and pod->service normalization."""
+    t0 = 1_700_000_000
+
+    def route(method, path, params, body):
+        assert path == "/api/v1/query_range"
+        assert {"query", "start", "end", "step"} <= set(params)
+        if params["query"] == "microservice_request_rate":
+            return 200, _prom_payload([
+                ({"service": "nginx-web-server", "job": "prom"},
+                 [[t0 + 15 * i, str(1.5 + i)] for i in range(4)]),
+                ({"service": "compose-post-service", "job": "prom"},
+                 [[t0 + 15 * i, str(9.0 + i)] for i in range(4)]),
+            ])
+        if params["query"] == "system_cpu_usage":
+            return 200, _prom_payload([
+                ({"instance": "node0"}, [[t0, "0.93"]]),
+            ])
+        return 200, {"status": "success", "data": {"result": []}}
+
+    stub = stub_factory(route)
+    tp, _ = _fast_transport()
+    client = PrometheusClient(stub.base_url, transport=tp)
+    rep = client.collect_sn(
+        {"microservice_request_rate": "microservice_request_rate",
+         "system_cpu_usage": "system_cpu_usage",
+         "redis_memory_used": "redis_memory_used"},
+        tmp_path, t0, t0 + 60)
+    assert isinstance(rep, CollectReport)
+    assert rep.n_skipped == 1                     # empty query -> no file
+    assert sorted(p.split("/")[-1] for p in rep.files) == \
+        ["microservice_request_rate.csv", "system_cpu_usage.csv"]
+
+    from anomod.io.metrics import load_sn_metric_dir
+    mb = load_sn_metric_dir(tmp_path)
+    assert mb is not None
+    assert set(mb.metric_names) == {"microservice_request_rate",
+                                    "system_cpu_usage"}
+    assert mb.n_samples == 9
+    # label columns survive: the request-rate series resolve to services
+    assert {"nginx-web-server", "compose-post-service"} <= set(mb.services)
+    mi = mb.metric_names.index("microservice_request_rate")
+    sel = mb.metric == mi
+    assert sel.sum() == 8
+    assert np.isclose(sorted(mb.value[sel])[-1], 12.0)   # 9.0 + 3
+
+
+def test_prometheus_error_status_raises(stub_factory):
+    stub = stub_factory(lambda *a: (200, {"status": "error",
+                                          "error": "bad query"}))
+    tp, _ = _fast_transport()
+    with pytest.raises(TransportError, match="bad query"):
+        PrometheusClient(stub.base_url, transport=tp).query_range(
+            "x", 0, 1)
+
+
+def test_prometheus_tt_long_csv_roundtrips(stub_factory, tmp_path):
+    """collect_tt -> one long CSV (raw query as metric_name, label columns
+    spread, __name__ dropped) -> load_tt_metric_csv."""
+    t0 = 1_700_000_000
+
+    def route(method, path, params, body):
+        if params["query"] == "rate(node_cpu_seconds_total[5m])":
+            return 200, _prom_payload([
+                ({"__name__": "node_cpu_seconds_total", "pod": "ts-order-service-7f9b5"},
+                 [[t0, "0.4"], [t0 + 15, "0.5"]]),
+            ])
+        if params["query"] == "up":
+            return 200, _prom_payload([
+                ({"pod": "ts-travel-service-x1y2z"}, [[t0, "1"]]),
+            ])
+        return 200, {"status": "success", "data": {"result": []}}
+
+    stub = stub_factory(route)
+    tp, _ = _fast_transport()
+    out = tmp_path / "exp_metrics_1.csv"
+    rep = PrometheusClient(stub.base_url, transport=tp).collect_tt(
+        ["rate(node_cpu_seconds_total[5m])", "up", "node_load5"],
+        out, t0, t0 + 60)
+    assert rep.n_records == 3 and rep.n_skipped == 1
+
+    header = out.read_text().splitlines()[0].split(",")
+    assert header[:4] == ["metric_name", "timestamp", "datetime", "value"]
+    assert "__name__" not in header and "pod" in header
+
+    from anomod.io.metrics import load_tt_metric_csv
+    mb = load_tt_metric_csv(out)
+    assert mb is not None and mb.n_samples == 3
+    assert "rate(node_cpu_seconds_total[5m])" in mb.metric_names
+    # pod label -> normalized service names
+    assert {"ts-order-service", "ts-travel-service"} <= set(mb.services)
+
+
+# ---------------------------------------------------------------------------
+# Jaeger
+# ---------------------------------------------------------------------------
+
+def _jaeger_stub_route(doc):
+    """Serve /api/services + per-service /api/traces from one Jaeger doc,
+    overlapping across services so dedup is exercised."""
+    svc_names = sorted({p["serviceName"] for tr in doc["data"]
+                        for p in tr["processes"].values()})
+
+    def route(method, path, params, body):
+        if path == "/api/services":
+            return 200, {"data": svc_names}
+        if path == "/api/traces":
+            svc = params["service"]
+            assert "limit" in params and "lookback" in params
+            data = [tr for tr in doc["data"]
+                    if any(p["serviceName"] == svc
+                           for p in tr["processes"].values())]
+            return 200, {"data": data}
+        return 404, {}
+
+    return route
+
+
+def test_jaeger_collect_all_dedups_and_roundtrips(stub_factory, tmp_path):
+    from anomod.io.sn_traces import load_jaeger_json, spans_from_jaeger
+
+    batch = synth.generate_spans(labels.label_for("Perf_CPU_Contention"),
+                                 n_traces=25, seed=7)
+    doc = synth.spans_to_jaeger_json(batch)
+    stub = stub_factory(_jaeger_stub_route(doc))
+    tp, _ = _fast_transport()
+    out = tmp_path / "all_traces.json"
+    rep = JaegerClient(stub.base_url, transport=tp).collect_all(out)
+    # every trace fetched exactly once despite appearing under many services
+    assert rep.n_records == len(doc["data"])
+    assert rep.n_skipped > 0                      # overlap existed
+
+    got = load_jaeger_json(out)
+    truth = spans_from_jaeger(doc)
+    assert got.n_spans == truth.n_spans
+    assert sorted(got.services) == sorted(truth.services)
+    assert int(got.is_error.sum()) == int(truth.is_error.sum())
+    assert int(got.duration_us.sum()) == int(truth.duration_us.sum())
+    # per-trace span counts keyed by trace id (order-independent)
+    def per_trace(b):
+        return {b.trace_ids[t]: int((b.trace == t).sum())
+                for t in range(len(b.trace_ids))}
+    assert per_trace(got) == per_trace(truth)
+
+
+# ---------------------------------------------------------------------------
+# SkyWalking GraphQL
+# ---------------------------------------------------------------------------
+
+def _artifact_to_graphql(artifact):
+    """Invert the collector artifact into raw OAP GraphQL responses: the
+    summaries the trace-list query returns and the span dicts the
+    trace-detail query returns."""
+    summaries, spans_by_tid = [], {}
+    for t in artifact["traces"]:
+        summaries.append({"traceIds": [t["trace_id"]],
+                          "duration": t["summary"]["duration"],
+                          "start": 0,
+                          "isError": t["summary"]["is_error"],
+                          "endpointNames": []})
+        spans_by_tid[t["trace_id"]] = [{
+            "traceId": sp["trace_id"], "segmentId": sp["segment_id"],
+            "spanId": sp["span_id"], "parentSpanId": sp["parent_span_id"],
+            "serviceCode": sp["service_code"],
+            "startTime": sp["start_timestamp_ms"],
+            "endTime": sp["end_timestamp_ms"],
+            "endpointName": sp["endpoint_name"], "type": sp["type"],
+            "peer": sp["peer"], "component": sp["component"],
+            "isError": sp["is_error"], "layer": sp["layer"],
+            "tags": sp["tags"], "refs": sp["refs"],
+        } for sp in t["spans"]]
+    return summaries, spans_by_tid
+
+
+def _sw_stub_route(summaries, spans_by_tid):
+    def route(method, path, params, body):
+        q = body["query"]
+        if "queryBasicTraces" in q:
+            paging = body["variables"]["condition"]["paging"]
+            n, size = paging["pageNum"], paging["pageSize"]
+            page = summaries[(n - 1) * size:n * size]
+            return 200, {"data": {"data": {"total": len(summaries),
+                                           "traces": page}}}
+        if "queryTrace" in q:
+            tid = body["variables"]["traceId"]
+            return 200, {"data": {"trace":
+                                  {"spans": spans_by_tid.get(tid, [])}}}
+        return 400, {"errors": [{"message": "unknown query"}]}
+
+    return route
+
+
+def test_skywalking_paginated_collect_matches_direct_artifact(
+        stub_factory, tmp_path):
+    """Full client path — paginated summaries, per-trace detail, artifact
+    build — produces a SpanBatch IDENTICAL to loading the directly-emitted
+    collector artifact."""
+    from anomod.io.tt_traces import load_skywalking_json, spans_from_skywalking
+
+    batch = synth.generate_spans(labels.label_for("Lv_D_TRANSACTION_timeout"),
+                                 n_traces=9, seed=3)
+    artifact = synth.spans_to_skywalking_json(batch, "Lv_D_TRANSACTION_timeout")
+    summaries, spans_by_tid = _artifact_to_graphql(artifact)
+    # a duplicate summary entry exercises traceID dedup
+    summaries.append(summaries[0])
+    stub = stub_factory(_sw_stub_route(summaries, spans_by_tid))
+    tp, _ = _fast_transport()
+    out = tmp_path / "live_skywalking_traces.json"
+    rep = SkyWalkingClient(stub.base_url + "/graphql",
+                           transport=tp).collect(
+        out, experiment="Lv_D_TRANSACTION_timeout", limit=1000,
+        hours_back=1.0, page_size=4, now_s=1_700_000_000.0)
+    assert rep.n_records == batch.n_spans
+
+    # pagination actually happened: ceil((9+1)/4) = 3 list pages
+    list_calls = [r for r in stub.requests
+                  if r[3] and "queryBasicTraces" in r[3]["query"]]
+    assert len(list_calls) == 3
+
+    got = load_skywalking_json(out)
+    truth = spans_from_skywalking(artifact)
+    assert got.n_spans == truth.n_spans
+    for f in ("trace", "parent", "service", "endpoint", "start_us",
+              "duration_us", "is_error", "status", "kind"):
+        np.testing.assert_array_equal(getattr(got, f), getattr(truth, f),
+                                      err_msg=f)
+    assert got.services == truth.services
+    assert got.trace_ids == truth.trace_ids
+    # parent graph survived the wire: same resolution rate, same edges
+    assert int((got.parent >= 0).sum()) == int((truth.parent >= 0).sum())
+
+
+def test_skywalking_graphql_error_payload_raises(stub_factory):
+    stub = stub_factory(lambda *a: (200, {"errors": [{"message": "nope"}]}))
+    tp, _ = _fast_transport()
+    with pytest.raises(TransportError, match="graphql error"):
+        SkyWalkingClient(stub.base_url, transport=tp).trace_spans("t1")
+
+
+# ---------------------------------------------------------------------------
+# Elasticsearch
+# ---------------------------------------------------------------------------
+
+def test_es_segments_roundtrip_through_loader(stub_factory, tmp_path):
+    """Segment search -> detailed_traces artifact -> tt_traces_es loader
+    (base64 service ids decoded by the LOADER, latency in ms -> µs)."""
+    import base64
+
+    from anomod.io.tt_traces_es import load_detailed_traces_json
+
+    def b64(name):
+        return base64.b64encode(name.encode()).decode() + ".1"
+
+    sources = [
+        {"trace_id": "t-1", "segment_id": "seg-a",
+         "service_id": b64("ts-order-service"), "endpoint_name": "/order",
+         "start_time": 1_700_000_000_000, "end_time": 1_700_000_000_120,
+         "latency": 120, "is_error": 0},
+        {"trace_id": "t-1", "segment_id": "seg-b",
+         "service_id": b64("ts-travel-service"), "endpoint_name": "/travel",
+         "start_time": 1_700_000_000_050, "end_time": 1_700_000_000_090,
+         "latency": 40, "is_error": 1},
+        {"trace_id": "t-2", "segment_id": "seg-c",
+         "service_id": b64("ts-order-service"), "endpoint_name": "/order",
+         "start_time": 1_700_000_001_000, "end_time": 1_700_000_001_030,
+         "latency": 30, "is_error": 0},
+    ]
+
+    def route(method, path, params, body):
+        assert method == "POST" and path == "/sw_segment-*/_search"
+        rng = body["query"]["bool"]["must"][0]["range"]["start_time"]
+        assert rng["gte"] < rng["lte"]             # windowed, ms epoch
+        assert body["size"] == 500
+        assert body["sort"] == [{"start_time": {"order": "desc"}}]
+        return 200, {"hits": {"hits": [{"_source": s} for s in sources]}}
+
+    stub = stub_factory(route)
+    tp, _ = _fast_transport()
+    out = tmp_path / "detailed_traces_1.json"
+    rep = ElasticsearchClient(stub.base_url, transport=tp).collect(
+        out, size=500, hours_back=2.0, now_s=1_700_000_100.0)
+    assert rep.n_records == 3
+
+    got = load_detailed_traces_json(out)
+    assert got.n_spans == 3
+    assert set(got.services) == {"ts-order-service", "ts-travel-service"}
+    assert sorted(got.duration_us.tolist()) == [30_000, 40_000, 120_000]
+    assert int(got.is_error.sum()) == 1
+    assert len(got.trace_ids) == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_collect_jaeger(stub_factory, tmp_path, capsys):
+    from anomod.cli import main
+
+    batch = synth.generate_spans(labels.label_for("Perf_CPU_Contention"),
+                                 n_traces=6, seed=1)
+    doc = synth.spans_to_jaeger_json(batch)
+    stub = stub_factory(_jaeger_stub_route(doc))
+    out = tmp_path / "all_traces.json"
+    assert main(["collect", "jaeger", "--url", stub.base_url,
+                 "--out", str(out)]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["kind"] == "jaeger"
+    assert rep["n_records"] == len(doc["data"])
+
+    from anomod.io.sn_traces import load_jaeger_json
+    assert load_jaeger_json(out).n_spans == batch.n_spans
+
+
+def test_cli_collect_prometheus_sn_catalog(stub_factory, tmp_path, capsys):
+    """The CLI sweeps the full SN catalog (24 identity queries against the
+    stub); only families the stub answers produce CSVs."""
+    from anomod.cli import main
+    from anomod.metrics_catalog import SN_METRIC_FILES
+
+    t0 = 1_700_000_000
+
+    def route(method, path, params, body):
+        if params["query"] in ("system_load1", "redis_command_rate"):
+            return 200, _prom_payload([({"instance": "n0"}, [[t0, "2.5"]])])
+        return 200, {"status": "success", "data": {"result": []}}
+
+    stub = stub_factory(route)
+    out = tmp_path / "metric_data"
+    assert main(["collect", "prometheus", "--url", stub.base_url,
+                 "--out", str(out), "--testbed", "SN"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["kind"] == "prometheus_sn"
+    assert rep["n_skipped"] == len(SN_METRIC_FILES) - 2
+    assert len(rep["files"]) == 2
