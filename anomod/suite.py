@@ -113,29 +113,38 @@ def _endpoint_pool(testbed: str) -> List[RequestSpec]:
 
 
 def generate_suite(testbed: str, budget_s: Optional[float] = None,
-                   n_tests: Optional[int] = None, seed: int = 0) -> Suite:
+                   n_tests: Optional[int] = None, seed: int = 0,
+                   spec: Optional[dict] = None) -> Suite:
     """Deterministic suite from the endpoint catalog.
 
     ``budget_s`` mirrors the on-the-fly `--maxTime` generation flow
     (run_experiment.sh:523-535); ``n_tests`` pins the count directly (the
     shipped-suite flow).  Defaults to the testbed's reference budget.
-    """
+
+    ``spec`` switches the endpoint pool to a parsed OpenAPI/Swagger
+    document (anomod.openapi) — the ``--bbSwaggerUrl`` flow: the suite's
+    request surface comes from the spec instead of the internal catalog,
+    with the same budget calibration and run-id stamping."""
     if testbed not in _CALIBRATION:
         raise ValueError(f"unknown testbed: {testbed!r}")
     if budget_s is None and n_tests is None:
         budget_s = _CALIBRATION[testbed][0]
     if n_tests is None:
         n_tests = n_tests_for_budget(testbed, budget_s)
-    pool = _endpoint_pool(testbed)
+    if spec is not None:
+        from anomod.openapi import endpoint_pool_from_spec
+        pool = endpoint_pool_from_spec(spec, seed=seed)
+    else:
+        pool = _endpoint_pool(testbed)
     rng = np.random.default_rng(seed)
     run_id = "em-" + hashlib.sha1(
         f"{testbed}:{n_tests}:{seed}".encode()).hexdigest()[:12]
     tests = []
     for i in range(n_tests):
         # round-robin guarantees pool coverage; rng breaks phase alignment
-        spec = pool[i % len(pool)] if i < len(pool) else \
+        req = pool[i % len(pool)] if i < len(pool) else \
             pool[int(rng.integers(len(pool)))]
-        tests.append(SuiteTest(f"test_{i}", spec))
+        tests.append(SuiteTest(f"test_{i}", req))
     return Suite(testbed, run_id, float(budget_s or 0.0), tuple(tests))
 
 

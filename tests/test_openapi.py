@@ -1,0 +1,141 @@
+"""OpenAPI-spec ingestion: fixture spec -> endpoint catalog -> generated
+suite -> executed against the synthetic gateway (the reference's
+``--bbSwaggerUrl`` regeneration flow, run_experiment.sh:500-555, made
+deterministic and JVM-free)."""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from anomod.openapi import (SpecEndpoint, endpoint_pool_from_spec,
+                            instantiate, load_spec, parse_spec)
+
+FIXTURE = Path(__file__).parent / "fixtures" / "tt_openapi_small.json"
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return load_spec(FIXTURE)
+
+
+def test_parse_spec_flattens_operations(spec):
+    eps = parse_spec(spec)
+    assert len(eps) == 7          # 5 single-op paths + get/delete on order
+    by_key = {(e.method, e.template): e for e in eps}
+    # $ref body schema resolved through #/definitions
+    login = by_key[("POST", "/api/v1/users/login")]
+    assert login.body_schema["properties"]["username"]["type"] == "string"
+    # path-LEVEL shared parameter reaches both operations
+    for m in ("GET", "DELETE"):
+        e = by_key[(m, "/api/v1/orderservice/order/{orderId}")]
+        assert e.path_params == (("orderId", "string"),)
+    # op-level typed path param
+    train = by_key[("GET", "/api/v1/trainservice/trains/{trainId}")]
+    assert train.path_params == (("trainId", "integer"),)
+
+
+def test_instantiate_is_deterministic_and_complete(spec):
+    pool1 = endpoint_pool_from_spec(spec, seed=4)
+    pool2 = endpoint_pool_from_spec(spec, seed=4)
+    assert [(s.method, s.path, s.body) for s in pool1] == \
+        [(s.method, s.path, s.body) for s in pool2]
+    for s in pool1:
+        assert "{" not in s.path and "}" not in s.path
+        if s.body is not None:
+            body = json.loads(s.body)
+            assert isinstance(body, dict) and body
+    # schema types drive the synthesized values
+    preserve = next(s for s in pool1
+                    if s.template == "/api/v1/preserveservice/preserve")
+    body = json.loads(preserve.body)
+    assert isinstance(body["seatType"], int)
+    assert isinstance(body["isWithin"], bool)
+    assert body["date"] == "2025-01-01"
+    assert len(body["accountId"]) == 36          # uuid format
+    # integer path param instantiated as an int literal
+    train = next(s for s in pool1
+                 if s.template == "/api/v1/trainservice/trains/{trainId}")
+    assert train.path.rsplit("/", 1)[-1].isdigit()
+
+
+def test_spec_pool_routes_to_owning_services(spec):
+    pool = endpoint_pool_from_spec(spec, seed=0)
+    svc = {s.template: s.service for s in pool}
+    assert svc["/api/v1/users/login"] == "ts-user-service"
+    assert svc["/api/v1/travelservice/trips/left"] == "ts-travel-service"
+    assert svc["/api/v1/orderservice/order/{orderId}"] == "ts-order-service"
+    assert svc["/api/v1/stationservice/stations"] == "ts-station-service"
+
+
+def test_openapi3_request_body_and_servers():
+    doc = {
+        "openapi": "3.0.1",
+        "paths": {
+            "/api/v1/foodservice/foods/{date}": {
+                "get": {
+                    "parameters": [
+                        {"name": "date", "in": "path", "required": True,
+                         "schema": {"type": "string", "format": "date"}}
+                    ]
+                },
+                "post": {
+                    "requestBody": {"content": {"application/json": {
+                        "schema": {"$ref": "#/components/schemas/FoodOrder"}
+                    }}}
+                }
+            }
+        },
+        "components": {"schemas": {"FoodOrder": {
+            "type": "object",
+            "properties": {"orderId": {"type": "string"},
+                           "price": {"type": "number"}}
+        }}},
+    }
+    eps = {(e.method): e for e in parse_spec(doc)}
+    assert eps["GET"].path_params == (("date", "string"),)
+    assert eps["POST"].body_schema["properties"]["price"]["type"] == "number"
+    rng = np.random.default_rng(0)
+    spec_req = instantiate(doc, eps["POST"], rng)
+    assert isinstance(json.loads(spec_req.body)["price"], float)
+
+
+def test_load_spec_rejects_lfs_stub(tmp_path):
+    stub = tmp_path / "spec.json"
+    stub.write_text("version https://git-lfs.github.com/spec/v1\n"
+                    "oid sha256:abcd\nsize 42\n")
+    with pytest.raises(ValueError, match="LFS pointer"):
+        load_spec(stub)
+
+
+def test_suite_from_spec_runs_against_gateway(spec):
+    """The full round trip: spec -> suite (budget calibration intact) ->
+    run_suite -> api records + caused traces, run-id join working."""
+    from anomod.suite import generate_suite, run_suite, traces_for_run
+
+    suite = generate_suite("TT", n_tests=21, seed=2, spec=spec)
+    assert suite.n_tests == 21
+    # round-robin covers the whole spec surface before sampling
+    ops = {(t.spec.method, t.spec.template) for t in suite.tests[:7]}
+    assert len(ops) == 7
+    run = run_suite(suite, iterations=2, seed=0)
+    assert run.api.n_records == 42
+    assert run.pass_rate > 0.8              # healthy SUT, no chaos
+    assert run.spans.n_spans > run.api.n_records        # caused traces
+    joined = traces_for_run(run.spans, suite.run_id)
+    assert len(joined) == 42                # every request's trace joins
+    # spec-derived entry services appear in the caused spans
+    names = set(np.array(run.spans.services)[
+        np.unique(run.spans.service)].tolist())
+    assert "ts-order-service" in names or "ts-travel-service" in names
+
+
+def test_suite_spec_budget_calibration(spec):
+    """budget -> n_tests stays on the reference calibration line with a
+    spec-derived pool (600 s -> 256 TT tests)."""
+    from anomod.suite import generate_suite
+
+    suite = generate_suite("TT", budget_s=600.0, spec=spec)
+    assert suite.n_tests == 256
+    assert suite.covered_targets == 825
