@@ -120,6 +120,14 @@ def main(argv=None) -> int:
     p_coll.add_argument("--timeout", type=float, default=30.0)
     p_coll.add_argument("--retries", type=int, default=3)
 
+    p_gold = sub.add_parser(
+        "golden", help="golden run over the REAL reference dataset trees: "
+        "loadability census + coverage-modality detection on the non-LFS "
+        "artifacts (anomod.golden)")
+    p_gold.add_argument("--markdown", action="store_true",
+                        help="emit the docs/GOLDEN_REPORT.md body instead "
+                             "of JSON")
+
     p_val = sub.add_parser("validate", help="data-quality validation report "
                            "over a corpus (reference-style embedded checks)")
     p_val.add_argument("--testbed", choices=["SN", "TT"], default="TT")
@@ -638,6 +646,13 @@ def main(argv=None) -> int:
             rep = ElasticsearchClient(args.url, transport=tp).collect(
                 args.out, size=args.limit, hours_back=args.hours_back)
         print(json.dumps(rep.to_json()))
+        return 0
+
+    if args.cmd == "golden":
+        from anomod.golden import format_markdown, golden_report
+        report = golden_report()
+        print(format_markdown(report) if args.markdown
+              else json.dumps(report, indent=1))
         return 0
 
     if args.cmd == "validate":

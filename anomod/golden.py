@@ -1,0 +1,273 @@
+"""Golden run over the REAL reference dataset trees.
+
+The shipped checkout's payloads are mostly git-LFS pointer stubs, but not
+all of it: both coverage trees are real content (SN_data/coverage_data —
+8.5k gcov text files; TT_data/coverage_report — 27.5k JaCoCo xml/html
+artifacts), plus a handful of SN log/metric files.  This module is the
+committed evidence that the loaders and the coverage-modality detector run
+over the ACTUAL dataset, not only its synthetic shadow:
+
+  1. :func:`scan_tree` — the loadability census: per modality, how many
+     files are real vs LFS-stubbed, and which experiments' artifacts the
+     typed loaders actually parse (synth fallback disabled).
+  2. :func:`coverage_signal` — the coverage-modality detector on real
+     data: per-service line-coverage ratios per experiment, |delta| vs the
+     normal-baseline run, culprit ranking — the real-data counterpart of
+     the ``coverage_ratio`` feature in anomod.detect (detect.py:116-124).
+
+``anomod golden`` prints the full report as JSON (``--markdown`` for the
+docs body); docs/GOLDEN_REPORT.md carries the committed run, pinned by
+tests/test_golden.py (which re-runs the scan against /root/reference and
+asserts the stable fields match).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from anomod import labels as labels_mod
+from anomod.config import Config, get_config
+from anomod.io.lfs import is_lfs_pointer
+
+_MODALITY_SUBDIRS = {
+    "SN": ("log_data", "metric_data", "trace_data", "api_responses",
+           "coverage_data"),
+    "TT": ("log_data", "metric_data", "trace_data", "api_responses",
+           "coverage_data", "coverage_report"),
+}
+
+
+def _count_files(base: Path) -> Dict[str, int]:
+    files = [p for p in base.rglob("*") if p.is_file()]
+    stubs = sum(1 for p in files if is_lfs_pointer(p))
+    return {"n_files": len(files), "n_lfs_stubs": stubs,
+            "n_real": len(files) - stubs}
+
+
+def _try_load(testbed: str, modality: str, d: Path):
+    """Attempt the typed load of one experiment-modality dir; None when the
+    artifact is missing/stubbed (synth fallback deliberately NOT taken)."""
+    from anomod.io import api as api_io
+    from anomod.io import coverage as cov_io
+    from anomod.io import logs as logs_io
+    from anomod.io import metrics as met_io
+    from anomod.io import sn_traces, tt_traces
+    if modality == "traces":
+        if testbed == "TT":
+            art = tt_traces.find_trace_artifact(d)
+            return tt_traces.load_skywalking_json(art) if art else None
+        art = sn_traces.find_trace_artifact(d)
+        if art is None:
+            return None
+        return (sn_traces.load_jaeger_json(art) if art.suffix == ".json"
+                else sn_traces.load_jaeger_csv(art))
+    if modality == "metrics":
+        if testbed == "TT":
+            art = met_io.find_tt_metric_artifact(d)
+            return met_io.load_tt_metric_csv(art) if art else None
+        return met_io.load_sn_metric_dir(d)
+    if modality == "logs":
+        loader = (logs_io.load_tt_log_dir if testbed == "TT"
+                  else logs_io.load_sn_log_dir)
+        batch, _ = loader(d)
+        return batch
+    if modality == "api":
+        art = api_io.find_api_artifact(d)
+        return api_io.load_api_jsonl(art) if art else None
+    if modality == "coverage":
+        loader = (cov_io.load_tt_coverage_report if testbed == "TT"
+                  else cov_io.load_sn_coverage_dir)
+        return loader(d)
+    raise ValueError(modality)
+
+
+def _load_coverage_batches(testbed: str, cfg: Config) -> Dict[str, object]:
+    """Load every experiment's real coverage tree ONCE — shared by the
+    census and the detection pass (TT's coverage_report is 27.5k files;
+    parsing it twice per report would double the most expensive I/O)."""
+    from anomod.io import dataset
+    out: Dict[str, object] = {}
+    for ed in dataset.discover(testbed, cfg):
+        if "coverage" not in ed.dirs:
+            continue
+        cb = _try_load(testbed, "coverage", ed.dirs["coverage"])
+        if cb is not None and len(cb.services):
+            out[ed.name] = cb
+    return out
+
+
+def scan_tree(testbed: str, cfg: Optional[Config] = None,
+              coverage_batches: Optional[Dict[str, object]] = None) -> dict:
+    """The loadability census for one testbed's archive tree.
+
+    ``coverage_batches`` (from :func:`_load_coverage_batches`) substitutes
+    for re-parsing the coverage trees when the caller already loaded
+    them."""
+    from anomod.io import dataset
+    cfg = cfg or get_config()
+    root = cfg.sn_data if testbed == "SN" else cfg.tt_data
+    out: dict = {"testbed": testbed, "root": str(root), "modality_files": {},
+                 "experiments": {}}
+    if not root.is_dir():
+        out["missing"] = True
+        return out
+    for sub in _MODALITY_SUBDIRS[testbed]:
+        base = root / sub
+        if base.is_dir():
+            out["modality_files"][sub] = _count_files(base)
+    for ed in sorted(dataset.discover(testbed, cfg), key=lambda e: e.name):
+        row = {}
+        for modality, d in sorted(ed.dirs.items()):
+            if modality == "coverage" and coverage_batches is not None:
+                row[modality] = ("real" if ed.name in coverage_batches
+                                 else "stub")
+                continue
+            try:
+                batch = _try_load(testbed, modality, d)
+            except Exception as e:           # a real but unparseable file
+                row[modality] = f"error: {type(e).__name__}"
+                continue
+            row[modality] = "real" if batch is not None else "stub"
+        out["experiments"][ed.name] = row
+    mods = out["experiments"].values()
+    out["n_experiments"] = len(out["experiments"])
+    out["real_loads"] = {m: sum(1 for r in mods if r.get(m) == "real")
+                         for m in ("traces", "metrics", "logs", "api",
+                                   "coverage")}
+    return out
+
+
+def coverage_signal(testbed: str, cfg: Optional[Config] = None,
+                    batches: Optional[Dict[str, object]] = None) -> dict:
+    """Coverage-modality detection over the REAL coverage artifacts.
+
+    Per fault experiment: per-service |coverage-ratio delta| vs the normal
+    baseline run (services aligned by name), culprit ranking by delta.
+    This is the real-data counterpart of the offline detector's
+    ``coverage_ratio`` feature channel (anomod.detect:116-124, 147-157 —
+    coverage shifts are two-sided: faults both drop covered paths on dead
+    services and light error-handling paths)."""
+    cfg = cfg or get_config()
+    if batches is None:
+        batches = _load_coverage_batches(testbed, cfg)
+    normal_name = next((n for n in batches
+                        if labels_mod.label_for(n) is not None
+                        and not labels_mod.label_for(n).is_anomaly), None)
+    out: dict = {"testbed": testbed, "n_loaded": len(batches),
+                 "normal_baseline": normal_name, "experiments": []}
+    if normal_name is None:
+        return out
+    base = batches[normal_name]
+    base_ratio = dict(zip(base.services, base.service_ratio()))
+    hits1 = hits3 = scored = 0
+    max_delta = 0.0
+    for name, cb in sorted(batches.items()):
+        label = labels_mod.label_for(name)
+        if name == normal_name or label is None:
+            continue
+        ratio = cb.service_ratio()
+        deltas = []
+        for si, svc in enumerate(cb.services):
+            if svc in base_ratio:
+                deltas.append((abs(float(ratio[si] - base_ratio[svc])), svc))
+        deltas.sort(reverse=True)
+        if deltas:
+            max_delta = max(max_delta, deltas[0][0])
+        # a rank is only meaningful where the delta plane is non-zero:
+        # zero-signal experiments must not score, or ties would credit and
+        # deny hits by the sort's alphabetical accident
+        ranked = [svc for d, svc in deltas if d > 1e-9]
+        target = label.target_service
+        row = {"experiment": name, "target": target,
+               "n_services_aligned": len(deltas),
+               "top3": [
+                   {"service": svc, "abs_delta": round(d, 4)}
+                   for d, svc in deltas[:3]]}
+        if not ranked:
+            row["no_signal"] = True
+        if target and ranked:
+            scored += 1
+            row["top1_hit"] = ranked[0] == target
+            row["top3_hit"] = target in ranked[:3]
+            hits1 += row["top1_hit"]
+            hits3 += row["top3_hit"]
+        out["experiments"].append(row)
+    out["scored"] = scored
+    out["top1"] = round(hits1 / scored, 3) if scored else None
+    out["top3"] = round(hits3 / scored, 3) if scored else None
+    # An all-zero delta plane means the ARTIFACTS carry no per-experiment
+    # signal (the shipped TT coverage-summary.txt files are byte-identical
+    # across experiments), not that the detector failed — distinguish the
+    # two in the committed record.
+    out["max_abs_delta"] = round(max_delta, 6)
+    out["signal_present"] = max_delta > 1e-9
+    return out
+
+
+def golden_report(cfg: Optional[Config] = None) -> dict:
+    """The full committed golden run: census + real-data coverage
+    detection for both testbeds (coverage trees parsed once each)."""
+    cfg = cfg or get_config()
+    out: dict = {"scan": {}, "coverage_detection": {}}
+    for tb in ("SN", "TT"):
+        batches = _load_coverage_batches(tb, cfg)
+        out["scan"][tb] = scan_tree(tb, cfg, coverage_batches=batches)
+        out["coverage_detection"][tb] = coverage_signal(tb, cfg,
+                                                        batches=batches)
+    return out
+
+
+def format_markdown(report: dict) -> str:
+    """docs/GOLDEN_REPORT.md body from a report dict."""
+    lines: List[str] = [
+        "# Golden run over the real reference dataset",
+        "",
+        "Generated by `anomod golden` against the shipped checkout "
+        "(`/root/reference`); regenerate with "
+        "`ANOMOD_PLATFORM=cpu anomod golden --markdown`.  Pinned by "
+        "`tests/test_golden.py`.",
+        "",
+        "## Loadability census (typed loaders, synth fallback disabled)",
+        "",
+    ]
+    for tb, scan in report["scan"].items():
+        lines += [f"### {tb}_data", "",
+                  "| modality dir | files | LFS stubs | real |",
+                  "|---|---|---|---|"]
+        for sub, c in scan.get("modality_files", {}).items():
+            lines.append(f"| {sub} | {c['n_files']} | {c['n_lfs_stubs']} "
+                         f"| {c['n_real']} |")
+        rl = scan.get("real_loads", {})
+        lines += ["",
+                  f"{scan.get('n_experiments', 0)} experiments discovered; "
+                  f"real (non-stub) loads per modality: "
+                  + ", ".join(f"{m}={n}" for m, n in rl.items()) + ".", ""]
+    lines += ["## Coverage-modality detection on real artifacts", ""]
+    for tb, cov in report["coverage_detection"].items():
+        lines += [f"### {tb}",
+                  "",
+                  f"- experiments with loadable real coverage: "
+                  f"{cov['n_loaded']}",
+                  f"- normal baseline: `{cov.get('normal_baseline')}`",
+                  f"- culprit ranking by |coverage-ratio delta|: "
+                  f"top-1 {cov.get('top1')}, top-3 {cov.get('top3')} over "
+                  f"{cov.get('scored', 0)} scored faults",
+                  f"- max |delta| anywhere: {cov.get('max_abs_delta')} "
+                  + ("(real per-experiment signal present)"
+                     if cov.get("signal_present") else
+                     "(the shipped artifacts are IDENTICAL across "
+                     "experiments — the modality carries no culprit "
+                     "signal in this dataset, which the synthetic "
+                     "corpus deliberately does not replicate)"), ""]
+        for row in cov.get("experiments", []):
+            t3 = ", ".join(f"{e['service']} ({e['abs_delta']})"
+                           for e in row["top3"])
+            mark = ("no signal (unscored)" if row.get("no_signal")
+                    else "hit" if row.get("top1_hit")
+                    else "top3" if row.get("top3_hit") else "miss")
+            lines.append(f"- `{row['experiment']}` target "
+                         f"`{row['target']}` -> {mark}; largest deltas: "
+                         f"{t3}")
+        lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,89 @@
+"""Pin the committed golden report (docs/GOLDEN_REPORT.md) to the real
+reference checkout: the census numbers and the report's central claims are
+re-derived from the actual trees, so the committed document cannot drift
+from the data it describes.  Skips cleanly when the checkout is absent."""
+
+from pathlib import Path
+
+import pytest
+
+REFERENCE = Path("/root/reference")
+REPORT = Path(__file__).parent.parent / "docs" / "GOLDEN_REPORT.md"
+
+pytestmark = pytest.mark.skipif(
+    not (REFERENCE / "TT_data").is_dir(),
+    reason="reference checkout not mounted")
+
+
+def _cfg():
+    """Pin the data root to the reference checkout the skipif guards —
+    an ANOMOD_DATA_ROOT override must not redirect these assertions."""
+    from anomod.config import Config
+    return Config(data_root=REFERENCE)
+
+
+def test_census_counts_match_committed_report():
+    from anomod.golden import _count_files
+
+    sn_cov = _count_files(REFERENCE / "SN_data" / "coverage_data")
+    tt_cov = _count_files(REFERENCE / "TT_data" / "coverage_report")
+    assert sn_cov == {"n_files": 8544, "n_lfs_stubs": 0, "n_real": 8544}
+    assert tt_cov["n_files"] == 28041
+    # the 533 stubs are exactly the 13 x 41 coverage.xml payloads; every
+    # coverage-summary.txt is real
+    assert tt_cov["n_lfs_stubs"] == 533
+    text = REPORT.read_text()
+    assert "| coverage_data | 8544 | 0 | 8544 |" in text
+    assert "| coverage_report | 28041 | 533 | 27508 |" in text
+
+
+def test_real_coverage_loads_for_all_experiments():
+    """Both coverage trees load through the typed loaders for every one of
+    the 13 experiments (the report's real_loads coverage=13 rows)."""
+    from anomod.golden import _try_load
+    from anomod.io import dataset
+
+    for tb in ("SN", "TT"):
+        eds = dataset.discover(tb, _cfg())
+        assert len(eds) == 13
+        with_cov = [e for e in eds if "coverage" in e.dirs]
+        assert len(with_cov) == 13
+        # one full load per testbed proves the loader path; the golden CLI
+        # run loads all 26 (census pinned above keeps this cheap in CI)
+        cb = _try_load(tb, "coverage", with_cov[0].dirs["coverage"])
+        assert cb is not None and len(cb.services) >= 10
+
+
+def test_tt_real_coverage_is_experiment_invariant():
+    """The committed report's headline TT finding: the shipped
+    coverage-summary artifacts are IDENTICAL across experiments — zero
+    per-experiment signal in the real TT coverage modality."""
+    from anomod.io.coverage import load_tt_coverage_report
+
+    dirs = sorted((REFERENCE / "TT_data" / "coverage_report").iterdir())
+    dirs = [d for d in dirs if d.is_dir()]
+    a = load_tt_coverage_report(dirs[1])
+    b = load_tt_coverage_report(dirs[5])
+    ra = dict(zip(a.services, a.service_ratio()))
+    rb = dict(zip(b.services, b.service_ratio()))
+    assert set(ra) == set(rb) and len(ra) == 41
+    assert all(abs(ra[s] - rb[s]) <= 1e-12 for s in ra)
+    assert "carries no culprit signal" in REPORT.read_text()
+
+
+def test_sn_real_coverage_carries_signal():
+    """SN gcov coverage DOES vary per experiment (max |delta| ~0.089 in
+    the committed run) — the modality is weak but real there."""
+    from anomod.golden import _try_load
+    from anomod.io import dataset
+
+    eds = {e.name: e for e in dataset.discover("SN", _cfg())}
+    normal = _try_load("SN", "coverage",
+                       eds["Normal_Baseline"].dirs["coverage"])
+    fault = _try_load("SN", "coverage",
+                      eds["Code_Stop_TextService"].dirs["coverage"])
+    rn = dict(zip(normal.services, normal.service_ratio()))
+    rf = dict(zip(fault.services, fault.service_ratio()))
+    deltas = [abs(rf[s] - rn[s]) for s in rf if s in rn]
+    assert max(deltas) > 0.05
+    assert "real per-experiment signal present" in REPORT.read_text()
