@@ -1,10 +1,12 @@
 """GNN model zoo for anomaly scoring and root-cause localization."""
 
 from anomod.models.gnn import GCN, GAT, GraphSAGE, normalized_adjacency
+from anomod.models.linegraph import LineGraphRCA
 from anomod.models.temporal import TemporalGCN
 from anomod.models.transformer import TraceTransformer
 from anomod.models.lru import TemporalLRU
 from anomod.models.moe import MoERCA
 
 __all__ = ["GCN", "GAT", "GraphSAGE", "TemporalGCN", "TemporalLRU",
-           "TraceTransformer", "MoERCA", "normalized_adjacency"]
+           "TraceTransformer", "MoERCA", "LineGraphRCA",
+           "normalized_adjacency"]

@@ -176,7 +176,8 @@ def stage_sorted_planes(sid, planes, n_segments, k: int = 128,
 def make_pallas_replay_sorted_fn(n_segments: int, n_hist: int = 16,
                                  k: int = 128, block: int = 4096,
                                  interpret: bool = False,
-                                 inner_repeats: int = 1):
+                                 inner_repeats: int = 1,
+                                 bf16_onehot: bool = False):
     """Sorted-window variant of :func:`make_pallas_replay_fn`:
     ``fn(sid_local[T], planes[6, T], wids[T // block]) -> agg[SW, 6+H]``
     over arrays staged by :func:`stage_sorted_planes`.
@@ -209,8 +210,18 @@ def make_pallas_replay_sorted_fn(n_segments: int, n_hist: int = 16,
         # [6, B] f32 -> shared bf16 rhs build (same split as the unsorted
         # kernel, so the two paths cannot diverge numerically)
         rhs_t = _build_rhs_t(planes_ref[:], block, n_hist)
-        seg_iota = jax.lax.broadcasted_iota(jnp.int32, (block, k), 1)
-        onehot = (seg_iota == sid[:, None]).astype(jnp.bfloat16)  # [B, k]
+        if bf16_onehot:
+            # the one-hot construction is the kernel's VPU bottleneck
+            # (scripts/bench_kernel_roofline.py ablations); window-local
+            # ids are < k <= 128, exactly representable in bf16, and
+            # 16-bit lanes compare at 2x packing — the [B, k] compare
+            # halves its cycle count where the int32 iota cannot
+            seg_iota = jax.lax.broadcasted_iota(jnp.bfloat16, (block, k), 1)
+            onehot = (seg_iota == sid.astype(jnp.bfloat16)[:, None]
+                      ).astype(jnp.bfloat16)                      # [B, k]
+        else:
+            seg_iota = jax.lax.broadcasted_iota(jnp.int32, (block, k), 1)
+            onehot = (seg_iota == sid[:, None]).astype(jnp.bfloat16)
         partial = jax.lax.dot_general(
             rhs_t, onehot, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [ROWS, k]

@@ -82,6 +82,9 @@ def _repad_edges(stacked: Dict[str, np.ndarray], e_max: int) -> None:
         for k in ("edge_src", "edge_dst"):
             stacked[k] = np.pad(stacked[k], pad)
         stacked["edge_mask"] = np.pad(stacked["edge_mask"], pad)
+        if "edge_x" in stacked:
+            stacked["edge_x"] = np.pad(
+                stacked["edge_x"], pad + ((0, 0), (0, 0)))
 
 
 def _train_model(model_name: str, train: Dict[str, np.ndarray],

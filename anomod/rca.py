@@ -31,6 +31,10 @@ class RCASample:
     edge_mask: np.ndarray  # [E_max] bool
     target: int            # culprit service index (-1 if none)
     is_anomaly: bool
+    #: [E_max, W, 4] baseline-relative PER-EDGE temporal features aligned
+    #: with edge_src/edge_dst (built when edge_features=True) — the
+    #: line-graph model's token inputs; None otherwise
+    edge_x: Optional[np.ndarray] = None
 
 
 def _agg_feature_block(batch, services, cfg: ReplayConfig,
@@ -79,6 +83,52 @@ def _windowed_features(batch, services, cfg: ReplayConfig,
     edge_batch = take_spans(batch, cross)._replace(service=psvc[cross])
     edge = _agg_feature_block(edge_batch, services, cfg, t0_us=t0_us)
     return np.concatenate([node, edge], axis=-1)
+
+
+def _edge_feature_block(batch, services, g, cfg: ReplayConfig) -> np.ndarray:
+    """[E, W, 4] windowed aggregates PER call-graph edge of ``g`` —
+    count/err/log-lat/5xx of the spans riding each (caller, callee) edge
+    (child spans keyed by their parent's service, the
+    anomod.replay.edge_keyed_batch convention).  The line-graph model's
+    token features: a link fault lands in exactly one row here, where the
+    per-caller out-edge BLOCK (_windowed_features) sums it with every
+    other callee of the same caller."""
+    svc_index = {s: i for i, s in enumerate(services)}
+    remap = np.array([svc_index.get(s, 0) for s in batch.services] or [0],
+                     np.int32)
+    svc = remap[batch.service]
+    psvc = np.full(batch.n_spans, -1, np.int32)
+    has = batch.parent >= 0
+    psvc[has] = svc[batch.parent[has]]
+    S = len(services)
+    eid_of_pair = {int(a) * S + int(b): i
+                   for i, (a, b) in enumerate(zip(g.edge_src, g.edge_dst))}
+    E = len(eid_of_pair)
+    pair = psvc.astype(np.int64) * S + svc
+    eid = np.array([eid_of_pair.get(int(p), -1) for p in pair], np.int32)
+    keep = (psvc >= 0) & (eid >= 0)
+    if not keep.any() or E == 0:
+        return np.zeros((E, cfg.n_windows, 4), np.float32)
+    from anomod.schemas import take_spans
+    eb = take_spans(batch, keep)._replace(
+        service=eid[keep],
+        services=tuple(f"e{i}" for i in range(E)))
+    cfg_e = dataclasses.replace(cfg, n_services=E)
+    t0_us = int(batch.start_us.min()) if batch.n_spans else 0
+    return _agg_feature_block(eb, eb.services, cfg_e, t0_us=t0_us)
+
+
+def _edge_x_relative(exp_spans, services, g, cfg,
+                     base_edge: Dict[tuple, np.ndarray]) -> np.ndarray:
+    """Baseline-relative per-edge features: the normal run's edge set can
+    differ, so rows align by (src, dst) pair; edges unseen in the
+    baseline keep their raw values (their baseline is zero traffic)."""
+    raw = _edge_feature_block(exp_spans, services, g, cfg)
+    for i, (a, b) in enumerate(zip(g.edge_src, g.edge_dst)):
+        base = base_edge.get((int(a), int(b)))
+        if base is not None:
+            raw[i] = raw[i] - base
+    return raw
 
 
 def _pick_confounders(label, services: Tuple[str, ...], seed: int,
@@ -156,6 +206,12 @@ def build_dataset(testbed: str, seeds: Sequence[int], n_traces: int = 80,
         base_x = detect.extract_features(normal, services).x
         base_t = _windowed_features(normal.spans, services, cfg,
                                     edge_features=edge_features)
+        base_edge: Dict[tuple, np.ndarray] = {}
+        if edge_features:
+            g_n = build_service_graph(normal.spans, services=services)
+            nb = _edge_feature_block(normal.spans, services, g_n, cfg)
+            base_edge = {(int(a), int(b)): nb[i] for i, (a, b) in
+                         enumerate(zip(g_n.edge_src, g_n.edge_dst))}
         for label, exp in experiment_stream(testbed, seed, n_traces=n_traces,
                                             hard=hard,
                                             n_confounders=n_confounders):
@@ -166,20 +222,26 @@ def build_dataset(testbed: str, seeds: Sequence[int], n_traces: int = 80,
             e_max = max(e_max, g.n_edges)
             target = (services.index(label.target_service)
                       if label.target_service in services else -1)
-            raw.append((label.experiment, x, x_t, g, target, label.is_anomaly))
-    for name, x, x_t, g, target, is_anom in raw:
+            ex = (_edge_x_relative(exp.spans, services, g, cfg, base_edge)
+                  if edge_features else None)
+            raw.append((label.experiment, x, x_t, g, target,
+                        label.is_anomaly, ex))
+    for name, x, x_t, g, target, is_anom, ex in raw:
         E = e_max
         src = np.zeros(E, np.int32); dst = np.zeros(E, np.int32)
         mask = np.zeros(E, np.bool_)
         src[:g.n_edges] = g.edge_src; dst[:g.n_edges] = g.edge_dst
         mask[:g.n_edges] = True
+        if ex is not None:
+            ex = np.pad(ex.astype(np.float32),
+                        ((0, E - ex.shape[0]), (0, 0), (0, 0)))
         samples.append(RCASample(name, x.astype(np.float32), x_t, g.adj_counts,
-                                 src, dst, mask, target, is_anom))
+                                 src, dst, mask, target, is_anom, edge_x=ex))
     return samples, services
 
 
 def _stack(samples: List[RCASample]) -> Dict[str, np.ndarray]:
-    return {
+    out = {
         "x": np.stack([s.x for s in samples]),
         "x_t": np.stack([s.x_t for s in samples]),
         "adj": np.stack([s.adj for s in samples]).astype(np.float32),
@@ -189,6 +251,9 @@ def _stack(samples: List[RCASample]) -> Dict[str, np.ndarray]:
         "target": np.array([s.target for s in samples], np.int32),
         "is_anomaly": np.array([s.is_anomaly for s in samples], np.float32),
     }
+    if samples and samples[0].edge_x is not None:
+        out["edge_x"] = np.stack([s.edge_x for s in samples])
+    return out
 
 
 def _apply_model(model_name: str, model, params, batch):
@@ -196,6 +261,16 @@ def _apply_model(model_name: str, model, params, batch):
     if model_name in ("gcn",):
         return jax.vmap(lambda x, a: model.apply(params, x, a))(
             batch["x"], batch["adj"])
+    if model_name == "linegraph":
+        if "edge_x" not in batch:
+            raise ValueError("the linegraph model needs per-edge features "
+                             "(build_dataset(edge_features=True) / quality "
+                             "sweeps with edge_aware)")
+        return jax.vmap(
+            lambda x, xt, ex, s, d, m:
+            model.apply(params, x, xt, ex, s, d, m))(
+            batch["x"], batch["x_t"], batch["edge_x"], batch["edge_src"],
+            batch["edge_dst"], batch["edge_mask"])
     if model_name in ("temporal", "lru", "transformer", "moe"):
         import jax.numpy as jnp
         # fuse static multimodal features (logs etc.) into every window
@@ -214,6 +289,10 @@ def init_params(model_name: str, model, sample0: Dict[str, np.ndarray], rng):
     distributed train steps, and the quality sweep)."""
     if model_name == "gcn":
         return model.init(rng, sample0["x"], sample0["adj"])
+    if model_name == "linegraph":
+        return model.init(rng, sample0["x"], sample0["x_t"],
+                          sample0["edge_x"], sample0["edge_src"],
+                          sample0["edge_dst"], sample0["edge_mask"])
     if model_name in ("temporal", "lru", "transformer", "moe"):
         W = sample0["x_t"].shape[1]
         fused = np.concatenate(
@@ -226,14 +305,18 @@ def init_params(model_name: str, model, sample0: Dict[str, np.ndarray], rng):
 
 def standardize_features(train: Dict[str, np.ndarray],
                          evals: Sequence[Dict[str, np.ndarray]]) -> None:
-    """Standardize x/x_t on train statistics, in place (shared with eval)."""
-    for key in ("x", "x_t"):
+    """Standardize x/x_t (and edge_x when present) on train statistics,
+    in place (shared with eval)."""
+    for key in ("x", "x_t", "edge_x"):
+        if key not in train:
+            continue
         axes = tuple(range(train[key].ndim - 1))  # all but the feature axis
         mu = train[key].mean(axis=axes, keepdims=True)
         sd = train[key].std(axis=axes, keepdims=True) + 1e-6
         train[key] = (train[key] - mu) / sd
         for ev in evals:
-            ev[key] = (ev[key] - mu) / sd
+            if key in ev:
+                ev[key] = (ev[key] - mu) / sd
 
 
 def topk_eval(scores: np.ndarray,
@@ -274,11 +357,13 @@ def rca_loss(scores, batch):
 
 def make_model(model_name: str):
     from anomod.models import GAT, GCN, GraphSAGE, MoERCA, TemporalGCN
+    from anomod.models.linegraph import LineGraphRCA
     from anomod.models.lru import TemporalLRU
     from anomod.models.transformer import TraceTransformer
     return {"gcn": GCN(), "gat": GAT(), "sage": GraphSAGE(),
             "temporal": TemporalGCN(), "lru": TemporalLRU(),
-            "transformer": TraceTransformer(), "moe": MoERCA()}[model_name]
+            "transformer": TraceTransformer(), "moe": MoERCA(),
+            "linegraph": LineGraphRCA()}[model_name]
 
 
 @dataclasses.dataclass

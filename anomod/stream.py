@@ -58,6 +58,7 @@ threshold at ~1 span/window.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import List, Optional, Sequence
 
@@ -128,6 +129,57 @@ def edge_combined_cfg(cfg: ReplayConfig, n_services: int) -> ReplayConfig:
     ``ShardedStreamReplay(edge_combined_cfg(cfg, S), t0, mesh)``) for
     ``OnlineDetector(..., replay=..., edge_attribution=True)``."""
     return dataclasses.replace(cfg, n_services=3 * n_services)
+
+
+def _poisson_lower_tail_z(x: int, lam: float) -> float:
+    """z-equivalent of the lower Poisson tail P(X <= x | lam) — the
+    out-edge DROP channel's statistic: observing ``x`` spans where the
+    baseline rate predicts ``lam`` over the pooled reach.  Exact sum (x is
+    small by construction — the channel only fires on collapses)."""
+    import math
+    if lam <= 0:
+        return 0.0
+    tail = math.exp(-lam) * sum(lam ** k / math.factorial(k)
+                                for k in range(0, int(x) + 1))
+    if tail >= 0.5:
+        return 0.0
+    lo, hi = 0.0, 40.0
+    for _ in range(60):
+        mid = 0.5 * (lo + hi)
+        if 0.5 * math.erfc(mid / math.sqrt(2.0)) > tail:
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+def _binom_tail_z(x: int, n: int, p: float) -> float:
+    """z-equivalent of the upper binomial tail P(X >= x | n, p).
+
+    Exact summation at the small counts the sparse-edge error channel
+    lives in (2 errors in 6 spans is not Gaussian; a normal z there is
+    either fabricated or blind); normal approximation once n*p is large
+    enough for it to be honest.  The tail converts to a z through the
+    standard-normal survival function so one threshold governs every
+    evidence channel."""
+    import math
+    if x <= 0 or n <= 0:
+        return 0.0
+    if n > 60 and n * p > 10.0:
+        return float((x - n * p) / math.sqrt(max(n * p * (1.0 - p), 1e-9)))
+    tail = 0.0
+    for k in range(int(x), int(n) + 1):
+        tail += math.comb(int(n), k) * p ** k * (1.0 - p) ** (int(n) - k)
+    if tail >= 0.5:
+        return 0.0
+    lo, hi = 0.0, 40.0
+    for _ in range(60):                      # bisection on the survival fn
+        mid = 0.5 * (lo + hi)
+        if 0.5 * math.erfc(mid / math.sqrt(2.0)) > tail:
+            lo = mid
+        else:
+            hi = mid
+    return lo
 
 
 def resolve_parent_services(batch: SpanBatch) -> np.ndarray:
@@ -256,7 +308,7 @@ class OnlineDetector:
                  call_edges: Optional[set] = None,
                  replay=None, with_hll: bool = False,
                  edge_attribution: Optional[bool] = None,
-                 edge_pool: int = 8, mesh=None):
+                 edge_pool: int = 12, edge_mass: float = 8.0, mesh=None):
         if baseline_windows < 2:
             raise ValueError("need >= 2 baseline windows for a sigma")
         if baseline_windows >= cfg.n_windows:
@@ -303,7 +355,10 @@ class OnlineDetector:
             else bool(edge_attribution)
         if edge_pool < 1:
             raise ValueError("edge_pool must be >= 1 window")
-        self.edge_pool = edge_pool
+        if edge_mass < 1:
+            raise ValueError("edge_mass must be >= 1 span")
+        self.edge_pool = edge_pool      # max window REACH of the edge pool
+        self.edge_mass = edge_mass      # span-mass target the pool walks to
         if self.edge_attribution:
             K = 3 * S
             cfg = edge_combined_cfg(cfg, S)
@@ -515,7 +570,7 @@ class OnlineDetector:
         var_bl = _between_var(plane[:, :B, F_LOGLAT] / bsafe)
         var_be = _between_var(plane[:, :B, F_ERR] / bsafe)
 
-        return dict(
+        out = dict(
             mu_l=mu_l, var_span=var_span, p_err=p_err, err_var=err_var,
             rate0=rate0, C0=C0,
             var_bl_pool=np.where(var_bl > 0, var_bl, drift_l),
@@ -532,6 +587,79 @@ class OnlineDetector:
             var_bl=var_bl, var_be=var_be,
             sd_cnt=np.sqrt(np.maximum(cnt[:, :B].var(axis=1),
                                       np.maximum(rate0, 1.0))))
+        if self.edge_attribution:
+            out.update(self._calibrate_edges(plane))
+        return out
+
+    def _calibrate_edges(self, plane: np.ndarray) -> dict:
+        """Shrunk baselines for the SPARSE edge rows [S, 3S).
+
+        Edge traffic is a fraction of node traffic, so at realistic
+        densities an edge row's own baseline holds a handful of spans —
+        a raw mean/variance from 1-5 spans is noise, and the old hard
+        ``C0 >= min_count`` gate simply zeroed those rows (the
+        sparse-density edge-locus collapse, docs/BENCHMARKS.md).  Instead
+        every edge row gets an empirical-Bayes baseline: its own stats
+        shrunk toward a borrowed population with prior mass
+        ``tau = 1.2*min_count`` —
+          - SELF-edge rows borrow the same service's NODE row (their
+            spans are a subset of it);
+          - OUT-edge rows borrow the count-weighted pooled baseline of
+            ALL out-edge rows, with the between-row spread of out-edge
+            means priced into the variance (caller populations differ).
+        The error channel gets a fleet null instead of the node plane's
+        +1/+2 Laplace prior (which at C0=3 fabricates a 20% baseline
+        error rate and swallows any real excess): posterior mean under a
+        fleet-rate prior, doubled and floored at 0.5% as a drift-safety
+        margin — scored by exact binomial tail (:func:`_binom_tail_z`),
+        not a normal z, because 2 errors in 6 spans is not Gaussian."""
+        from anomod.replay import F_LOGLAT2
+        B = self.baseline_windows
+        S = self._n_svc
+        tau = 1.2 * self.min_count
+        cnt = plane[..., F_COUNT]
+        c = cnt[S:3 * S, :B].sum(axis=1)             # raw, unclamped
+        s1 = plane[S:3 * S, :B, F_LOGLAT].sum(axis=1)
+        s2 = plane[S:3 * S, :B, F_LOGLAT2].sum(axis=1)
+        csafe = np.maximum(c, 1.0)
+        own_mu = s1 / csafe
+        own_var = np.maximum(s2 / csafe - own_mu ** 2, 1e-4)
+        # borrowed population per row
+        node_mu = np.tile(plane[:S, :B, F_LOGLAT].sum(axis=1)
+                          / np.maximum(cnt[:S, :B].sum(axis=1), 1.0), 2)
+        node_c = np.maximum(cnt[:S, :B].sum(axis=1), 1.0)
+        node_var = np.tile(np.maximum(
+            plane[:S, :B, F_LOGLAT2].sum(axis=1) / node_c
+            - (node_mu[:S]) ** 2, 1e-4), 2)
+        oc = c[S:]                                   # out-edge rows
+        o_tot = max(float(oc.sum()), 1.0)
+        mu_pop_out = float(s1[S:].sum()) / o_tot
+        var_pop_out = max(float(s2[S:].sum()) / o_tot - mu_pop_out ** 2,
+                          1e-4)
+        good = oc >= 4
+        if int(good.sum()) >= 3:
+            between = float(np.average(
+                (own_mu[S:][good] - mu_pop_out) ** 2, weights=oc[good]))
+        else:
+            between = 0.25 * var_pop_out
+        pop_mu = node_mu.copy()
+        pop_var = node_var.copy()
+        pop_mu[S:] = mu_pop_out
+        pop_var[S:] = var_pop_out + between
+        w = c / (c + tau)
+        mu_sh = w * own_mu + (1 - w) * pop_mu
+        var_sh = np.where(c > 1, w * own_var + (1 - w) * pop_var, pop_var)
+        # the borrowed prior is worth tau pseudo-spans of baseline mass in
+        # the two-sample term — bounded confidence from borrowed data
+        c_eff = c + tau
+        # fleet error null (node plane pools every span once)
+        p_fleet = float(plane[:S, :B, F_ERR].sum()
+                        / max(float(cnt[:S, :B].sum()), 1.0))
+        own_e = plane[S:3 * S, :B, F_ERR].sum(axis=1)
+        p_null = np.clip((own_e + 2 * tau * p_fleet) / (c + 2 * tau)
+                         * 2.0 + 0.005, 0.005, 0.5)
+        return dict(edge_mu=mu_sh, edge_var=var_sh, edge_c_eff=c_eff,
+                    edge_p_null=p_null)
 
     def _score_through(self, through: int) -> List[Alert]:
         """Score closed ABSOLUTE windows (scored_through, through]."""
@@ -637,49 +765,111 @@ class OnlineDetector:
                 # caveats would apply per edge with no extra signal.
                 # Edge traffic is a fraction of node traffic (each span
                 # keys to ONE edge), so per-window edge counts sit below
-                # min_count at realistic densities — the edge z therefore
-                # POOLS the last ``edge_pool`` closed windows (same SE /
-                # binomial math on the pooled sums; the between-window
-                # term uses var_*_pool — the regular var_bl where it
-                # exists, else the sparse-row drift estimate — unscaled
-                # by the pool width, conservative).  The fault's sustain
-                # makes the pooled z converge to the per-window z within
-                # edge_pool windows of onset.
+                # min_count at realistic densities — the edge z pools a
+                # VARIABLE-width window: walk back from the current
+                # window until ``edge_mass`` spans accumulate, capped at
+                # ``edge_pool`` windows of reach.  Mass-based pooling is
+                # what fixes the sparse-density collapse the fixed
+                # 8-window pool had: a thin edge reaches further back for
+                # the same evidence mass, a dense one pools narrowly and
+                # is not diluted by healthy windows.
                 P = self.edge_pool
                 plo = max(col - P + 1, 0)
                 seg = plane[S:, plo:col + 1]
-                n_p = seg[..., F_COUNT].sum(axis=1)
-                safe_p = np.maximum(n_p, 1.0)
-                # pooled scoring earns a softer calibration gate than the
-                # per-window node z (min_count baseline spans instead of
-                # 2x): the pooled window widens the evidence side, and
-                # the Laplace error prior + between-window variance terms
-                # already price a thin baseline into the denominator
-                ok_p = (n_p >= self.min_count) & \
-                    (b["C0"][S:] >= self.min_count)
-                # two-sample form: the pooled window can hold MORE spans
-                # than the thin edge baseline, so the baseline mean's own
-                # sampling variance (var/C0) must be priced in — without
-                # it a 5-span baseline against a 40-span pool mints fake
-                # 4-sigma heat from baseline noise alone
-                C0e = np.maximum(b["C0"][S:], 1.0)
-                zl_p = np.where(
-                    ok_p,
-                    (seg[..., F_LOGLAT].sum(axis=1) / safe_p - b["mu_l"][S:])
-                    / np.sqrt(b["var_span"][S:] / safe_p
-                              + b["var_span"][S:] / C0e
-                              + b["var_bl_pool"][S:]),
-                    0.0)
-                ze_p = np.where(
-                    ok_p,
-                    (seg[..., F_ERR].sum(axis=1) / safe_p - b["p_err"][S:])
-                    / np.sqrt(b["err_var"][S:] / safe_p
-                              + b["err_var"][S:] / C0e
-                              + b["var_be_pool"][S:]),
-                    0.0)
+                rev_cnt = seg[..., F_COUNT][:, ::-1]
+                cumc = rev_cnt.cumsum(axis=1)
+                reach = cumc.shape[1]
+                # Two-scale mass pooling, max over scales: the NARROW pool
+                # walks back to ``edge_mass`` spans (a concentrated error
+                # burst or latency spike scores undiluted); the WIDE pool
+                # walks to one baseline-block's worth (C0 ~ B windows of
+                # this row's traffic — the smoothing dense rows need, and
+                # past n_p ~ C0 the baseline term dominates the variance
+                # anyway so wider pooling only dilutes).  A thin row's two
+                # scales coincide at the edge_mass floor.
+                cuml = seg[..., F_LOGLAT][:, ::-1].cumsum(axis=1)
+                cume = seg[..., F_ERR][:, ::-1].cumsum(axis=1)
+                zl_p = np.zeros(2 * S)
+                ze_p = np.zeros(2 * S)
+                for mass in (np.full(2 * S, self.edge_mass),
+                             np.maximum(b["C0"][S:], self.edge_mass)):
+                    m = mass[:, None]
+                    has = cumc[:, -1:] >= m
+                    kidx = np.where(
+                        has, np.argmax(cumc >= m, axis=1, keepdims=True),
+                        reach - 1)
+                    n_p = np.take_along_axis(cumc, kidx, axis=1)[:, 0]
+                    suml = np.take_along_axis(cuml, kidx, axis=1)[:, 0]
+                    sume = np.take_along_axis(cume, kidx, axis=1)[:, 0]
+                    safe_p = np.maximum(n_p, 1.0)
+                    # the shrunk empirical-Bayes baselines
+                    # (_calibrate_edges) replace the old hard
+                    # C0 >= min_count gate: a thin-baseline row scores
+                    # against its borrowed baseline, with the borrow
+                    # priced as tau pseudo-spans in the two-sample term —
+                    # only a minimal evidence mass is still required
+                    ok_p = n_p >= min(3.0, self.edge_mass)
+                    zl_p = np.maximum(zl_p, np.where(
+                        ok_p,
+                        (suml / safe_p - b["edge_mu"])
+                        / np.sqrt(b["edge_var"] / safe_p
+                                  + b["edge_var"] / b["edge_c_eff"]
+                                  + b["var_bl_pool"][S:]),
+                        0.0))
+                    # error channel: exact binomial tail against the
+                    # fleet null — only rows with >= 2 pooled errors can
+                    # score (one stray background error must never be
+                    # 4-sigma evidence)
+                    for ei in np.nonzero(ok_p & (sume >= 2.0))[0]:
+                        ze_p[ei] = max(ze_p[ei], _binom_tail_z(
+                            int(sume[ei]), int(n_p[ei]),
+                            float(b["edge_p_null"][ei])))
+                # The SELF-edge channel is the node-vs-link locus
+                # discriminator: a self-edge falsely hot on borrowed-
+                # baseline noise reads as "node-borne in the callee" and
+                # suppresses the caller's true out-edge attribution.  So
+                # self rows keep the conservative gates (own baseline AND
+                # evidence mass >= min_count) — the borrowed-baseline
+                # liberalization is for OUT-edge attribution only.
+                self_ok = (b["C0"][S:2 * S] >= self.min_count) & \
+                    (n_p[:S] >= self.min_count)
+                zl_p[:S] = np.where(self_ok, zl_p[:S], 0.0)
+                ze_p[:S] = np.where(self_ok, ze_p[:S], 0.0)
                 span_z = np.concatenate(
                     [np.maximum(zl, ze)[:S], np.maximum(zl_p, ze_p)])
+                # Out-edge alerting is two-tier: the pooled scan runs FAR
+                # fewer effective tests than the node plane (one
+                # correlated statistic per row vs S x W independent
+                # windows), which earns a halved-sigma threshold; below
+                # that, a row that UNIQUELY dominates the out-edge plane
+                # by a wide margin is attribution-grade evidence even
+                # sub-threshold (a scan where exactly one of S rows
+                # stands out is a stronger event than one row crossing a
+                # line).  Self-edge heat (the node-vs-link locus
+                # discriminator) stays at the full node threshold —
+                # mis-declaring "node-borne" flips rankings.
                 hot[S:] = span_z[S:] >= self.z_threshold
+                out_z = span_z[2 * S:]
+                if os.environ.get("ANOMOD_EDGE_DEBUG"):
+                    _t = int(out_z.argmax())
+                    print(f"[edge] w{w} top={self.services[_t]} "
+                          f"z={out_z[_t]:.2f} "
+                          f"2nd={float(np.partition(out_z, -2)[-2]):.2f}")
+                hot_hi = out_z >= self.z_threshold - 0.5
+                if out_z.size >= 2:
+                    top = int(out_z.argmax())
+                    second = float(np.partition(out_z, -2)[-2])
+                    # the dominance tier exists for rows whose baseline is
+                    # STRUCTURALLY too thin to support the hi threshold; a
+                    # well-calibrated dense row (C0 >= 4*min_count) that
+                    # cannot reach hi is not signal-limited — letting it
+                    # through would alert normal baselines on weak flukes
+                    if (out_z[top] >= self.z_threshold - 1.5
+                            and out_z[top] >= 1.2 * max(second, 1e-9)
+                            and b["C0"][2 * S + top]
+                            < 4.0 * self.min_count):
+                        hot_hi[top] = True
+                hot[2 * S:] |= hot_hi
             self._streak = np.where(hot, self._streak + 1, 0)
             for s in np.nonzero(self._streak[:S] >= self.consecutive)[0]:
                 out.append(Alert(window=w, service=int(s),
@@ -839,7 +1029,13 @@ class OnlineDetector:
         if edge_dom:
             # an edge-dominant caller yields only to NODE-borne anomalies
             # downstream (hot self-edge or direct modality evidence — a
-            # real culprit living deeper), not to its own blast radius
+            # real culprit living deeper), not to its own blast radius.
+            # (A direct-callee-only variant was measured in round 4: it
+            # keeps sparse edge culprits from being explained away by
+            # unrelated downstream decoys, but costs the same number of
+            # in-dist cells where a blast-heated caller must yield to a
+            # node culprit whose self-edge is underpowered — net zero on
+            # top1, so the general walk stays.)
             node_borne = {s for s in anomalous
                           if self._self_hot[s] or s in direct_node_ev}
             strict = _explained_by_downstream(

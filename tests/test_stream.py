@@ -496,6 +496,37 @@ def test_edge_locus_fault_attributed_to_caller():
     assert fw is not None and 10 <= fw <= 10 + det.edge_pool
 
 
+def test_edge_locus_attribution_survives_sparse_density():
+    """The sparse-density fix (mass-based two-scale pooling + shrunk
+    empirical-Bayes edge baselines + exact-binomial error tail): at the
+    offline sweep's knobs (60 traces, severity 0.3, noise 0.5) an
+    edge-locus fault whose out-edge baseline holds only a handful of
+    spans must still be attributed to the caller — the old fixed-width
+    pool with the hard C0 gate scored these rows 0 (docs/BENCHMARKS.md's
+    0.17 collapse)."""
+    label = labels.label_for("Lv_C_travel_detail_failure")
+    hard = synth.HardMode(severity=0.3, noise=0.5, fault_locus="edge")
+    exp = synth.generate_spans(label, n_traces=60, seed=0, hard=hard)
+    det = stream_experiment(exp)
+    edge_alerts = [a for a in det.alerts if a.evidence == "edge"]
+    assert any(a.service_name == label.target_service
+               for a in edge_alerts), \
+        [(a.service_name, a.evidence) for a in det.alerts]
+    assert det.ranked_services()[0] == label.target_service
+
+
+def test_sparse_normal_has_no_edge_alerts():
+    """The liberalized sparse-edge path (borrowed baselines, dominance
+    tier) must not buy its sensitivity with normal-baseline false
+    alerts: a healthy sparse stream produces ZERO edge-evidence
+    alerts."""
+    label = labels.label_for("Normal_case")
+    hard = synth.HardMode(severity=0.3, noise=0.5)
+    exp = synth.generate_spans(label, n_traces=60, seed=0, hard=hard)
+    det = stream_experiment(exp)
+    assert not [a for a in det.alerts if a.evidence == "edge"]
+
+
 def test_node_fault_not_misattributed_to_caller():
     """Under a NODE fault the culprit's self-edge goes hot, so the
     callee-self-hot guard must suppress out-edge blame on its callers:
