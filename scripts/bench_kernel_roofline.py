@@ -154,12 +154,14 @@ def main() -> int:
         "params": dict(k=k, block=block, replicate=replicate,
                        n_spans=n, device=str(jax.devices()[0])),
     }
+    # device must be TOP-LEVEL: write_capture names the file by the
+    # record's "device" field (…_tpu.json), and tpu_watch.sh's retire
+    # gate globs exactly that name
     rec = capture_record("replay_kernel_roofline", verdict["value"],
-                         "spans/sec/chip", **{kk: vv for kk, vv in
-                                              verdict.items()
-                                              if kk not in ("metric",
-                                                            "value",
-                                                            "unit")})
+                         "spans/sec/chip",
+                         device=str(jax.devices()[0]),
+                         **{kk: vv for kk, vv in verdict.items()
+                            if kk not in ("metric", "value", "unit")})
     path = write_capture(rec)
     verdict["capture_file"] = str(path)
     print(json.dumps(verdict))
