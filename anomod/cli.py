@@ -199,8 +199,10 @@ def main(argv=None) -> int:
                                "host core; single-chip only)")
     p_replay.add_argument("--percentiles", action="store_true",
                           help="also report corpus-wide p50/p95/p99 from the "
-                               "per-segment t-digest plane (Mosaic kernel on "
-                               "TPU, host build elsewhere)")
+                               "per-segment t-digest plane (XLA build on "
+                               "TPU, host build elsewhere; "
+                               "ANOMOD_TDIGEST_ENGINE=pallas opts into the "
+                               "Mosaic kernel)")
     p_replay.add_argument("--edge-percentiles", action="store_true",
                           help="also report the slowest call-graph edges by "
                                "p99 from the PER-EDGE t-digest plane "

@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -252,10 +252,18 @@ def percentile_from_hist(hist: np.ndarray, q: float,
 
 
 def _resolve_tdigest_engine(engine: str) -> str:
-    """Normalize the digest-engine selector: "host" (numpy build), "pallas"
-    (Mosaic MXU kernel; interpret mode off-TPU), or "auto" — env override
-    ``ANOMOD_TDIGEST_ENGINE`` first, else the kernel iff the default JAX
-    backend is a TPU.  Auto initializes the backend to look at it; callers
+    """Normalize the digest-engine selector: "host" (numpy build), "xla"
+    (jitted one-hot build over the same staged lanes), "pallas" (Mosaic
+    MXU kernel; interpret mode off-TPU), or "auto" — env override
+    ``ANOMOD_TDIGEST_ENGINE`` first, else "xla" iff the default JAX
+    backend is a TPU, "host" elsewhere.
+
+    The Mosaic kernel is OPT-IN only (``ANOMOD_TDIGEST_ENGINE=pallas``):
+    the committed on-chip rematches show it does not beat the XLA build at
+    either production regime — 0.956x at the replay-plane shape (1M values
+    / 2976 segments) and 0.971x at long skewed lanes (2M / 256 segments,
+    L=8064), bench_runs/20260731T011001Z + T011102Z — so auto must not
+    route through it.  Auto initializes the backend to look at it; callers
     that must stay host-only in an unknown device environment pass
     engine="host"."""
     engine = (engine or "auto").strip().lower()
@@ -264,10 +272,32 @@ def _resolve_tdigest_engine(engine: str) -> str:
             "ANOMOD_TDIGEST_ENGINE", "").strip().lower() or "auto"
     if engine == "auto":
         import jax
-        engine = "pallas" if jax.default_backend() == "tpu" else "host"
-    if engine not in ("host", "pallas"):
+        engine = "xla" if jax.default_backend() == "tpu" else "host"
+    if engine not in ("host", "xla", "pallas"):
         raise ValueError(f"unknown t-digest engine {engine!r}")
     return engine
+
+
+@lru_cache(maxsize=None)
+def _xla_tdigest_build(k: int):
+    """One jitted XLA digest build per centroid count (compile-cached)."""
+    import jax
+    import jax.numpy as jnp
+
+    from anomod.ops.tdigest import tdigest_build
+    return jax.jit(lambda p, w: tdigest_build(p, k=k, weights=w, xp=jnp))
+
+
+def _tdigest_by_segment_xla(values, segment_ids, n_segments: int, k: int):
+    """Per-segment digests through the jitted XLA one-hot build — the TPU
+    auto default.  Host :func:`segment_pad` staging with the kernel path's
+    exact lane layout (pad_to=128), so switching engines changes only the
+    build, never the staged lanes."""
+    from anomod.ops.tdigest import segment_pad
+    padded, weights = segment_pad(np.asarray(values, np.float32),
+                                  np.asarray(segment_ids), n_segments,
+                                  pad_to=128)
+    return _xla_tdigest_build(k)(padded, weights)
 
 
 def _digests_from_staged(chunks, cfg: ReplayConfig, k: int, engine: str):
@@ -283,6 +313,8 @@ def _digests_from_staged(chunks, cfg: ReplayConfig, k: int, engine: str):
     if engine == "pallas":
         from anomod.ops.pallas_tdigest import tdigest_by_segment_pallas
         digests = tdigest_by_segment_pallas(dur[real], sid[real], cfg.sw, k=k)
+    elif engine == "xla":
+        digests = _tdigest_by_segment_xla(dur[real], sid[real], cfg.sw, k=k)
     else:
         from anomod.ops.tdigest import tdigest_by_segment
         digests = tdigest_by_segment(dur[real], sid[real], cfg.sw, k=k)
@@ -297,9 +329,12 @@ def replay_digests(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
     host-resident numpy arrays — one device transfer regardless of how many
     quantiles are queried afterwards).
 
-    This is the featurization entry the BASELINE mandates a Pallas kernel
-    for: on a TPU backend (engine="auto") the build runs through the
-    Mosaic kernel (anomod.ops.pallas_tdigest); elsewhere the numpy build.
+    This is the featurization entry the BASELINE names: on a TPU backend
+    (engine="auto") the build runs through the jitted XLA one-hot build;
+    elsewhere the numpy build.  The Mosaic kernel
+    (anomod.ops.pallas_tdigest) remains available as
+    ``ANOMOD_TDIGEST_ENGINE=pallas`` but measured no faster than XLA at
+    production shapes (see _resolve_tdigest_engine).
     Digests are built in log1p domain — service latencies are heavy-tailed
     and linear-domain centroids smear the p99 tail."""
     cfg = cfg or ReplayConfig(n_services=len(batch.services))
@@ -392,7 +427,7 @@ def replay_edge_percentiles(batch: SpanBatch,
     """PER-EDGE latency percentiles: the t-digest plane built over
     (call-graph edge, window) segments instead of (service, window) —
     the per-edge featurization the BASELINE north star names, through
-    the same Mosaic-kernel dispatch (engine="auto").
+    the same engine dispatch (engine="auto": XLA build on TPU).
 
     Returns ``(percentiles, edge_table)``: [E*W, len(qs)] float32 µs plus
     the edge id → (caller, callee) service-id table.  Per-edge p99 is

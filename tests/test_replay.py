@@ -240,15 +240,20 @@ def test_replay_percentiles_tdigest_plane(tt_batch):
 
 
 def test_replay_percentiles_pallas_engine_matches_host(tt_batch):
-    """The production featurization wiring: engine='pallas' (Mosaic kernel,
-    interpret path on the CPU mesh) must reproduce the host digest plane,
-    and engine='auto' must resolve to host off-TPU."""
+    """Engine parity across the digest builds: the TPU auto default
+    (engine='xla') and the opt-in Mosaic kernel (engine='pallas',
+    interpret path on the CPU mesh) must both reproduce the host digest
+    plane, and engine='auto' must resolve to host off-TPU."""
     import pytest
     from anomod.replay import replay_percentiles
     cfg = ReplayConfig(n_services=tt_batch.n_services, chunk_size=2048)
     host = replay_percentiles(tt_batch, cfg, qs=(0.5, 0.99), engine="host")
     auto = replay_percentiles(tt_batch, cfg, qs=(0.5, 0.99), engine="auto")
     np.testing.assert_array_equal(auto, host)
+    # the TPU auto default (jitted XLA one-hot build) must reproduce the
+    # host plane from the identical staged lanes
+    xla = replay_percentiles(tt_batch, cfg, qs=(0.5, 0.99), engine="xla")
+    np.testing.assert_allclose(xla, host, rtol=2e-3, atol=1e-2)
     pal = replay_percentiles(tt_batch, cfg, qs=(0.5, 0.99), engine="pallas")
     # identical staging + identical bucket math; only kernel-vs-numpy float
     # ordering differs (lane padding slots carry weight 0)

@@ -1,9 +1,13 @@
 """Compiled t-digest featurization path: parity + micro-bench vs the jax
-build (round-2 verdict item 2 — the kernel must run in the production call
-path, with a measured advantage trail).
+build.  The measured trail (0.956x at the replay-plane shape, 0.971x at
+long skewed lanes) demoted the Mosaic kernel to opt-in
+(``ANOMOD_TDIGEST_ENGINE=pallas``); these tests keep the parity contract
+and re-capture the rematch records on every watcher revival so a tree
+that changes the verdict carries a committed record saying so.
 
-Writes a ``tdigest_featurize_micro`` provenance record with the median
-walls of both engines so the docs table can cite a committed artifact.
+Writes ``tdigest_featurize_micro`` / ``_large_lanes`` provenance records
+with the median walls of both engines so the docs table can cite a
+committed artifact.
 """
 
 import time
@@ -24,13 +28,17 @@ def _median_wall(fn, *args, repeats=5):
     return sorted(walls)[len(walls) // 2], walls
 
 
-def test_replay_percentiles_auto_uses_kernel_on_tpu():
-    """engine='auto' must route through the Mosaic kernel on a TPU backend
-    and agree with the host digest plane."""
+def test_replay_percentiles_engines_on_tpu():
+    """engine='auto' resolves to the XLA build on a TPU backend (the Mosaic
+    kernel measured 0.956x/0.971x vs XLA at both production regimes — see
+    _resolve_tdigest_engine — so it is opt-in only); both the auto/XLA
+    plane and the opt-in kernel plane must agree with the host digests."""
     from anomod import labels, synth
-    from anomod.replay import ReplayConfig, replay_percentiles
+    from anomod.replay import (ReplayConfig, _resolve_tdigest_engine,
+                               replay_percentiles)
     from anomod.schemas import concat_span_batches
 
+    assert _resolve_tdigest_engine("auto") == "xla"
     batch = concat_span_batches([
         synth.generate_spans(l, n_traces=40)
         for l in labels.labels_for_testbed("TT")[:4]])
@@ -38,6 +46,8 @@ def test_replay_percentiles_auto_uses_kernel_on_tpu():
     auto = replay_percentiles(batch, cfg, qs=(0.5, 0.99))
     host = replay_percentiles(batch, cfg, qs=(0.5, 0.99), engine="host")
     np.testing.assert_allclose(auto, host, rtol=2e-3, atol=1e-2)
+    pal = replay_percentiles(batch, cfg, qs=(0.5, 0.99), engine="pallas")
+    np.testing.assert_allclose(pal, host, rtol=2e-3, atol=1e-2)
     nonzero = host[:, 0] > 0
     assert nonzero.any()
     assert (auto[nonzero, 1] >= auto[nonzero, 0]).all()
@@ -95,9 +105,10 @@ def _featurize_micro(n, S, lane_rng_seed, metric, floor):
 
 def test_tdigest_featurize_microbench_kernel_vs_jax():
     """Production-sized digest plane (one TT replay plane: 93 services x
-    32 windows, ~336 values/lane).  The kernel must at least match the XLA
-    path (its reason to exist is deleting the [R, L, K] broadcast the XLA
-    build materializes)."""
+    32 windows, ~336 values/lane).  Rematch record: the committed result
+    (0.956x) is why auto no longer selects the kernel; the floor only
+    guards against the opt-in kernel regressing far below XLA (>20%
+    slower), not a win claim."""
     _featurize_micro(n=1_000_000, S=2976, lane_rng_seed=5,
                      metric="tdigest_featurize_micro", floor=1.2)
 
@@ -105,7 +116,7 @@ def test_tdigest_featurize_microbench_kernel_vs_jax():
 def test_tdigest_featurize_large_lanes():
     """Skewed plane: few segments with long lanes (L_max ~8k), where the
     XLA build's [R, L, K] intermediate is largest relative to useful work
-    — the regime the kernel's docs claim as its win; the committed record
-    carries the measured ratio either way."""
+    — the regime the kernel was designed to win, where it still measured
+    0.971x; the committed record carries the ratio either way."""
     _featurize_micro(n=2_000_000, S=256, lane_rng_seed=6,
                      metric="tdigest_featurize_large_lanes", floor=1.2)
