@@ -39,7 +39,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from datetime import datetime
+from datetime import datetime, timezone
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -75,7 +75,25 @@ class HttpTransport:
                         headers={"Content-Type": "application/json"})
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
                     return json.loads(r.read().decode())
-            except Exception as e:  # HTTP errors, timeouts, bad JSON
+            except urllib.error.HTTPError as e:
+                if 400 <= e.code < 500:
+                    # client errors (bad PromQL, malformed GraphQL) are
+                    # permanent: retrying burns the whole backoff schedule
+                    # and buries the real error class.  The body carries
+                    # the server's actual diagnostic (e.g. the PromQL
+                    # parse error) — surface it, truncated.
+                    try:
+                        body = e.read().decode(errors="replace")[:500]
+                    except Exception:
+                        body = ""
+                    raise TransportError(
+                        f"request to {url.split('?')[0]} rejected: "
+                        f"HTTP {e.code} {e.reason}"
+                        + (f": {body}" if body else "")) from e
+                last = e          # 5xx: server-side, worth retrying
+                if attempt < self.max_retries:
+                    self.sleep(min(3.0 * attempt, 10.0))
+            except Exception as e:  # timeouts, connection errors, bad JSON
                 last = e
                 if attempt < self.max_retries:
                     self.sleep(min(3.0 * attempt, 10.0))
@@ -153,8 +171,10 @@ class PrometheusClient:
             w = csv.writer(f)
             w.writerow(["timestamp", "value", "metric"] + label_cols)
             for ts, val, labels in rows:
-                stamp = datetime.fromtimestamp(ts).strftime(
-                    "%Y-%m-%d %H:%M:%S")
+                # UTC, not local: artifacts from collectors in different
+                # timezones must be byte-comparable for the same data
+                stamp = datetime.fromtimestamp(
+                    ts, tz=timezone.utc).strftime("%Y-%m-%d %H:%M:%S")
                 lab = ",".join(f'{k}="{v}"'
                                for k, v in sorted(labels.items()))
                 w.writerow([stamp, val, lab]
@@ -198,7 +218,8 @@ class PrometheusClient:
                 continue
             for ts, val, labels in rows:
                 row = {"metric_name": query, "timestamp": ts,
-                       "datetime": datetime.fromtimestamp(ts).isoformat(),
+                       "datetime": datetime.fromtimestamp(
+                           ts, tz=timezone.utc).isoformat(),
                        "value": val}
                 row.update({k: v for k, v in labels.items()
                             if k != "__name__"})
@@ -328,16 +349,26 @@ class SkyWalkingClient:
         """Paginated ``queryBasicTraces`` sweep -> summary dicts, deduped
         by first traceId; stops on a short page or at ``limit``.  The
         query window is minute-grained under 12 h lookback, hour-grained
-        beyond (the reference's step selection)."""
-        page_size = max(1, min(page_size, limit if limit > 0 else page_size))
+        beyond (the reference's step selection).  ``limit`` must be >= 1:
+        there is no unlimited mode (a server that always returns full
+        pages would otherwise paginate forever)."""
+        if limit < 1:
+            raise ValueError(f"limit must be >= 1, got {limit}")
+        page_size = max(1, min(page_size, limit))
         now = time.time() if now_s is None else now_s
         start = now - max(hours_back, 0.1) * 3600.0
         step = "MINUTE" if hours_back <= 12 else "HOUR"
         fmt = "%Y-%m-%d %H%M" if step == "MINUTE" else "%Y-%m-%d %H"
         condition_base = {
+            # queryDuration strings are rendered in UTC: the OAP server
+            # interprets them in its own timezone, so a deterministic
+            # rendering (rather than the collector host's local TZ) is the
+            # only choice that makes the same call reproducible everywhere
             "queryDuration": {
-                "start": datetime.fromtimestamp(start).strftime(fmt),
-                "end": datetime.fromtimestamp(now).strftime(fmt),
+                "start": datetime.fromtimestamp(
+                    start, tz=timezone.utc).strftime(fmt),
+                "end": datetime.fromtimestamp(
+                    now, tz=timezone.utc).strftime(fmt),
                 "step": step,
             },
             "traceState": "ALL",
@@ -347,25 +378,32 @@ class SkyWalkingClient:
         out: List[dict] = []
         seen: set = set()
         page = 1
-        while not (limit and len(out) >= limit):
+        while len(out) < limit:
             condition = dict(condition_base,
                              paging={"pageNum": page, "pageSize": page_size})
             data = self._post(_SW_TRACE_LIST, {"condition": condition})
             traces = (data.get("data") or {}).get("traces") or []
             if not traces:
                 break
+            new_here = 0
             for entry in traces:
                 tids = entry.get("traceIds") or []
                 if not tids or tids[0] in seen:
                     continue
                 seen.add(tids[0])
+                new_here += 1
                 out.append(dict(entry, traceIds=tids))
-                if limit and len(out) >= limit:
+                if len(out) >= limit:
                     break
             if len(traces) < page_size:
                 break
+            if new_here == 0:
+                # a full page of already-seen traces means the server is
+                # not honoring pageNum (or the window is being re-served);
+                # without this break such a server paginates forever
+                break
             page += 1
-        return out[:limit] if limit else out
+        return out[:limit]
 
     def trace_spans(self, trace_id: str) -> List[dict]:
         data = self._post(_SW_TRACE_DETAIL, {"traceId": trace_id})

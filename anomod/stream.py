@@ -131,28 +131,6 @@ def edge_combined_cfg(cfg: ReplayConfig, n_services: int) -> ReplayConfig:
     return dataclasses.replace(cfg, n_services=3 * n_services)
 
 
-def _poisson_lower_tail_z(x: int, lam: float) -> float:
-    """z-equivalent of the lower Poisson tail P(X <= x | lam) — the
-    out-edge DROP channel's statistic: observing ``x`` spans where the
-    baseline rate predicts ``lam`` over the pooled reach.  Exact sum (x is
-    small by construction — the channel only fires on collapses)."""
-    import math
-    if lam <= 0:
-        return 0.0
-    tail = math.exp(-lam) * sum(lam ** k / math.factorial(k)
-                                for k in range(0, int(x) + 1))
-    if tail >= 0.5:
-        return 0.0
-    lo, hi = 0.0, 40.0
-    for _ in range(60):
-        mid = 0.5 * (lo + hi)
-        if 0.5 * math.erfc(mid / math.sqrt(2.0)) > tail:
-            lo = mid
-        else:
-            hi = mid
-    return lo
-
-
 def _binom_tail_z(x: int, n: int, p: float) -> float:
     """z-equivalent of the upper binomial tail P(X >= x | n, p).
 
@@ -791,8 +769,12 @@ class OnlineDetector:
                 cume = seg[..., F_ERR][:, ::-1].cumsum(axis=1)
                 zl_p = np.zeros(2 * S)
                 ze_p = np.zeros(2 * S)
-                for mass in (np.full(2 * S, self.edge_mass),
-                             np.maximum(b["C0"][S:], self.edge_mass)):
+                scales = (np.full(2 * S, self.edge_mass),
+                          np.maximum(b["C0"][S:], self.edge_mass))
+                n_p_wide = np.zeros(2 * S)  # wide-scale pooled counts,
+                # captured explicitly for the self_ok gate below (must not
+                # depend on which scale the loop happens to end on)
+                for mass in scales:
                     m = mass[:, None]
                     has = cumc[:, -1:] >= m
                     kidx = np.where(
@@ -824,6 +806,8 @@ class OnlineDetector:
                         ze_p[ei] = max(ze_p[ei], _binom_tail_z(
                             int(sume[ei]), int(n_p[ei]),
                             float(b["edge_p_null"][ei])))
+                    if mass is scales[1]:
+                        n_p_wide = n_p
                 # The SELF-edge channel is the node-vs-link locus
                 # discriminator: a self-edge falsely hot on borrowed-
                 # baseline noise reads as "node-borne in the callee" and
@@ -832,7 +816,7 @@ class OnlineDetector:
                 # evidence mass >= min_count) — the borrowed-baseline
                 # liberalization is for OUT-edge attribution only.
                 self_ok = (b["C0"][S:2 * S] >= self.min_count) & \
-                    (n_p[:S] >= self.min_count)
+                    (n_p_wide[:S] >= self.min_count)
                 zl_p[:S] = np.where(self_ok, zl_p[:S], 0.0)
                 ze_p[:S] = np.where(self_ok, ze_p[:S], 0.0)
                 span_z = np.concatenate(

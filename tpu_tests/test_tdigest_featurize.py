@@ -33,11 +33,16 @@ def test_replay_percentiles_engines_on_tpu():
     kernel measured 0.956x/0.971x vs XLA at both production regimes — see
     _resolve_tdigest_engine — so it is opt-in only); both the auto/XLA
     plane and the opt-in kernel plane must agree with the host digests."""
+    import os
+
     from anomod import labels, synth
     from anomod.replay import (ReplayConfig, _resolve_tdigest_engine,
                                replay_percentiles)
     from anomod.schemas import concat_span_batches
 
+    # the resolution assert tests the DEFAULT: an operator's opt-in
+    # ANOMOD_TDIGEST_ENGINE export must not redefine what "auto" means here
+    os.environ.pop("ANOMOD_TDIGEST_ENGINE", None)
     assert _resolve_tdigest_engine("auto") == "xla"
     batch = concat_span_batches([
         synth.generate_spans(l, n_traces=40)
