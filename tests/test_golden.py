@@ -71,6 +71,40 @@ def test_tt_real_coverage_is_experiment_invariant():
     assert "carries no culprit signal" in REPORT.read_text()
 
 
+def test_sn_log_detection_matches_committed_report():
+    """The committed log-modality result: 6 scored faults, kills hit 3/3
+    through the unique-mover volume channel, Code_Stop misses 3/3 to the
+    propagation sink (ComposePostService logs the errors one hop
+    downstream of the stopped service)."""
+    from anomod.golden import log_signal
+
+    r = log_signal("SN", _cfg())
+    assert r["scored"] == 6
+    assert r["top1"] == 0.5
+    rows = {e["experiment"]: e for e in r["experiments"]}
+    for kill in ("Svc_Kill_Media", "Svc_Kill_SocialGraph",
+                 "Svc_Kill_UserTimeline"):
+        assert rows[kill]["top1_hit"], kill
+    for stop in ("Code_Stop_MediaService", "Code_Stop_TextService",
+                 "Code_Stop_UserService"):
+        assert not rows[stop]["top1_hit"]
+        assert rows[stop]["top3"][0]["service"] == "ComposePostService"
+    text = REPORT.read_text()
+    assert "top-1 0.5, top-3 0.5 over 6 scored faults" in text
+    assert "propagation SINK" in text
+
+
+def test_tt_logs_are_fully_stubbed():
+    """TT log_data carries no real content in the shipped checkout — the
+    log-modality section must say 0 loaded, not fabricate rows from
+    zero-line stub parses."""
+    from anomod.golden import log_signal
+
+    r = log_signal("TT", _cfg())
+    assert r["n_loaded"] == 0
+    assert r.get("scored") in (None, 0)
+
+
 def test_sn_real_coverage_carries_signal():
     """SN gcov coverage DOES vary per experiment (max |delta| ~0.089 in
     the committed run) — the modality is weak but real there."""
