@@ -11,9 +11,12 @@ over the ACTUAL dataset, not only its synthetic shadow:
      files are real vs LFS-stubbed, and which experiments' artifacts the
      typed loaders actually parse (synth fallback disabled).
   2. :func:`coverage_signal` — the coverage-modality detector on real
-     data: per-service line-coverage ratios per experiment, |delta| vs the
-     normal-baseline run, culprit ranking — the real-data counterpart of
-     the ``coverage_ratio`` feature in anomod.detect (detect.py:116-124).
+     data: artifact-absence fingerprinting + blast-discounted coverage
+     -ratio deltas + producer triangulation, vs the normal-baseline run —
+     the real-data counterpart of the ``coverage_ratio`` feature in
+     anomod.detect (detect.py:116-124).
+  3. :func:`log_signal` — the log-modality detector on the real
+     summary.txt error/warn/line counts (collect_log.sh:101-137).
 
 ``anomod golden`` prints the full report as JSON (``--markdown`` for the
 docs body); docs/GOLDEN_REPORT.md carries the committed run, pinned by
@@ -71,7 +74,10 @@ def _try_load(testbed: str, modality: str, d: Path):
         loader = (logs_io.load_tt_log_dir if testbed == "TT"
                   else logs_io.load_sn_log_dir)
         batch, _ = loader(d)
-        return batch
+        # a LogBatch built from zero-line stub parses is NOT real content;
+        # this criterion must live here so the standalone census agrees
+        # with the _load_log_summaries preload path
+        return batch if batch is not None and batch.n_lines > 0 else None
     if modality == "api":
         art = api_io.find_api_artifact(d)
         return api_io.load_api_jsonl(art) if art else None
@@ -194,15 +200,34 @@ def _mark_hits(row: dict, target: str, ranked: List[str]) -> tuple:
 
 
 def coverage_signal(testbed: str, cfg: Optional[Config] = None,
-                    batches: Optional[Dict[str, object]] = None) -> dict:
+                    batches: Optional[Dict[str, object]] = None,
+                    repeat_tol: float = 0.005,
+                    upstream_w: float = 1.1) -> dict:
     """Coverage-modality detection over the REAL coverage artifacts.
 
-    Per fault experiment: per-service |coverage-ratio delta| vs the normal
-    baseline run (services aligned by name), culprit ranking by delta.
+    Per fault experiment: per-service coverage-ratio delta vs the normal
+    baseline run (services aligned by name), then culprit ranking by a
+    BLAST-DISCOUNTED, PRODUCER-ATTRIBUTED score.  Raw |delta| ranking is
+    confounded two ways in the real SN artifacts (the round-4 report's
+    shared-top-delta artifact): (1) a fault anywhere in the compose
+    pipeline starves the same downstream set by the SAME amounts — e.g.
+    post-storage-service drops exactly 0.0887 under every Code_Stop —
+    so a delta that repeats across other fault experiments (within
+    ``repeat_tol``) is a deterministic secondary effect and is divided by
+    (1 + 2·repeats); (2) a stopped service's OWN coverage never moves
+    (the cumulative gcov counters already covered its paths), while its
+    unique downstream consumers starve.  So when TWO OR MORE of one
+    producer's callees show unique (non-repeated) starvation, they
+    triangulate that producer: it inherits ``upstream_w`` x the max such
+    starvation, with ``upstream_w`` > 1 because the producer cannot
+    self-evidence in this data.  One uniquely starved callee alone is
+    ambiguous — a killed service and a starved service look identical
+    from inside their own artifact — so single-callee starvation stays
+    where it is (which is exactly what lets Svc_Kill self-attribute).
     This is the real-data counterpart of the offline detector's
-    ``coverage_ratio`` feature channel (anomod.detect:116-124, 147-157 —
-    coverage shifts are two-sided: faults both drop covered paths on dead
-    services and light error-handling paths)."""
+    ``coverage_ratio`` feature channel (anomod.detect:116-124) plus its
+    dependency-attribution idea."""
+    from anomod import synth
     cfg = cfg or get_config()
     if batches is None:
         batches = _load_coverage_batches(testbed, cfg)
@@ -213,34 +238,84 @@ def coverage_signal(testbed: str, cfg: Optional[Config] = None,
         return out
     base = batches[normal_name]
     base_ratio = dict(zip(base.services, base.service_ratio()))
-    hits1 = hits3 = scored = 0
-    max_delta = 0.0
-    for name, cb in sorted(batches.items()):
-        label = labels_mod.label_for(name)
-        if name == normal_name or label is None:
+    # signed per-service deltas for EVERY fault experiment up front: the
+    # repeat-discount needs each delta's frequency across the others
+    signed: Dict[str, Dict[str, float]] = {}
+    for name, cb in batches.items():
+        if name == normal_name:
             continue
         ratio = cb.service_ratio()
-        deltas = []
-        for si, svc in enumerate(cb.services):
-            if svc in base_ratio:
-                deltas.append((abs(float(ratio[si] - base_ratio[svc])), svc))
-        deltas.sort(reverse=True)
-        if deltas:
-            max_delta = max(max_delta, deltas[0][0])
+        signed[name] = {svc: float(ratio[si] - base_ratio[svc])
+                        for si, svc in enumerate(cb.services)
+                        if svc in base_ratio}
+    callees_of: Dict[str, List[str]] = {}
+    try:
+        for a, c in synth._topology(testbed)[1]:
+            callees_of.setdefault(a, []).append(c)
+    except Exception:
+        pass
+    hits1 = hits3 = scored = 0
+    max_delta = 0.0
+    n_absent = 0
+    n_absence_hits = 0
+    for name in sorted(signed):
+        label = labels_mod.label_for(name)
+        if label is None:
+            continue
+        dmap = signed[name]
+        if dmap:
+            max_delta = max(max_delta, max(abs(d) for d in dmap.values()))
+        disc: Dict[str, float] = {}
+        unique_mover: Dict[str, bool] = {}
+        for svc, d in dmap.items():
+            repeats = sum(
+                1 for other, od in signed.items()
+                if other != name
+                and abs(od.get(svc, 0.0) - d) <= repeat_tol
+                and abs(od.get(svc, 0.0)) > 1e-9)
+            moved = abs(d) > 1e-9
+            disc[svc] = abs(d) / (1.0 + 2.0 * repeats) if moved else 0.0
+            unique_mover[svc] = moved and repeats == 0
+        score: Dict[str, float] = dict(disc)
+        for svc in dmap:
+            starve = [disc[c] for c in callees_of.get(svc, ())
+                      if unique_mover.get(c) and dmap.get(c, 0.0) < 0]
+            if len(starve) >= 2:
+                score[svc] = max(score[svc], upstream_w * max(starve))
+        # ABSENCE tier, above every delta: a service that reported
+        # coverage at baseline but produced NO artifact under the fault
+        # stopped executing outright — a stopped binary cannot flush its
+        # gcov counters at collection time.  In the real SN tree this is
+        # exactly the Code_Stop culprits' fingerprint (each is the one
+        # service missing from its own experiment's coverage_data).
+        absent = [svc for svc in base_ratio if svc not in dmap]
+        n_absent += len(absent)
+        top_disc = max(score.values(), default=0.0)
+        for svc in absent:
+            # among multiple absences, the higher-baseline-coverage (more
+            # load-bearing) service ranks first — never the alphabetical
+            # accident of the tuple sort
+            score[svc] = top_disc + 1.0 + 1e-3 * base_ratio[svc]
+        deltas = sorted(((s, svc) for svc, s in score.items()),
+                        reverse=True)
         # a rank is only meaningful where the delta plane is non-zero:
         # zero-signal experiments must not score, or ties would credit and
         # deny hits by the sort's alphabetical accident
-        ranked = [svc for d, svc in deltas if d > 1e-9]
+        ranked = [svc for s, svc in deltas if s > 1e-9]
         target = label.target_service
         row = {"experiment": name, "target": target,
-               "n_services_aligned": len(deltas),
+               "n_services_aligned": len(dmap),
                "top3": [
-                   {"service": svc, "abs_delta": round(d, 4)}
-                   for d, svc in deltas[:3]]}
+                   dict({"service": svc, "score": round(s, 4),
+                         "abs_delta": round(abs(dmap.get(svc, 0.0)), 4)},
+                        **({"absent": True} if svc in absent else {}))
+                   for s, svc in deltas[:3]]}
         ds, d1, d3 = _mark_hits(row, target, ranked)
         scored += ds
         hits1 += d1
         hits3 += d3
+        if d1 and row["top3"] and row["top3"][0].get("absent"):
+            n_absence_hits += 1
         out["experiments"].append(row)
     out["scored"] = scored
     out["top1"] = round(hits1 / scored, 3) if scored else None
@@ -250,7 +325,11 @@ def coverage_signal(testbed: str, cfg: Optional[Config] = None,
     # across experiments), not that the detector failed — distinguish the
     # two in the committed record.
     out["max_abs_delta"] = round(max_delta, 6)
-    out["signal_present"] = max_delta > 1e-9
+    out["n_absent_artifacts"] = n_absent
+    out["n_absence_top1_hits"] = n_absence_hits
+    # absence is signal too (an experiment could carry ONLY the missing
+    # -artifact fingerprint and still score)
+    out["signal_present"] = max_delta > 1e-9 or n_absent > 0
     return out
 
 
@@ -337,13 +416,24 @@ def log_signal(testbed: str, cfg: Optional[Config] = None,
         vol_eps = 1e-12 if n_movers <= 3 else 0.1
         ranked = [svc for de, dw, dv, svc in deltas
                   if de > 1e-12 or dw > 1e-12 or dv > vol_eps]
+        # ABSENCE tier, above every delta (mirrors coverage_signal): a
+        # service that logged at baseline but has NO (or zero-line) rows
+        # under the fault went silent outright — the strongest kill
+        # fingerprint a non-cumulative collector would produce.  Among
+        # multiple absences the higher-volume baseline service ranks
+        # first (never the sort's alphabetical accident).
+        absent = sorted((svc for svc in base if svc not in svc_rates),
+                        key=lambda svc: -base[svc][2])
+        ranked = absent + ranked
         target = label.target_service
         row = {"experiment": name, "target": target,
                "n_services_aligned": len(deltas),
-               "top3": [{"service": svc, "err_delta": round(de, 5),
-                         "warn_delta": round(dw, 5),
-                         "vol_shift": round(dv, 6)}
-                        for de, dw, dv, svc in deltas[:3]]}
+               "top3": ([{"service": svc, "absent": True}
+                         for svc in absent[:3]]
+                        + [{"service": svc, "err_delta": round(de, 5),
+                            "warn_delta": round(dw, 5),
+                            "vol_shift": round(dv, 6)}
+                           for de, dw, dv, svc in deltas[:3]])[:3]}
         ds, d1, d3 = _mark_hits(row, target, ranked)
         scored += ds
         hits1 += d1
@@ -415,16 +505,31 @@ def format_markdown(report: dict) -> str:
                   f"{scan.get('n_experiments', 0)} experiments discovered; "
                   f"real (non-stub) loads per modality: "
                   + ", ".join(f"{m}={n}" for m, n in rl.items()) + ".", ""]
-    lines += ["## Coverage-modality detection on real artifacts", ""]
+    lines += ["## Coverage-modality detection on real artifacts",
+              "",
+              "Ranking is three-tiered (coverage_signal): (1) a service "
+              "present in the baseline but missing from the fault run's "
+              "coverage tree outranks everything — a stopped binary "
+              "cannot flush its gcov counters, so artifact ABSENCE is "
+              "the stop-fault fingerprint; (2) deltas that repeat "
+              "identically across other fault experiments are "
+              "deterministic pipeline blast and are discounted; (3) two "
+              "or more uniquely starved callees triangulate their "
+              "shared producer through the call topology.",
+              ""]
     for tb, cov in report["coverage_detection"].items():
         lines += [f"### {tb}",
                   "",
                   f"- experiments with loadable real coverage: "
                   f"{cov['n_loaded']}",
                   f"- normal baseline: `{cov.get('normal_baseline')}`",
-                  f"- culprit ranking by |coverage-ratio delta|: "
+                  f"- culprit ranking (absence tier + blast-discounted "
+                  f"deltas + producer triangulation): "
                   f"top-1 {cov.get('top1')}, top-3 {cov.get('top3')} over "
-                  f"{cov.get('scored', 0)} scored faults",
+                  f"{cov.get('scored', 0)} scored faults"
+                  + (f"; {cov.get('n_absence_top1_hits', 0)} culprits "
+                     f"identified by artifact absence"
+                     if cov.get("n_absence_top1_hits") else ""),
                   f"- max |delta| anywhere: {cov.get('max_abs_delta')} "
                   + ("(real per-experiment signal present)"
                      if cov.get("signal_present") else
@@ -433,8 +538,10 @@ def format_markdown(report: dict) -> str:
                      "signal in this dataset, which the synthetic "
                      "corpus deliberately does not replicate)"), ""]
         for row in cov.get("experiments", []):
-            t3 = ", ".join(f"{e['service']} ({e['abs_delta']})"
-                           for e in row["top3"])
+            t3 = ", ".join(
+                f"{e['service']} (ABSENT)" if e.get("absent")
+                else f"{e['service']} ({e['abs_delta']})"
+                for e in row["top3"])
             mark = ("no signal (unscored)" if row.get("no_signal")
                     else "hit" if row.get("top1_hit")
                     else "top3" if row.get("top3_hit") else "miss")
@@ -458,11 +565,11 @@ def format_markdown(report: dict) -> str:
     sink_misses = [r for r in sn_rows
                    if r.get("top1_hit") is False and r["top3"]
                    and r["top3"][0]["service"] == "ComposePostService"
-                   and r["top3"][0]["err_delta"] > 0]
+                   and r["top3"][0].get("err_delta", 0) > 0]
     vol_hits = [r for r in sn_rows
                 if r.get("top1_hit") and r["top3"]
-                and r["top3"][0]["err_delta"] == 0
-                and r["top3"][0]["vol_shift"] > 0]
+                and r["top3"][0].get("err_delta", 1) == 0
+                and r["top3"][0].get("vol_shift", 0) > 0]
     if vol_hits or sink_misses:
         finding_bits = []
         if vol_hits:
@@ -499,8 +606,9 @@ def format_markdown(report: dict) -> str:
                   f"{lg.get('max_abs_err_delta')}", ""]
         for row in lg.get("experiments", []):
             t3 = ", ".join(
-                f"{e['service']} (err {e['err_delta']}, "
-                f"vol {e['vol_shift']})" for e in row["top3"])
+                f"{e['service']} (ABSENT)" if e.get("absent")
+                else f"{e['service']} (err {e['err_delta']}, "
+                     f"vol {e['vol_shift']})" for e in row["top3"])
             mark = ("no signal (unscored)" if row.get("no_signal")
                     else "hit" if row.get("top1_hit")
                     else "top3" if row.get("top3_hit") else "miss")

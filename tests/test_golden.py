@@ -71,27 +71,54 @@ def test_tt_real_coverage_is_experiment_invariant():
     assert "carries no culprit signal" in REPORT.read_text()
 
 
+def test_sn_coverage_detection_matches_committed_report():
+    """The round-5 result: the three Code_Stop culprits are identified by
+    ARTIFACT ABSENCE (each is the one service missing from its own
+    experiment's coverage tree — a stopped binary cannot flush gcov
+    counters), and Svc_Kill_SocialGraph self-attributes through its
+    unique non-repeated delta: top-1 1.0 over the 4 scored faults, up
+    from 0.25 under raw |delta| ranking (the round-4 shared-top-delta
+    artifact was deterministic pipeline blast, now discounted)."""
+    from anomod.golden import coverage_signal
+
+    r = coverage_signal("SN", _cfg())
+    assert r["scored"] == 4
+    assert r["top1"] == 1.0
+    assert r["n_absent_artifacts"] == 3
+    rows = {e["experiment"]: e for e in r["experiments"]}
+    for stop in ("Code_Stop_MediaService", "Code_Stop_TextService",
+                 "Code_Stop_UserService"):
+        assert rows[stop]["top1_hit"], stop
+        assert rows[stop]["top3"][0].get("absent") is True
+    assert rows["Svc_Kill_SocialGraph"]["top1_hit"]
+    assert "absent" not in rows["Svc_Kill_SocialGraph"]["top3"][0]
+    text = REPORT.read_text()
+    assert "3 culprits identified by artifact absence" in text
+
+
 def test_sn_log_detection_matches_committed_report():
-    """The committed log-modality result: 6 scored faults, kills hit 3/3
-    through the unique-mover volume channel, Code_Stop misses 3/3 to the
-    propagation sink (ComposePostService logs the errors one hop
-    downstream of the stopped service)."""
+    """The committed log-modality result: 6 scored faults, all hit.
+    Kills hit through the unique-mover volume channel (a ~0.2% line-count
+    dip at exactly the killed service in an otherwise bit-frozen
+    cumulative log plane); Code_Stop culprits hit through the ABSENCE
+    tier — their summary.txt literally records "no log file found" for
+    the stopped service, so it has no countable row at all."""
     from anomod.golden import log_signal
 
     r = log_signal("SN", _cfg())
     assert r["scored"] == 6
-    assert r["top1"] == 0.5
+    assert r["top1"] == 1.0
     rows = {e["experiment"]: e for e in r["experiments"]}
     for kill in ("Svc_Kill_Media", "Svc_Kill_SocialGraph",
                  "Svc_Kill_UserTimeline"):
         assert rows[kill]["top1_hit"], kill
+        assert "absent" not in rows[kill]["top3"][0]
     for stop in ("Code_Stop_MediaService", "Code_Stop_TextService",
                  "Code_Stop_UserService"):
-        assert not rows[stop]["top1_hit"]
-        assert rows[stop]["top3"][0]["service"] == "ComposePostService"
+        assert rows[stop]["top1_hit"], stop
+        assert rows[stop]["top3"][0].get("absent") is True
     text = REPORT.read_text()
-    assert "top-1 0.5, top-3 0.5 over 6 scored faults" in text
-    assert "propagation SINK" in text
+    assert "top-1 1.0, top-3 1.0 over 6 scored faults" in text
 
 
 def test_tt_logs_are_fully_stubbed():
