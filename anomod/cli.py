@@ -94,13 +94,27 @@ def main(argv=None) -> int:
 
     p_coll = sub.add_parser(
         "collect", help="live-transport collection: pull from a running "
-        "Prometheus / Jaeger / SkyWalking / Elasticsearch endpoint and "
-        "write loader-compatible artifacts (anomod.io.live)")
+        "Prometheus / Jaeger / SkyWalking / Elasticsearch endpoint "
+        "(anomod.io.live) or through kubectl/docker exec transports "
+        "(anomod.io.live_exec) and write loader-compatible artifacts")
     p_coll.add_argument("kind", choices=["prometheus", "jaeger",
-                                         "skywalking", "es"])
-    p_coll.add_argument("--url", required=True,
+                                         "skywalking", "es", "kube-logs",
+                                         "docker-logs", "jacoco"])
+    p_coll.add_argument("--url",
                         help="base URL (prometheus/jaeger/es) or the "
-                             "GraphQL endpoint (skywalking)")
+                             "GraphQL endpoint (skywalking); unused by "
+                             "the exec transports")
+    p_coll.add_argument("--namespace", default="default",
+                        help="kube-logs/jacoco: kubernetes namespace")
+    p_coll.add_argument("--tail", type=int, default=1000,
+                        help="kube-logs: lines per pod")
+    p_coll.add_argument("--since", default=None,
+                        help="docker-logs: docker logs --since window "
+                             "(default: full history, the collect_log.sh "
+                             "default)")
+    p_coll.add_argument("--report-dir", default=None,
+                        help="jacoco: coverage_report output tree "
+                             "(default: <out>/../coverage_report)")
     p_coll.add_argument("--out", required=True,
                         help="output dir (prometheus) or artifact file "
                              "path (jaeger/skywalking/es)")
@@ -619,6 +633,32 @@ def main(argv=None) -> int:
         from anomod.io.live import (ElasticsearchClient, HttpTransport,
                                     JaegerClient, PrometheusClient,
                                     SkyWalkingClient)
+        if args.kind in ("kube-logs", "docker-logs", "jacoco"):
+            from pathlib import Path as _P
+
+            from anomod.io.live_exec import (DockerLogCollector, ExecRunner,
+                                             JacocoCoverageCollector,
+                                             KubeLogCollector)
+            runner = ExecRunner(timeout=args.timeout)
+            stamp = _time.strftime("%Y%m%d_%H%M%S")
+            if args.kind == "kube-logs":
+                rep = KubeLogCollector(
+                    runner=runner, namespace=args.namespace).collect(
+                    _P(args.out), stamp=stamp, tail=args.tail)
+            elif args.kind == "docker-logs":
+                rep = DockerLogCollector(runner=runner).collect(
+                    _P(args.out), stamp=stamp, time_range=args.since)
+            else:
+                out = _P(args.out)
+                report = (_P(args.report_dir) if args.report_dir
+                          else out.parent / "coverage_report")
+                rep = JacocoCoverageCollector(
+                    runner=runner, namespace=args.namespace).collect(
+                    out, report)
+            print(json.dumps(rep.to_json()))
+            return 0
+        if not args.url:
+            parser.error(f"--url is required for kind {args.kind}")
         tp = HttpTransport(timeout=args.timeout, max_retries=args.retries)
         now = _time.time()
         start = now - args.hours_back * 3600.0

@@ -253,7 +253,10 @@ def coverage_signal(testbed: str, cfg: Optional[Config] = None,
         for a, c in synth._topology(testbed)[1]:
             callees_of.setdefault(a, []).append(c)
     except Exception:
+        # triangulation degrades to delta-only ranking without topology —
+        # surfaced in the record so a silent regression is visible
         pass
+    out["topology_available"] = bool(callees_of)
     hits1 = hits3 = scored = 0
     max_delta = 0.0
     n_absent = 0
@@ -554,8 +557,12 @@ def format_markdown(report: dict) -> str:
               "Per-service error/warn RATES (errors / lines, the "
               "collect_log.sh:101-137 summary counts normalized by "
               "volume) plus the log-VOLUME shift |ln(lines/baseline)|, "
-              "deltas vs the normal baseline, culprit ranked by "
-              "error-rate delta with volume as the tiebreak channel.",
+              "deltas vs the normal baseline.  Ranking is two-tiered: a "
+              "service that logged at baseline but has NO countable row "
+              "under the fault (summary.txt records no log file) "
+              "outranks everything — going silent is the stop/kill "
+              "fingerprint — then error-rate delta with warn-rate and "
+              "volume as tiebreak channels.",
               ""]
     # the two dataset findings are emitted only when THIS run's rows
     # exhibit them — a regeneration after `git lfs pull` (or against a
@@ -599,9 +606,10 @@ def format_markdown(report: dict) -> str:
                   f"- experiments with real (non-stub) logs: "
                   f"{lg['n_loaded']}",
                   f"- normal baseline: `{lg.get('normal_baseline')}`",
-                  f"- culprit ranking by |error-rate delta|: "
-                  f"top-1 {lg.get('top1')}, top-3 {lg.get('top3')} over "
-                  f"{lg.get('scored', 0)} scored faults",
+                  f"- culprit ranking (absence tier + error-rate "
+                  f"delta): top-1 {lg.get('top1')}, top-3 "
+                  f"{lg.get('top3')} over {lg.get('scored', 0)} "
+                  f"scored faults",
                   f"- max |err-rate delta| anywhere: "
                   f"{lg.get('max_abs_err_delta')}", ""]
         for row in lg.get("experiments", []):

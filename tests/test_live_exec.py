@@ -1,0 +1,168 @@
+"""Exec-transport collectors against a scripted fake cluster.
+
+Mirrors tests/test_live.py's stub-server design for the subprocess-driven
+collection paths: a FakeCluster answers every kubectl/docker invocation
+from canned data, and the assertions close the loop through the OFFLINE
+loaders — collection is correct iff load_tt_log_dir / load_sn_log_dir /
+load_tt_coverage_report consume the produced trees unmodified.
+"""
+
+import numpy as np
+import pytest
+
+from anomod.io.live_exec import (DockerLogCollector, ExecResult, ExecRunner,
+                                 JacocoCoverageCollector, KubeLogCollector)
+
+STAMP = "20260731_120000"
+
+
+class FakeCluster:
+    """Scripted answers for kubectl/docker command lines; records every
+    invocation for behavioral asserts."""
+
+    def __init__(self):
+        self.calls = []
+        self.pods = ["ts-order-service-86d6f7876-99bhf",
+                     "ts-travel-service-5f7b8-x2k4p",
+                     "nacos-0", "other-pod-1"]
+        self.crashed = {"ts-order-service-86d6f7876-99bhf"}
+        self.containers = {
+            "compose-post-service": "c01",
+            "post-storage-service": "c02",
+        }
+        self.jacoco_pods = {"ts-order-service-86d6f7876-99bhf",
+                            "ts-travel-service-5f7b8-x2k4p"}
+
+    def __call__(self, cmd):
+        self.calls.append(cmd)
+        joined = " ".join(cmd)
+        if cmd[:3] == ["kubectl", "get", "pods"] and "-o" in cmd \
+                and "json" in joined and "jsonpath" not in joined:
+            import json
+            return ExecResult(0, json.dumps({"items": [
+                {"metadata": {"name": p}} for p in self.pods]}))
+        if "jsonpath" in joined:
+            return ExecResult(0, " ".join(self.pods))
+        if cmd[:2] == ["kubectl", "logs"]:
+            pod = cmd[2]
+            if "--previous" in cmd:
+                if pod in self.crashed:
+                    return ExecResult(0, "ERROR crash before restart\n")
+                return ExecResult(1, "", "no previous terminated container")
+            return ExecResult(
+                0, f"2026-07-31 12:00:00 INFO {pod} serving\n"
+                   f"2026-07-31 12:00:01 WARN {pod} slow\n")
+        if cmd[:2] == ["kubectl", "get"] and "events" in cmd:
+            return ExecResult(0, '{"items": [{"reason": "Killing"}]}')
+        if cmd[:2] == ["docker", "ps"]:
+            rows = [f"{cid} socialnetwork_{svc}_1"
+                    for svc, cid in self.containers.items()]
+            return ExecResult(0, "\n".join(rows) + "\n")
+        if cmd[:2] == ["docker", "logs"]:
+            cid = cmd[-1]
+            return ExecResult(
+                0, "2026-07-31T12:00:00 INFO ready\n"
+                   "2026-07-31T12:00:01 ERROR downstream failed\n")
+        if "test -f /jacoco/jacococli.jar" in joined:
+            pod = cmd[cmd.index("exec") + 1]
+            return ExecResult(0 if pod in self.jacoco_pods else 1)
+        if "jacococli.jar dump" in joined:
+            return ExecResult(0)
+        if "ls -1 /coverage/*.exec" in joined:
+            pod = cmd[cmd.index("exec") + 1]
+            return ExecResult(0, f"/coverage/jacoco-{pod}.exec\n")
+        if cmd[:3] == ["kubectl", "-n", "default"] and cmd[3] == "cp":
+            # "copy" the pod's dump: write a CoverageDump npz at the dst
+            from pathlib import Path
+
+            from anomod.io.coverage_report import CoverageDump, save_dump
+            pod = cmd[4].split(":", 1)[0]
+            dst = Path(cmd[5])
+            covered = pod.startswith("ts-order")
+            mask = np.zeros(10, bool)
+            mask[:7 if covered else 3] = True
+            save_dump(CoverageDump(service=pod,
+                                   files={"src/Main.java": mask}), dst)
+            # kubectl cp delivers bytes at EXACTLY the requested path;
+            # numpy's savez appends .npz, so emulate the byte-copy
+            if not dst.exists():
+                dst.with_name(dst.name + ".npz").rename(dst)
+            return ExecResult(0)
+        return ExecResult(1, "", f"unscripted command: {joined}")
+
+
+@pytest.fixture()
+def cluster():
+    return FakeCluster()
+
+
+def _runner(cluster):
+    return ExecRunner(run_fn=cluster)
+
+
+def test_kube_log_collection_roundtrips_through_loader(tmp_path, cluster):
+    col = KubeLogCollector(runner=_runner(cluster))
+    rep = col.collect(tmp_path, stamp=STAMP)
+    assert rep.kind == "kubectl_logs"
+    # only ts-/nacos/rabbitmq pods collected; other-pod-1 filtered out
+    assert not any("other-pod" in f for f in rep.files)
+    # crashed pod got a _previous_ file; healthy one did not
+    prev = [f for f in rep.files if "_previous_" in f]
+    assert len(prev) == 1 and "ts-order-service" in prev[0]
+    assert any("kubernetes_events_" in f for f in rep.files)
+    from anomod.io.logs import load_tt_log_dir
+    batch, summaries = load_tt_log_dir(tmp_path)
+    assert batch is not None and batch.n_lines > 0
+    # pod names collapse to service identity; _previous_ files excluded
+    assert "ts-order-service" in batch.services
+    assert "ts-travel-service" in batch.services
+
+
+def test_docker_log_collection_writes_summary_contract(tmp_path, cluster):
+    col = DockerLogCollector(runner=_runner(cluster))
+    rep = col.collect(tmp_path, stamp=STAMP)
+    assert rep.kind == "docker_logs"
+    from anomod.io.logs import load_sn_log_dir
+    batch, summaries = load_sn_log_dir(tmp_path)
+    assert batch is not None and batch.n_lines > 0
+    by_svc = {s.service: s for s in summaries}
+    # the two running containers produced real files with counted errors;
+    # crucially the loader-derived service identity is the bare display
+    # name — the filename stamp must not leak into it
+    assert "ComposePostService" in by_svc, sorted(by_svc)
+    assert by_svc["ComposePostService"].n_error == 1
+    assert by_svc["PostStorageService"].n_lines == 2
+    # absent services carry the no-log-file row (the golden run's
+    # stop-fault fingerprint), not a fabricated zero-count file
+    text = (tmp_path / "summary.txt").read_text()
+    assert "TextService: 未找到日志文件" in text
+    assert not list(tmp_path.glob("TextService_*.log"))
+
+
+def test_jacoco_collect_renders_loadable_report_tree(tmp_path, cluster):
+    col = JacocoCoverageCollector(runner=_runner(cluster))
+    rep = col.collect(tmp_path / "coverage_data", tmp_path / "report")
+    assert rep.kind == "jacoco_coverage"
+    assert rep.n_skipped == 0
+    # exec files pulled with the pod__basename convention
+    assert all("__jacoco-" in f for f in rep.files)
+    from anomod.io.coverage import load_tt_coverage_report
+    cb = load_tt_coverage_report(tmp_path / "report")
+    assert cb is not None
+    ratios = dict(zip(cb.services, cb.service_ratio()))
+    assert ratios["ts-order-service"] == pytest.approx(0.7)
+    assert ratios["ts-travel-service"] == pytest.approx(0.3)
+
+
+def test_dump_failure_skips_pod_and_continues(tmp_path, cluster):
+    cluster.jacoco_pods = {"ts-travel-service-5f7b8-x2k4p"}  # order has no jar
+    col = JacocoCoverageCollector(runner=_runner(cluster))
+    rep = col.collect(tmp_path / "coverage_data", tmp_path / "report")
+    assert rep.n_skipped == 1
+    assert len(rep.files) == 1 and "ts-travel" in rep.files[0]
+
+
+def test_runner_timeout_degrades_not_raises(cluster):
+    r = ExecRunner(timeout=0.001)
+    res = r.run(["sleep", "5"])
+    assert res.returncode != 0

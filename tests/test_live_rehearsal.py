@@ -1,0 +1,199 @@
+"""End-to-end LIVE collection rehearsal: stub infra -> 5-modality tree ->
+loaders -> validator -> detector, zero synth fallback.
+
+The round-4 verdict's last live-story gap: prove that the live clients
+(HTTP transports from anomod.io.live + exec transports from
+anomod.io.live_exec) compose into a full collection run whose OUTPUT TREE
+is byte-compatible with the archive layout — i.e. a user can point the
+collectors at running infra and get a drop-in experiment the offline
+stack consumes unmodified (collect_all_modalities.sh:114-254's promise).
+
+TT flavor, per modality:
+  traces   — SkyWalking GraphQL stub server (from test_live) serving the
+             fault experiment's spans; SkyWalkingClient.collect
+  metrics  — Prometheus stub serving query_range; collect_tt long CSV
+  logs     — fake kubectl cluster whose `kubectl logs` replay each pod's
+             LogBatch lines; KubeLogCollector
+  coverage — fake jacococli dump/cp loop delivering CoverageDump bytes;
+             JacocoCoverageCollector renders the report tree
+  api      — the in-process OpenAPI monitor family writer (the monitor IS
+             the live api collector in this design — there is no separate
+             HTTP backend to stub)
+
+The tree is then consumed STRICTLY (synth_on_lfs=False): every modality
+must load real, the validator must pass, and the detector must rank the
+injected culprit over the fault-free baseline tree collected the same
+way.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from anomod import labels, synth
+from anomod.config import Config
+from anomod.io.live import HttpTransport, PrometheusClient, SkyWalkingClient
+from anomod.io.live_exec import (ExecResult, ExecRunner,
+                                 JacocoCoverageCollector, KubeLogCollector)
+from test_live import JsonStub, _artifact_to_graphql, _sw_stub_route
+
+
+@pytest.fixture
+def stub_factory():
+    stubs = []
+
+    def make(route):
+        s = JsonStub(route)
+        stubs.append(s)
+        return s
+
+    yield make
+    for s in stubs:
+        s.close()
+
+STAMP = "20260731_130000"
+TS2 = "20260731_130500"
+
+
+def _prom_route(queries):
+    """query_range stub: every query answers one constant series."""
+    def route(method, path, params, body):
+        assert path.endswith("/api/v1/query_range")
+        start = float(params["start"])
+        return 200, {"status": "success", "data": {"result": [{
+            "metric": {"__name__": "stub", "service": "ts-order-service"},
+            "values": [[start + 15 * i, "1.0"] for i in range(4)],
+        }]}}
+    return route
+
+
+class FakePods:
+    """kubectl/jacoco answers derived from one synthetic Experiment."""
+
+    def __init__(self, exp):
+        self.exp = exp
+        self.pods = [f"{svc}-86d6f7876-9{si:02d}bh"
+                     for si, svc in enumerate(exp.logs.services)]
+
+    def _log_text(self, svc_idx):
+        from anomod.schemas import LOG_ERROR, LOG_INFO, LOG_WARN
+        lvl_name = {LOG_INFO: "INFO", LOG_WARN: "WARN", LOG_ERROR: "ERROR"}
+        lg = self.exp.logs
+        rows = np.flatnonzero(lg.service == svc_idx)
+        return "".join(
+            f"2026-07-31 13:00:00 {lvl_name.get(int(lg.level[r]), 'DEBUG')} "
+            f"{lg.services[svc_idx]}: request handled\n" for r in rows)
+
+    def __call__(self, cmd):
+        joined = " ".join(cmd)
+        if "jsonpath" in joined:
+            return ExecResult(0, " ".join(p for p in self.pods
+                                          if p.startswith("ts-")))
+        if cmd[:3] == ["kubectl", "get", "pods"]:
+            return ExecResult(0, json.dumps({"items": [
+                {"metadata": {"name": p}} for p in self.pods]}))
+        if cmd[:2] == ["kubectl", "logs"]:
+            if "--previous" in cmd:
+                return ExecResult(1, "", "no previous container")
+            svc_idx = self.pods.index(cmd[2])
+            return ExecResult(0, self._log_text(svc_idx))
+        if cmd[:2] == ["kubectl", "get"] and "events" in cmd:
+            return ExecResult(0, '{"items": []}')
+        if "test -f /jacoco/jacococli.jar" in joined \
+                or "jacococli.jar dump" in joined:
+            return ExecResult(0)
+        if "ls -1 /coverage/*.exec" in joined:
+            pod = cmd[cmd.index("exec") + 1]
+            return ExecResult(0, f"/coverage/jacoco-{pod}.exec\n")
+        if len(cmd) > 3 and cmd[3] == "cp":
+            from pathlib import Path
+
+            from anomod.io.coverage_report import batch_to_dumps, save_dump
+            pod = cmd[4].split(":", 1)[0]
+            dst = Path(cmd[5])
+            from anomod.io.logs import pod_to_service
+            svc = pod_to_service(pod)
+            dump = next(d for d in batch_to_dumps(self.exp.coverage)
+                        if d.service == svc)
+            save_dump(dump, dst)
+            if not dst.exists():
+                dst.with_name(dst.name + ".npz").rename(dst)
+            return ExecResult(0)
+        return ExecResult(1, "", f"unscripted: {joined}")
+
+
+def _collect_tree(exp, label, root, stub_factory):
+    """One experiment through every live collector into the archive
+    layout the TT discover() walks (dir naming run_all_experiments.sh:
+    ``<Exp>_<ts>_em`` for anomalies, ``<Exp>_em_<ts>`` for the normal)."""
+    ts = "20260731T130500Z"
+    base = (f"{exp.name}_{ts}_em" if label.is_anomaly
+            else f"{exp.name}_em_{ts}")
+    tt = root / "TT_data"
+
+    # traces: spans -> collector artifact -> GraphQL stub -> live client
+    doc = synth.spans_to_skywalking_json(exp.spans, experiment=base)
+    summaries, spans_by_tid = _artifact_to_graphql(doc)
+    stub = stub_factory(_sw_stub_route(summaries, spans_by_tid))
+    tp = HttpTransport(timeout=5.0, sleep=lambda s: None)
+    tdir = tt / "trace_data" / base
+    SkyWalkingClient(stub.base_url, transport=tp).collect(
+        tdir / f"{base}_skywalking_traces_{STAMP}.json",
+        experiment=base, limit=len(summaries))
+
+    # metrics: prometheus stub -> TT long CSV
+    pstub = stub_factory(_prom_route(None))
+    mdir = tt / "metric_data" / base
+    PrometheusClient(pstub.base_url, transport=tp).collect_tt(
+        ["node_cpu_seconds_total", "jvm_memory_used_bytes"],
+        mdir / f"{base}_metrics_{STAMP}.csv", 0.0, 60.0)
+
+    # logs + coverage through the fake cluster
+    fake = FakePods(exp)
+    runner = ExecRunner(run_fn=fake)
+    KubeLogCollector(runner=runner).collect(
+        tt / "log_data" / base, stamp=STAMP)
+    JacocoCoverageCollector(runner=runner).collect(
+        tt / "coverage_data" / base,
+        tt / "coverage_report" / base)
+
+    # api: the in-process monitor family writer
+    from anomod.io.api import write_api_artifact_family
+    write_api_artifact_family(
+        exp.api, tt / "api_responses" / base)
+
+
+@pytest.mark.slow
+def test_live_rehearsal_tt_five_modalities(tmp_path, stub_factory):
+    fault = labels.label_for("Lv_S_KILLPOD_preserve")
+    normal = next(l for l in labels.labels_for_testbed("TT")
+                  if not l.is_anomaly)
+    exps = {}
+    for label in (normal, fault):
+        exps[label.experiment] = synth.generate_experiment(
+            label, n_traces=60, seed=11)
+        _collect_tree(exps[label.experiment], label, tmp_path, stub_factory)
+
+    # strict consumption: no synth fallback anywhere
+    cfg = Config(data_root=tmp_path, synth_on_lfs=False)
+    from anomod.io import dataset
+    from anomod.validate import validate_experiment
+    loaded = {}
+    for name in exps:
+        exp = dataset.load_experiment(name, testbed="TT", cfg=cfg)
+        assert not exp.synthetic, f"synth fallback hit for {name}"
+        for modality in ("spans", "metrics", "logs", "api", "coverage"):
+            assert getattr(exp, modality) is not None, (name, modality)
+        rep = validate_experiment(exp)
+        assert rep.ok, rep
+        loaded[name] = exp
+
+    # the detector consumes the collected tree and localizes the culprit
+    from anomod import detect
+    services = tuple(synth.TT_SERVICES)
+    base_x = detect.extract_features(loaded[normal.experiment], services).x
+    x = detect.extract_features(loaded[fault.experiment], services).x
+    scores = np.asarray(detect.service_scores(x, base_x))
+    top = [services[i] for i in np.argsort(-scores)[:3]]
+    assert fault.target_service in top, (fault.target_service, top)
