@@ -26,9 +26,10 @@ docs/BENCHMARKS.md):
   - the transformer node backbone restores in-dist to 0.97 across every
     non-edge shift at unchanged edge capability.
   - edge-locus attribution is DATA-limited at the sweep's 6-seed
-    training protocol: 0.36 top-1 there vs 0.56 with 24 training seeds
-    (the committed data-scaling record; see docs/BENCHMARKS.md for the
-    same-protocol comparison against the out-edge-block models).
+    training protocol: 0.39 top-1 there (bench_runs/20260731T184051Z)
+    vs ~0.56 with 24 training seeds (see docs/BENCHMARKS.md for the
+    same-protocol comparison against the out-edge-block models and the
+    committed data-scaling records).
 
 TPU-first shape discipline: the edge list is padded to a static E_max
 with a mask; the edge<->node exchanges are one-hot [E, S] matmuls (MXU)
