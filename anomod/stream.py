@@ -948,8 +948,14 @@ class OnlineDetector:
             # shield blast victims from edge-explanation and explain
             # away a genuine edge culprit upstream of the noise
             mod_windows: dict = {}
+            plane_groups: dict = {}   # evidence classification, shared
+            # with the corroboration tier below (single source for the
+            # log/metric/api-vs-span split)
             for a in self.alerts:
-                if a.evidence in ("log", "metric", "api"):
+                g = a.evidence if a.evidence in ("log", "metric", "api") \
+                    else "span"
+                plane_groups.setdefault(a.service, set()).add(g)
+                if g != "span":
                     mod_windows.setdefault(a.service, set()).add(a.window)
             direct_node_ev = {s for s, ws in mod_windows.items()
                               if len(ws) >= 2}
@@ -1027,6 +1033,41 @@ class OnlineDetector:
                 peaks=peak, windows=windows)
             explained = (explained - edge_dom) | (strict & edge_dom)
 
+        # Plane-corroboration tier, active only when (a) an edge-dominant
+        # candidate exists and (b) the run is genuinely multimodal (>= 2
+        # evidence plane groups fired somewhere).  An out-edge alert is
+        # precision-calibrated structural evidence — it survived a
+        # dominance scan over the whole out-edge plane — while its z is
+        # arithmetically small next to a raw 6-sigma log/metric window on
+        # some unrelated service (S x W cells of multiple testing plus
+        # planted confounders produce those routinely at sparse density).
+        # The reorder is PAIRWISE, not a global tier: each edge-dominant
+        # candidate lifts above the single-plane services ranked ahead of
+        # it, and every pair NOT involving an edge-dominant candidate
+        # keeps its magnitude order — a global tier was measured to cost
+        # two in-dist cells by letting arbitrary services pass a
+        # single-plane node culprit it had demoted.
+        uncorroborated: set = set()
+        if edge_dom and os.environ.get("ANOMOD_RANK_TIER", "1") != "0":
+            groups = plane_groups
+            if len(set().union(*groups.values())) >= 2:
+                # span-plane evidence is exempt even alone: latency/error
+                # /drop z is anchored to the service's own traffic (a node
+                # culprit can legitimately be spans-only at sparse
+                # density), while a lone log/metric/api plane with healthy
+                # spans is exactly the planted-confounder shape
+                # direct_node_ev members are exempt: a service with
+                # SUSTAINED modality evidence that is also the callee of
+                # the edge-dominant rows is the node-culprit reading of
+                # the same picture (every caller's edge to it heats) —
+                # the bubble must not let its own blast outrank it
+                uncorroborated = {
+                    s for s in total
+                    if s not in edge_dom and not self._self_hot[s]
+                    and s not in direct_node_ev
+                    and len(groups.get(s, ())) < 2
+                    and "span" not in groups.get(s, ())}
+
         # ranking key: SUM of alert scores, not the single peak — a
         # culprit sustains its anomaly across the fault (many windows,
         # several evidence channels) while a blast-radius victim flickers;
@@ -1035,7 +1076,21 @@ class OnlineDetector:
         def key(s):
             return (s in explained or s in edge_explained, -total[s])
 
-        return [self.services[s] for s in sorted(total, key=key)]
+        order = sorted(total, key=key)
+        if uncorroborated:
+            # bubble each edge-dominant candidate above adjacent
+            # uncorroborated services within the same explained tier:
+            # exactly the pairs the corroboration argument covers move
+            changed = True
+            while changed:
+                changed = False
+                for i in range(len(order) - 1):
+                    a, b = order[i], order[i + 1]
+                    if a in uncorroborated and b in edge_dom \
+                            and key(a)[0] == key(b)[0]:
+                        order[i], order[i + 1] = b, a
+                        changed = True
+        return [self.services[s] for s in order]
 
     def first_alert_window(self, service_name: Optional[str] = None):
         ws = [a.window for a in self.alerts

@@ -587,3 +587,46 @@ def test_sharded_edge_attribution_matches_single_chip():
         OnlineDetector(batch.services, cfg, t0,
                        replay=ShardedStreamReplay(cfg, t0, mesh),
                        edge_attribution=True)
+
+
+def test_rank_tier_demotes_isolated_single_plane_decoy(monkeypatch):
+    """Plane-corroboration reorder (round 5): an edge-dominant caller
+    bubbles above a service whose entire evidence is one flickering
+    non-span plane with no structural tie — while a service with
+    SUSTAINED modality evidence (the node-culprit signature) and the
+    span-plane services keep their magnitude order."""
+    import numpy as np
+
+    from anomod.replay import ReplayConfig
+    from anomod.stream import Alert, MultimodalDetector
+
+    services = ("caller", "decoy", "sustained", "victim")
+    cfg = ReplayConfig(n_services=4, n_windows=16)
+    det = MultimodalDetector(services, cfg, t0_us=0,
+                             call_edges={(0, 3)})
+    det.edge_attribution = True
+    det._self_hot = np.zeros(4, bool)
+    det._edge_hot = {0: 6.0}          # caller is edge-dominant
+
+    def alert(svc, w, score, evidence):
+        return Alert(window=w, service=svc, service_name=services[svc],
+                     score=score, z_latency=0.0, z_error=0.0, z_drop=0.0,
+                     evidence=evidence)
+
+    monkeypatch.delenv("ANOMOD_RANK_TIER", raising=False)
+    det.alerts.extend([
+        alert(0, 10, 3.0, "edge"), alert(0, 11, 3.0, "edge"),
+        # decoy: single log window, louder than the edge z
+        alert(1, 10, 8.0, "log"),
+        # sustained modality evidence across 2 windows: exempt
+        alert(2, 10, 9.0, "log"), alert(2, 11, 9.0, "log"),
+    ])
+    ranked = det.ranked_services()
+    # sustained keeps its magnitude rank; the edge-dominant caller
+    # bubbles above the isolated decoy
+    assert ranked.index("caller") < ranked.index("decoy")
+    assert ranked[0] == "sustained"
+    # with the tier disabled the decoy's raw magnitude wins back its spot
+    monkeypatch.setenv("ANOMOD_RANK_TIER", "0")
+    ranked0 = det.ranked_services()
+    assert ranked0.index("decoy") < ranked0.index("caller")
