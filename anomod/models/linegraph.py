@@ -27,9 +27,10 @@ docs/BENCHMARKS.md):
     non-edge shift at unchanged edge capability.
   - edge-locus attribution is DATA-limited at the sweep's 6-seed
     training protocol: 0.39 top-1 there (bench_runs/20260731T184051Z)
-    vs ~0.56 with 24 training seeds (see docs/BENCHMARKS.md for the
-    same-protocol comparison against the out-edge-block models and the
-    committed data-scaling records).
+    vs 0.50 with 24 training seeds (bench_runs/20260731T210351Z, the
+    committed data-scaling record; in-dist 0.97 at both protocols —
+    see docs/BENCHMARKS.md for the same-protocol comparison against
+    the out-edge-block models).
 
 TPU-first shape discipline: the edge list is padded to a static E_max
 with a mask; the edge<->node exchanges are one-hot [E, S] matmuls (MXU)
