@@ -342,6 +342,16 @@ class OnlineDetector:
             cfg = edge_combined_cfg(cfg, S)
             self._edge_hot: dict = {}       # caller id -> summed hot score
             self._self_hot = np.zeros(S, bool)
+            # Per-(caller, callee) PAIR accumulators — the ranking's
+            # concentration discriminator.  The pooled out-edge ROW can
+            # say "caller p's outgoing traffic degraded" but not whether
+            # the heat is spread across p's callees (link fault in p) or
+            # concentrated on one (blast pointing at a node culprit).
+            # O(observed pairs) streaming state: [n, sum_log1p_dur,
+            # n_err] keyed caller*S+callee, split baseline/anomalous
+            # phase at the calibration boundary.
+            self._pair_base: dict = {}
+            self._pair_anom: dict = {}
         else:
             K = S
         self._K = K
@@ -384,6 +394,10 @@ class OnlineDetector:
         self.push_wall_s = 0.0
         self._scored_through = -1          # last closed ABSOLUTE window scored
         self._max_seen = -1                # newest absolute window with data
+        # frozen grid anchor for the pair accumulators' phase split (the
+        # replay's own t0 ROLLS with the ring)
+        self._t0_us = int(t0_us)
+        self._window_us = int(cfg.window_us)
         self._callees_cache: dict = {}
         self._streak = np.zeros(self._K, np.int32)
         self._baseline = None              # frozen calibration snapshot
@@ -419,6 +433,62 @@ class OnlineDetector:
     _DUP_FIELDS = ("trace", "parent", "endpoint", "start_us",
                    "duration_us", "is_error", "status", "kind")
 
+    def _accumulate_pairs(self, batch: SpanBatch, svc: np.ndarray,
+                          psvc: np.ndarray) -> None:
+        """Fold a micro-batch's cross edges into the per-pair phase
+        accumulators (vectorized per unique pair; O(pairs) dict work)."""
+        cross = (psvc >= 0) & (psvc != svc)
+        if not cross.any():
+            return
+        wi = (batch.start_us[cross] - self._t0_us) // self._window_us
+        keys = psvc[cross].astype(np.int64) * self._n_svc + svc[cross]
+        dur = np.log1p(batch.duration_us[cross].astype(np.float64))
+        err = batch.is_error[cross].astype(np.float64)
+        in_base = wi < self.baseline_windows
+        for phase, m in ((self._pair_base, in_base),
+                         (self._pair_anom, ~in_base)):
+            if not m.any():
+                continue
+            uk, inv = np.unique(keys[m], return_inverse=True)
+            ns = np.bincount(inv).astype(np.float64)
+            ds = np.bincount(inv, weights=dur[m])
+            es = np.bincount(inv, weights=err[m])
+            for k_, n_, d_, e_ in zip(uk.tolist(), ns, ds, es):
+                acc = phase.setdefault(k_, [0.0, 0.0, 0.0])
+                acc[0] += n_
+                acc[1] += d_
+                acc[2] += e_
+
+    def _pair_verdict(self, p: int) -> Optional[tuple]:
+        """Concentration verdict for caller ``p``'s per-pair heat:
+        ``("concentrated", callee)`` when one callee carries >= 60% of
+        the degradation mass, ``("spread", -1)`` when it is spread, and
+        ``None`` when there is not enough pair data to tell.
+
+        Spread-vs-concentrated is THE link-vs-node discriminator: an
+        edge-locus fault degrades ALL of the culprit's outgoing pairs,
+        while a node culprit heats exactly the one pair pointing at it
+        from each caller."""
+        S = self._n_svc
+        deltas: List[tuple] = []
+        n_obs = 0
+        for k, (n_a, d_a, e_a) in self._pair_anom.items():
+            if k // S != p or n_a < 3:
+                continue
+            base = self._pair_base.get(k)
+            if not base or base[0] < 3:
+                continue
+            n_obs += 1
+            d = max(d_a / n_a - base[1] / base[0], 0.0) \
+                + 5.0 * max(e_a / n_a - base[2] / base[0], 0.0)
+            if d > 0:
+                deltas.append((d, int(k % S)))
+        if n_obs < 2 or not deltas:
+            return None          # one observed pair: spread undefined
+        tot = sum(d for d, _ in deltas)
+        d0, c0 = max(deltas)
+        return ("concentrated", c0) if d0 >= 0.6 * tot else ("spread", -1)
+
     def push(self, batch: SpanBatch,
              parent_service: Optional[np.ndarray] = None) -> List[Alert]:
         """Feed a micro-batch; returns alerts for newly closed windows.
@@ -442,6 +512,8 @@ class OnlineDetector:
                 svc = batch.service.astype(np.int32)
                 psvc = None if parent_service is None else \
                     np.asarray(parent_service, np.int32)
+                if psvc is not None:
+                    self._accumulate_pairs(batch, svc, psvc)
                 eids = self._edge_ids(svc, psvc)
                 batch = batch._replace(
                     service=np.concatenate([svc, eids]),
@@ -1026,8 +1098,34 @@ class OnlineDetector:
             # in-dist cells where a blast-heated caller must yield to a
             # node culprit whose self-edge is underpowered — net zero on
             # top1, so the general walk stays.)
-            node_borne = {s for s in anomalous
-                          if self._self_hot[s] or s in direct_node_ev}
+            # Concentration refutation (round 5): sustained modality
+            # evidence alone cannot certify a callee as node-borne when
+            # the per-pair data says its edge-dominant caller's heat is
+            # SPREAD across callees — under a link fault, planted decoys
+            # downstream of the culprit carry exactly that signature and
+            # were forcing the culprit to yield to them.  A callee the
+            # caller's heat CONCENTRATES on keeps (indeed earns) its
+            # node-borne status; with no pair data the old reading
+            # stands.
+            verdicts = {p: self._pair_verdict(p) for p in edge_dom}
+
+            def _node_borne(s):
+                if self._self_hot[s]:
+                    return True
+                if s not in direct_node_ev:
+                    return False
+                calling = [verdicts[p] for p in edge_dom
+                           if verdicts[p] is not None
+                           and s in self._callees_of(p)]
+                # concentration wins over a spread refutation from some
+                # other caller (one caller's heat pointing squarely at s
+                # IS the node-culprit signature, and this must agree
+                # with conc_exempt's any-caller semantics — never with
+                # set iteration order)
+                if any(v == ("concentrated", s) for v in calling):
+                    return True
+                return not any(v == ("spread", -1) for v in calling)
+            node_borne = {s for s in anomalous if _node_borne(s)}
             strict = _explained_by_downstream(
                 self.call_edges, node_borne | edge_dom,
                 peaks=peak, windows=windows)
@@ -1056,15 +1154,31 @@ class OnlineDetector:
                 # culprit can legitimately be spans-only at sparse
                 # density), while a lone log/metric/api plane with healthy
                 # spans is exactly the planted-confounder shape
-                # direct_node_ev members are exempt: a service with
-                # SUSTAINED modality evidence that is also the callee of
-                # the edge-dominant rows is the node-culprit reading of
-                # the same picture (every caller's edge to it heats) —
-                # the bubble must not let its own blast outrank it
+                # concentration exemption: when an edge-dominant
+                # candidate's per-pair heat is CONCENTRATED on one
+                # callee, that callee is the node-culprit reading of the
+                # same picture (the caller's "edge evidence" is blast
+                # pointing at it) — the bubble must not let the blast
+                # outrank it.  Spread heat (the edge-locus signature)
+                # exempts nobody, which is what lets sustained
+                # single-plane decoys be demoted where the earlier
+                # sustained-evidence exemption had to protect them.
+                conc_exempt = {v[1] for v in verdicts.values()
+                               if v is not None and v[0] == "concentrated"}
+                # a SUSTAINED-modality service is demotable only under a
+                # positive spread refutation (it is a callee of an
+                # edge-dominant caller whose pair heat is spread); with
+                # no pair data the node-culprit reading stands — absence
+                # of evidence must not demote a real culprit
+                spread_callees: set = set()
+                for p, v in verdicts.items():
+                    if v == ("spread", -1):
+                        spread_callees |= self._callees_of(p)
                 uncorroborated = {
                     s for s in total
                     if s not in edge_dom and not self._self_hot[s]
-                    and s not in direct_node_ev
+                    and s not in conc_exempt
+                    and (s not in direct_node_ev or s in spread_callees)
                     and len(groups.get(s, ())) < 2
                     and "span" not in groups.get(s, ())}
 

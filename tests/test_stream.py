@@ -591,22 +591,31 @@ def test_sharded_edge_attribution_matches_single_chip():
 
 def test_rank_tier_demotes_isolated_single_plane_decoy(monkeypatch):
     """Plane-corroboration reorder (round 5): an edge-dominant caller
-    bubbles above a service whose entire evidence is one flickering
-    non-span plane with no structural tie — while a service with
-    SUSTAINED modality evidence (the node-culprit signature) and the
-    span-plane services keep their magnitude order."""
+    bubbles above services whose entire evidence is a single non-span
+    plane — UNLESS the per-pair concentration discriminator says the
+    caller's heat is blast pointing at one callee, in which case that
+    callee keeps its rank (the node-culprit reading)."""
     import numpy as np
 
     from anomod.replay import ReplayConfig
     from anomod.stream import Alert, MultimodalDetector
 
-    services = ("caller", "decoy", "sustained", "victim")
+    services = ("caller", "decoy", "victim", "other")
     cfg = ReplayConfig(n_services=4, n_windows=16)
-    det = MultimodalDetector(services, cfg, t0_us=0,
-                             call_edges={(0, 3)})
-    det.edge_attribution = True
-    det._self_hot = np.zeros(4, bool)
-    det._edge_hot = {0: 6.0}          # caller is edge-dominant
+
+    def make_det():
+        det = MultimodalDetector(services, cfg, t0_us=0,
+                                 call_edges={(0, 2), (0, 3)})
+        det.edge_attribution = True
+        det._self_hot = np.zeros(4, bool)
+        det._edge_hot = {0: 6.0}          # caller is edge-dominant
+        det.alerts.extend([
+            alert(0, 10, 3.0, "edge"), alert(0, 11, 3.0, "edge"),
+            # single-plane log evidence, louder than the edge z
+            alert(1, 10, 8.0, "log"),
+            alert(2, 10, 9.0, "log"), alert(2, 11, 9.0, "log"),
+        ])
+        return det
 
     def alert(svc, w, score, evidence):
         return Alert(window=w, service=svc, service_name=services[svc],
@@ -614,19 +623,89 @@ def test_rank_tier_demotes_isolated_single_plane_decoy(monkeypatch):
                      evidence=evidence)
 
     monkeypatch.delenv("ANOMOD_RANK_TIER", raising=False)
-    det.alerts.extend([
-        alert(0, 10, 3.0, "edge"), alert(0, 11, 3.0, "edge"),
-        # decoy: single log window, louder than the edge z
-        alert(1, 10, 8.0, "log"),
-        # sustained modality evidence across 2 windows: exempt
-        alert(2, 10, 9.0, "log"), alert(2, 11, 9.0, "log"),
-    ])
+
+    # SPREAD heat across the caller's pairs (the link-fault signature):
+    # every single-plane service is demoted below the caller, sustained
+    # or not — a sustained decoy is observationally identical
+    det = make_det()
+    S = 4
+    det._pair_base = {0 * S + 2: [20.0, 100.0, 0.0],
+                      0 * S + 3: [20.0, 100.0, 0.0]}
+    det._pair_anom = {0 * S + 2: [20.0, 140.0, 2.0],
+                      0 * S + 3: [20.0, 138.0, 2.0]}
     ranked = det.ranked_services()
-    # sustained keeps its magnitude rank; the edge-dominant caller
-    # bubbles above the isolated decoy
-    assert ranked.index("caller") < ranked.index("decoy")
-    assert ranked[0] == "sustained"
-    # with the tier disabled the decoy's raw magnitude wins back its spot
+    assert ranked[0] == "caller", ranked
+
+    # CONCENTRATED heat on one callee (blast pointing at a node
+    # culprit): that callee is exempt and keeps its magnitude rank;
+    # the unrelated decoy is still demoted
+    det = make_det()
+    det._pair_base = {0 * S + 2: [20.0, 100.0, 0.0],
+                      0 * S + 3: [20.0, 100.0, 0.0]}
+    det._pair_anom = {0 * S + 2: [20.0, 170.0, 4.0],
+                      0 * S + 3: [20.0, 101.0, 0.0]}
+    ranked = det.ranked_services()
+    assert ranked[0] == "victim", ranked
+    # the caller yields (explained by the node-borne victim downstream);
+    # explained services rank last by the standing convention, so the
+    # decoy's relative spot vs the caller is not asserted here
+
+    # tier disabled: raw magnitudes win back their spots
     monkeypatch.setenv("ANOMOD_RANK_TIER", "0")
     ranked0 = det.ranked_services()
     assert ranked0.index("decoy") < ranked0.index("caller")
+
+
+def test_pair_accumulators_via_push_drive_verdict():
+    """End-to-end pair plumbing: spans pushed with parent_service land in
+    the right (caller*S+callee) keys with the baseline/anomalous phase
+    split on the frozen t0 grid, and _pair_verdict reads concentration
+    out of them."""
+    import numpy as np
+
+    from anomod.replay import ReplayConfig
+    from anomod.schemas import SpanBatch
+    from anomod.stream import OnlineDetector
+
+    services = ("caller", "c1", "c2")
+    S = 3
+    w_us = 1_000_000
+    cfg = ReplayConfig(n_services=S, n_windows=16, window_us=w_us)
+    det = OnlineDetector(services, cfg, t0_us=0, baseline_windows=4)
+
+    def batch(windows, svc, dur_us):
+        n = len(windows)
+        start = np.asarray(windows, np.int64) * w_us + 1000
+        return SpanBatch(
+            trace=np.zeros(n, np.int32), parent=np.zeros(n, np.int32) - 1,
+            service=np.full(n, svc, np.int32),
+            endpoint=np.zeros(n, np.int32),
+            start_us=start,
+            duration_us=np.full(n, dur_us, np.int64),
+            is_error=np.zeros(n, bool),
+            status=np.full(n, 200, np.int16),
+            kind=np.zeros(n, np.int8),
+            services=services, endpoints=("e",),
+            trace_ids=("t",))
+
+    # baseline phase (windows 0-3): both pairs healthy at 10ms
+    for c in (1, 2):
+        b = batch([0, 0, 0, 1, 1, 2, 2, 3], c, 10_000)
+        det.push(b, parent_service=np.zeros(b.n_spans, np.int32))
+    # anomalous phase: c1's pair heats 20x, c2 stays flat
+    b = batch([8, 8, 8, 9, 9, 10], 1, 200_000)
+    det.push(b, parent_service=np.zeros(b.n_spans, np.int32))
+    b = batch([8, 8, 9, 9, 10, 10], 2, 10_000)
+    det.push(b, parent_service=np.zeros(b.n_spans, np.int32))
+
+    assert set(det._pair_base) == {0 * S + 1, 0 * S + 2}
+    assert det._pair_base[1][0] == 8.0          # n spans in baseline
+    assert det._pair_anom[1][0] == 6.0
+    assert det._pair_verdict(0) == ("concentrated", 1)
+    # heat c2's pair too (strongly enough to overcome its earlier
+    # healthy anomalous-phase spans) -> spread
+    for ws in ([11, 11, 11, 12, 12, 12], [13, 13, 13, 13, 13, 13],
+               [14, 14, 14, 14, 14, 14]):
+        b = batch(ws, 2, 200_000)
+        det.push(b, parent_service=np.zeros(b.n_spans, np.int32))
+    assert det._pair_verdict(0) == ("spread", -1)
