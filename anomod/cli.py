@@ -99,7 +99,7 @@ def main(argv=None) -> int:
         "(anomod.io.live_exec) and write loader-compatible artifacts")
     p_coll.add_argument("kind", choices=["prometheus", "jaeger",
                                          "skywalking", "es", "kube-logs",
-                                         "docker-logs", "jacoco"])
+                                         "docker-logs", "jacoco", "gcov"])
     p_coll.add_argument("--url",
                         help="base URL (prometheus/jaeger/es) or the "
                              "GraphQL endpoint (skywalking); unused by "
@@ -115,6 +115,10 @@ def main(argv=None) -> int:
     p_coll.add_argument("--report-dir", default=None,
                         help="jacoco: coverage_report output tree "
                              "(default: <out>/../coverage_report)")
+    p_coll.add_argument("--mount-root", default="./coverage-reports",
+                        help="gcov: the compose-mounted coverage-reports "
+                             "dir the in-container collect scripts write "
+                             "into (collect_all_data.sh:535)")
     p_coll.add_argument("--out", required=True,
                         help="output dir (prometheus) or artifact file "
                              "path (jaeger/skywalking/es)")
@@ -633,10 +637,11 @@ def main(argv=None) -> int:
         from anomod.io.live import (ElasticsearchClient, HttpTransport,
                                     JaegerClient, PrometheusClient,
                                     SkyWalkingClient)
-        if args.kind in ("kube-logs", "docker-logs", "jacoco"):
+        if args.kind in ("kube-logs", "docker-logs", "jacoco", "gcov"):
             from pathlib import Path as _P
 
             from anomod.io.live_exec import (DockerLogCollector, ExecRunner,
+                                             GcovCoverageCollector,
                                              JacocoCoverageCollector,
                                              KubeLogCollector)
             runner = ExecRunner(timeout=args.timeout)
@@ -648,6 +653,11 @@ def main(argv=None) -> int:
             elif args.kind == "docker-logs":
                 rep = DockerLogCollector(runner=runner).collect(
                     _P(args.out), stamp=stamp, time_range=args.since)
+            elif args.kind == "gcov":
+                out = _P(args.out)
+                rep = GcovCoverageCollector(runner=runner).collect(
+                    _P(args.mount_root), out,
+                    base=args.experiment, stamp=stamp)
             else:
                 out = _P(args.out)
                 report = (_P(args.report_dir) if args.report_dir

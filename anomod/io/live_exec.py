@@ -233,6 +233,93 @@ class DockerLogCollector:
 
 
 # ---------------------------------------------------------------------------
+# SN: gcov flush + in-container collection (collect_all_data.sh:500-560)
+# ---------------------------------------------------------------------------
+
+SN_GCOV_SERVICES: Tuple[str, ...] = tuple(
+    s for s in SN_LOG_SERVICES if s != "nginx-thrift")
+
+
+@dataclasses.dataclass
+class GcovCoverageCollector:
+    """The SN gcov collection loop: SIGUSR1 flush + per-container collect
+    script + host-mounted report pickup.
+
+    Contract (collect_all_data.sh:500-560): every running
+    ``socialnetwork_*service`` container gets ``kill -USR1 1`` (the gcov
+    flush signal), then each service container runs its baked-in
+    ``/usr/local/bin/collect_coverage.sh`` with EXPERIMENT_BASE_NAME /
+    SERVICE_NAME / TIMESTAMP env, writing ``.gcov`` text into the
+    compose-mounted ``coverage-reports/<base>_<stamp>/<service>/``; the
+    host then moves that tree into
+    ``coverage_data/`` where :func:`anomod.io.coverage.load_sn_coverage_dir`
+    reads per-service dirs of ``.gcov`` files."""
+    runner: ExecRunner = dataclasses.field(default_factory=ExecRunner)
+    services: Sequence[str] = SN_GCOV_SERVICES
+    compose_project: str = "socialnetwork"
+
+    def _running(self) -> List[str]:
+        """One ``docker ps`` listing shared by flush + per-service lookup
+        (a wedged daemon must cost one timeout, not one per service)."""
+        r = self.runner.run(["docker", "ps", "--filter",
+                             f"name={self.compose_project}_.*service",
+                             "--format", "{{.Names}}"])
+        return r.stdout.split() if r.returncode == 0 else []
+
+    def _flush(self, running: Sequence[str]) -> int:
+        """SIGUSR1 every running service container; returns the count."""
+        n = 0
+        for cname in running:
+            if self.runner.run(["docker", "exec", cname, "kill", "-USR1",
+                                "1"]).returncode == 0:
+                n += 1
+        return n
+
+    def collect(self, mount_root: Path, out_dir: Path, base: str,
+                stamp: str) -> CollectReport:
+        """Flush, run each container's collect script, then move the
+        host-mounted report tree to its ``coverage_data`` home."""
+        import shutil
+        running = self._running()
+        flushed = self._flush(running)
+        skipped = 0
+        for svc in self.services:
+            # any replica suffix, the same matching the log collector
+            # uses — a service recreated as _2 must still be collected
+            pat = re.compile(
+                rf"^{self.compose_project}_{re.escape(svc)}_\d+$")
+            cname = next((c for c in running if pat.match(c)), None)
+            if cname is None:
+                skipped += 1
+                continue
+            r = self.runner.run(
+                ["docker", "exec",
+                 "-e", f"EXPERIMENT_BASE_NAME={base}",
+                 "-e", f"SERVICE_NAME={svc}",
+                 "-e", f"TIMESTAMP={stamp}",
+                 cname, "/usr/local/bin/collect_coverage.sh"])
+            if r.returncode != 0:
+                skipped += 1
+        src = Path(mount_root) / f"{base}_{stamp}"
+        out_dir = Path(out_dir)
+        files: List[str] = []
+        notes = [f"flushed={flushed}"]
+        if src.is_dir():
+            if out_dir.exists():
+                # moving INTO an existing dir would nest the tree one
+                # level deep — a shape load_sn_coverage_dir cannot read;
+                # degrade loudly instead of corrupting silently
+                notes.append(f"target exists, not moved: {out_dir}")
+            else:
+                out_dir.parent.mkdir(parents=True, exist_ok=True)
+                shutil.move(str(src), str(out_dir))
+                files = [str(p) for p in sorted(out_dir.rglob("*.gcov"))]
+        return CollectReport(kind="gcov_coverage", files=tuple(files),
+                             n_records=len(files), n_skipped=skipped,
+                             notes=tuple(notes))
+
+
+# ---------------------------------------------------------------------------
 # TT: JaCoCo dump + cp loop (collect_coverage_reports.sh:54-101)
 # ---------------------------------------------------------------------------
 

@@ -166,3 +166,57 @@ def test_runner_timeout_degrades_not_raises(cluster):
     r = ExecRunner(timeout=0.001)
     res = r.run(["sleep", "5"])
     assert res.returncode != 0
+
+
+def test_gcov_collection_roundtrips_through_loader(tmp_path):
+    """SN gcov loop against a fake docker: SIGUSR1 flush per container,
+    collect script per service writing into the mounted report tree, the
+    host move, and load_sn_coverage_dir consuming the result."""
+    from anomod.io.live_exec import ExecResult, GcovCoverageCollector
+
+    mount = tmp_path / "coverage-reports"
+    running = {"compose-post-service", "text-service"}
+    flushes = []
+
+    def fake(cmd):
+        joined = " ".join(cmd)
+        if cmd[:2] == ["docker", "ps"]:
+            names = [f"socialnetwork_{s}_1" for s in sorted(running)]
+            return ExecResult(0, "\n".join(names) + "\n")
+        if "kill -USR1 1" in joined:
+            flushes.append(cmd[2])
+            return ExecResult(0)
+        if "collect_coverage.sh" in joined:
+            env = dict(kv.split("=", 1) for kv in cmd[3:-2:2])
+            svc = env["SERVICE_NAME"]
+            d = (mount / f"{env['EXPERIMENT_BASE_NAME']}_"
+                         f"{env['TIMESTAMP']}" / svc)
+            d.mkdir(parents=True, exist_ok=True)
+            covered = 7 if svc == "text-service" else 3
+            lines = [f"        -:    0:Source:src/{svc}.cpp"]
+            for i in range(1, 11):
+                mark = "5" if i <= covered else "#####"
+                lines.append(f"        {mark}:{i:5d}:line {i};")
+            (d / f"src#{svc}.cpp.gcov").write_text("\n".join(lines) + "\n")
+            return ExecResult(0)
+        return ExecResult(1, "", f"unscripted: {joined}")
+
+    col = GcovCoverageCollector(runner=ExecRunner(run_fn=fake))
+    out = tmp_path / "coverage_data" / "Exp_coverage_TS"
+    rep = col.collect(mount, out, base="Exp", stamp="TS")
+    assert rep.kind == "gcov_coverage"
+    assert len(flushes) == 2                # one SIGUSR1 per container
+    assert rep.n_records == 2               # one gcov file per service
+    assert rep.n_skipped == len(col.services) - 2
+    from anomod.io.coverage import load_sn_coverage_dir
+    cb = load_sn_coverage_dir(out)
+    assert cb is not None
+    ratios = dict(zip(cb.services, cb.service_ratio()))
+    assert ratios["text-service"] == pytest.approx(0.7)
+    assert ratios["compose-post-service"] == pytest.approx(0.3)
+    # a second run against the same (now existing) target must degrade
+    # loudly — never nest the tree one level deep or crash
+    rep2 = col.collect(mount, out, base="Exp", stamp="TS")
+    assert rep2.n_records == 0
+    assert any("target exists" in n for n in rep2.notes)
+    assert load_sn_coverage_dir(out) is not None   # first run intact
