@@ -134,7 +134,9 @@ def main(argv=None) -> int:
                              "total trace budget; es: segment budget")
     p_coll.add_argument("--experiment", default="live",
                         help="skywalking: experiment name stamped into "
-                             "the artifact metadata")
+                             "the artifact metadata; gcov: the "
+                             "EXPERIMENT_BASE_NAME forwarded to the "
+                             "in-container collect scripts")
     p_coll.add_argument("--timeout", type=float, default=30.0)
     p_coll.add_argument("--retries", type=int, default=3)
 

@@ -144,6 +144,13 @@ SN_LOG_SERVICES: Tuple[str, ...] = (
     "home-timeline-service", "text-service", "nginx-thrift")
 
 
+def _compose_container_re(project: str, svc: str):
+    """The compose v1 container-name convention
+    (``<project>_<service>_<replica>``) — single source for every
+    collector that locates SN containers."""
+    return re.compile(rf"{re.escape(project)}_{re.escape(svc)}_\d+")
+
+
 def _display_name(svc: str) -> str:
     """compose-post-service -> ComposePostService (collect_log.sh's
     DISPLAY_NAMES table, derived instead of hand-enumerated)."""
@@ -174,8 +181,8 @@ class DockerLogCollector:
                 continue
             cid, cname = parts
             for svc in self.services:
-                if re.search(rf"{self.compose_project}_{re.escape(svc)}_\d+",
-                             cname):
+                if _compose_container_re(self.compose_project,
+                                         svc).search(cname):
                     out[svc] = cid
         return out
 
@@ -284,11 +291,10 @@ class GcovCoverageCollector:
         flushed = self._flush(running)
         skipped = 0
         for svc in self.services:
-            # any replica suffix, the same matching the log collector
-            # uses — a service recreated as _2 must still be collected
-            pat = re.compile(
-                rf"^{self.compose_project}_{re.escape(svc)}_\d+$")
-            cname = next((c for c in running if pat.match(c)), None)
+            # any replica suffix, the same convention the log collector
+            # matches — a service recreated as _2 must still be collected
+            pat = _compose_container_re(self.compose_project, svc)
+            cname = next((c for c in running if pat.fullmatch(c)), None)
             if cname is None:
                 skipped += 1
                 continue

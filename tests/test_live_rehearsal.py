@@ -8,6 +8,10 @@ is byte-compatible with the archive layout — i.e. a user can point the
 collectors at running infra and get a drop-in experiment the offline
 stack consumes unmodified (collect_all_modalities.sh:114-254's promise).
 
+Two flavors — TT (kubernetes/SkyWalking stack) and SN (compose/Jaeger
+stack, test at the bottom: jaeger + prometheus-SN CSV + docker-logs +
+gcov flush/collect + api family).
+
 TT flavor, per modality:
   traces   — SkyWalking GraphQL stub server (from test_live) serving the
              fault experiment's spans; SkyWalkingClient.collect
@@ -192,6 +196,137 @@ def test_live_rehearsal_tt_five_modalities(tmp_path, stub_factory):
     # the detector consumes the collected tree and localizes the culprit
     from anomod import detect
     services = tuple(synth.TT_SERVICES)
+    base_x = detect.extract_features(loaded[normal.experiment], services).x
+    x = detect.extract_features(loaded[fault.experiment], services).x
+    scores = np.asarray(detect.service_scores(x, base_x))
+    top = [services[i] for i in np.argsort(-scores)[:3]]
+    assert fault.target_service in top, (fault.target_service, top)
+
+
+class FakeSNDocker:
+    """docker answers for the SN flavor, derived from one Experiment:
+    per-container logs replay the LogBatch, the gcov collect script
+    writes each service's coverage masks into the mounted report tree."""
+
+    def __init__(self, exp, mount):
+        self.exp = exp
+        self.mount = mount
+        self.containers = {svc: f"c{si:02d}"
+                           for si, svc in enumerate(exp.logs.services)}
+
+    def _log_text(self, svc_idx):
+        from anomod.schemas import LOG_ERROR, LOG_INFO, LOG_WARN
+        lvl_name = {LOG_INFO: "INFO", LOG_WARN: "WARN", LOG_ERROR: "ERROR"}
+        lg = self.exp.logs
+        rows = np.flatnonzero(lg.service == svc_idx)
+        return "".join(
+            f"2026-07-31 13:00:00 {lvl_name.get(int(lg.level[r]), 'DEBUG')} "
+            f"{lg.services[svc_idx]}: handled\n" for r in rows)
+
+    def __call__(self, cmd):
+        from anomod.io.live_exec import ExecResult
+        joined = " ".join(cmd)
+        if cmd[:2] == ["docker", "ps"]:
+            # honor the requested --format, as real docker does: the two
+            # collectors ask for different column sets
+            names_only = "{{.Names}}" == cmd[-1]
+            rows = [(f"socialnetwork_{svc}_1" if names_only
+                     else f"{cid} socialnetwork_{svc}_1")
+                    for svc, cid in self.containers.items()]
+            return ExecResult(0, "\n".join(rows) + "\n")
+        if cmd[:2] == ["docker", "logs"]:
+            cid = cmd[-1]
+            svc_idx = [c for c in self.containers.values()].index(cid)
+            return ExecResult(0, self._log_text(svc_idx))
+        if "kill -USR1 1" in joined:
+            return ExecResult(0)
+        if "collect_coverage.sh" in joined:
+            env = dict(kv.split("=", 1) for kv in cmd[3:-2:2])
+            svc = env["SERVICE_NAME"]
+            cb = self.exp.coverage
+            if svc not in cb.services:
+                return ExecResult(0)
+            d = (self.mount / f"{env['EXPERIMENT_BASE_NAME']}_"
+                              f"{env['TIMESTAMP']}" / svc)
+            d.mkdir(parents=True, exist_ok=True)
+            si = cb.services.index(svc)
+            for row in np.flatnonzero(cb.service == si):
+                path = cb.paths[int(row)]
+                total = int(cb.lines_total[row])
+                covered = int(cb.lines_covered[row])
+                lines = [f"        -:    0:Source:{path}"]
+                for i in range(1, total + 1):
+                    mark = "3" if i <= covered else "#####"
+                    lines.append(f"        {mark}:{i:5d}:l{i};")
+                (d / (path.replace("/", "#") + ".gcov")).write_text(
+                    "\n".join(lines) + "\n")
+            return ExecResult(0)
+        return ExecResult(1, "", f"unscripted: {joined}")
+
+
+def _collect_sn_tree(exp, root, stub_factory):
+    """SN flavor: jaeger + prometheus-SN + docker-logs + gcov + api."""
+    from test_live import _jaeger_stub_route
+
+    from anomod.io.live import JaegerClient
+    from anomod.io.live_exec import (DockerLogCollector, ExecRunner,
+                                     GcovCoverageCollector)
+    ts1, ts2 = "20260731T130000Z", "20260731T130500Z"
+    base = f"{exp.name}_{ts1}"
+    sn = root / "SN_data"
+    tp = HttpTransport(timeout=5.0, sleep=lambda s: None)
+
+    doc = synth.spans_to_jaeger_json(exp.spans)
+    stub = stub_factory(_jaeger_stub_route(doc))
+    tdir = sn / "trace_data" / f"{base}_traces_{ts2}"
+    JaegerClient(stub.base_url, transport=tp).collect_all(
+        tdir / "all_traces.json")
+
+    pstub = stub_factory(_prom_route(None))
+    mdir = sn / "metric_data" / f"{base}_metrics_{ts2}"
+    PrometheusClient(pstub.base_url, transport=tp).write_query_csv(
+        "rate(http_requests_total[1m])", "request_rate", mdir, 0.0, 60.0)
+
+    mount = root / f"mount_{exp.name}"
+    fake = FakeSNDocker(exp, mount)
+    runner = ExecRunner(run_fn=fake)
+    DockerLogCollector(runner=runner).collect(
+        sn / "log_data" / f"{base}_logs_{ts2}", stamp="TS")
+    GcovCoverageCollector(runner=runner).collect(
+        mount, sn / "coverage_data" / f"{base}_coverage_{ts2}",
+        base=base, stamp="TS")
+
+    from anomod.io.api import write_api_artifact_family
+    write_api_artifact_family(
+        exp.api, sn / "api_responses" / f"{base}_openapi_{ts2}")
+
+
+@pytest.mark.slow
+def test_live_rehearsal_sn_five_modalities(tmp_path, stub_factory):
+    fault = labels.label_for("Svc_Kill_Media")
+    normal = next(l for l in labels.labels_for_testbed("SN")
+                  if not l.is_anomaly)
+    exps = {}
+    for label in (normal, fault):
+        exps[label.experiment] = synth.generate_experiment(
+            label, n_traces=80, seed=5)
+        _collect_sn_tree(exps[label.experiment], tmp_path, stub_factory)
+
+    cfg = Config(data_root=tmp_path, synth_on_lfs=False)
+    from anomod.io import dataset
+    from anomod.validate import validate_experiment
+    loaded = {}
+    for name in exps:
+        exp = dataset.load_experiment(name, testbed="SN", cfg=cfg)
+        assert not exp.synthetic, f"synth fallback hit for {name}"
+        for modality in ("spans", "metrics", "logs", "api", "coverage"):
+            assert getattr(exp, modality) is not None, (name, modality)
+        rep = validate_experiment(exp)
+        assert rep.ok, rep
+        loaded[name] = exp
+
+    from anomod import detect
+    services = tuple(synth.SN_SERVICES)
     base_x = detect.extract_features(loaded[normal.experiment], services).x
     x = detect.extract_features(loaded[fault.experiment], services).x
     scores = np.asarray(detect.service_scores(x, base_x))
