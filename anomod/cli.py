@@ -72,7 +72,7 @@ def main(argv=None) -> int:
     p_rca.add_argument("--testbed", choices=["SN", "TT"], default="TT")
     p_rca.add_argument("--model",
                        choices=["gcn", "gat", "sage", "temporal", "lru",
-                                "transformer", "moe"],
+                                "transformer", "moe", "linegraph"],
                        default="gcn")
     p_rca.add_argument("--epochs", type=int, default=300)
     p_rca.add_argument("--train-seeds", type=int, default=6)

@@ -395,8 +395,13 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
     import jax.numpy as jnp
     import optax
 
-    train_samples, services = build_dataset(testbed, train_seeds, n_traces)
-    eval_samples, _ = build_dataset(testbed, eval_seeds, n_traces)
+    # the edge-native model consumes the per-edge feature plane; every
+    # other model keeps the lighter node-only dataset
+    edge_features = model_name == "linegraph"
+    train_samples, services = build_dataset(testbed, train_seeds, n_traces,
+                                            edge_features=edge_features)
+    eval_samples, _ = build_dataset(testbed, eval_seeds, n_traces,
+                                    edge_features=edge_features)
     # pad eval edge arrays to the train E_max (or vice versa)
     E = max(train_samples[0].edge_src.shape[0], eval_samples[0].edge_src.shape[0])
     def repad(samples):
@@ -406,6 +411,9 @@ def train_rca(testbed: str = "TT", model_name: str = "gcn",
                 s.edge_src = np.pad(s.edge_src, (0, E - cur))
                 s.edge_dst = np.pad(s.edge_dst, (0, E - cur))
                 s.edge_mask = np.pad(s.edge_mask, (0, E - cur))
+                if s.edge_x is not None:
+                    s.edge_x = np.pad(s.edge_x,
+                                      ((0, E - cur), (0, 0), (0, 0)))
     repad(train_samples); repad(eval_samples)
     train = _stack([s for s in train_samples])
     evalb = _stack(eval_samples)
