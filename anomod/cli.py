@@ -148,6 +148,31 @@ def main(argv=None) -> int:
                         help="emit the docs/GOLDEN_REPORT.md body instead "
                              "of JSON")
 
+    p_ing = sub.add_parser(
+        "ingest", help="ingest-cache management (anomod.io.cache): warm the "
+        "content-addressed corpus cache before driver benches, report its "
+        "state, or clear it")
+    p_ing.add_argument("--warm-cache", action="store_true",
+                       help="load the full corpus (and the bench.py span "
+                            "corpus) through the cache so later runs are "
+                            "warm")
+    p_ing.add_argument("--testbed", choices=["SN", "TT", "both"],
+                       default="TT")
+    p_ing.add_argument("--traces", type=int, default=200,
+                       help="n_synth_traces for the corpus loaders")
+    p_ing.add_argument("--bench-traces", type=int, default=2_000,
+                       help="n_traces of the bench.py replay corpus to warm "
+                            "(0 skips it; 2000 is bench.py's default)")
+    p_ing.add_argument("--workers", type=int, default=None,
+                       help="process-pool size for the corpus load "
+                            "(default: ANOMOD_INGEST_WORKERS)")
+    p_ing.add_argument("--cache-dir", default=None,
+                       help="override ANOMOD_CACHE_DIR for this invocation")
+    p_ing.add_argument("--data-root", default=None,
+                       help="override ANOMOD_DATA_ROOT for this invocation")
+    p_ing.add_argument("--clear", action="store_true",
+                       help="delete every cache entry first")
+
     p_val = sub.add_parser("validate", help="data-quality validation report "
                            "over a corpus (reference-style embedded checks)")
     p_val.add_argument("--testbed", choices=["SN", "TT"], default="TT")
@@ -709,19 +734,61 @@ def main(argv=None) -> int:
               else json.dumps(report, indent=1))
         return 0
 
+    if args.cmd == "ingest":
+        import dataclasses as _dc
+        import time as _time
+
+        from anomod.config import get_config
+        from anomod.io import cache as ingest_cache
+        from anomod.io import dataset
+        cfg = get_config()
+        from pathlib import Path as _P
+        if args.cache_dir is not None:
+            cfg = _dc.replace(cfg, cache_dir=_P(args.cache_dir))
+        if args.data_root is not None:
+            cfg = _dc.replace(cfg, data_root=_P(args.data_root))
+        root = ingest_cache.cache_root(cfg)
+        out = {"cache_dir": str(root) if root else None}
+        if root is None:
+            print(json.dumps({**out, "error":
+                              "caching disabled (ANOMOD_CACHE_DIR=off)"}))
+            return 1
+        if args.clear:
+            out["cleared"] = ingest_cache.clear(root)
+        if args.warm_cache:
+            ingest_cache.reset_stats()
+            testbeds = (["SN", "TT"] if args.testbed == "both"
+                        else [args.testbed])
+            t0 = _time.perf_counter()
+            for tb in testbeds:
+                dataset.load_corpus(tb, cfg=cfg,
+                                    n_synth_traces=args.traces,
+                                    workers=args.workers)
+                if args.bench_traces:
+                    dataset.load_bench_corpus(tb, args.bench_traces, cfg)
+            out.update(warmed=testbeds,
+                       wall_s=round(_time.perf_counter() - t0, 3),
+                       **ingest_cache.stats().to_dict())
+        out["entries"] = ingest_cache.entry_count(root)
+        print(json.dumps(out))
+        return 0
+
     if args.cmd == "validate":
         from anomod import labels, synth
+        from anomod.io import cache as ingest_cache
         from anomod.io import dataset
-        from anomod.validate import validate_experiment
+        from anomod.validate import corpus_summary, validate_experiment
+        ingest_cache.reset_stats()
         if args.from_data:
             corpus = dataset.load_corpus(args.testbed, n_synth_traces=args.traces)
         else:
             corpus = [synth.generate_experiment(l, n_traces=args.traces)
                       for l in labels.labels_for_testbed(args.testbed)]
-        reports = [validate_experiment(e).to_dict() for e in corpus]
-        print(json.dumps({"testbed": args.testbed,
-                          "ok": all(r["ok"] for r in reports),
-                          "reports": reports}, indent=2))
+        reports = [validate_experiment(e) for e in corpus]
+        print(json.dumps(corpus_summary(
+            args.testbed, reports,
+            cache_stats=(ingest_cache.stats().to_dict()
+                         if args.from_data else None)), indent=2))
         return 0
 
     if args.cmd == "campaign":

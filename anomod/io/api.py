@@ -18,6 +18,10 @@ import numpy as np
 from anomod.io.lfs import is_lfs_pointer
 from anomod.schemas import ApiBatch
 
+#: Ingest-cache key component (anomod.io.cache): bump when this module's
+#: parsing semantics change, invalidating exactly the api entries.
+LOADER_VERSION = 1
+
 
 def _ts(s) -> float:
     if isinstance(s, (int, float)):

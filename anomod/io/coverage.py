@@ -19,6 +19,10 @@ from typing import List, Optional
 from anomod.io.lfs import is_lfs_pointer, read_text_or_none
 from anomod.schemas import CoverageBatch, FileCoverage, coverage_batch_from_files
 
+#: Ingest-cache key component (anomod.io.cache): bump when this module's
+#: parsing semantics change, invalidating exactly the coverage entries.
+LOADER_VERSION = 1
+
 _GCOV_LINE = re.compile(r"^\s*([#\-\d]+[*]?):\s*(\d+):")
 _SUMMARY_TOTAL = re.compile(r"TOTAL\s+Lines\s+(\d+)\s+Cover\s+(\d+)%")
 

@@ -9,18 +9,27 @@ Archive layout (SURVEY.md §2.3 / L7):
 Every payload that is a git-LFS pointer stub falls back to the deterministic
 synthetic generator (config.synth_on_lfs), keeping the full 2x13-experiment
 corpus loadable from the shipped checkout.
+
+Ingest fast path (anomod.io.cache): every parsed or synth-generated modality
+is read through the content-addressed cache — keyed by loader version +
+source-file stat fingerprint (parsed) or generator version + label + seed +
+n_traces (synth) — so warm loads skip CSV/JSON/gcov parsing and synth
+regeneration entirely.  ``load_corpus`` additionally fans experiments across
+a spawn-context process pool (``Config.ingest_workers`` / the ``workers``
+argument); the serial path is kept and parity-tested.
 """
 
 from __future__ import annotations
 
 import dataclasses
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from anomod import labels as labels_mod
 from anomod import synth
 from anomod.config import Config, get_config
 from anomod.io import api as api_io
+from anomod.io import cache
 from anomod.io import coverage as cov_io
 from anomod.io import logs as logs_io
 from anomod.io import metrics as met_io
@@ -35,6 +44,8 @@ _TT_MODALITY_DIRS = {
     "traces": "trace_data", "metrics": "metric_data", "logs": "log_data",
     "api": "api_responses", "coverage": "coverage_report",
 }
+
+MODALITIES = ("traces", "metrics", "logs", "api", "coverage")
 
 
 @dataclasses.dataclass
@@ -65,6 +76,148 @@ def discover(testbed: str, cfg: Optional[Config] = None) -> List[ExperimentDirs]
     return list(found.values())
 
 
+def loader_version(modality: str, testbed: str) -> int:
+    """The owning loader module's LOADER_VERSION — part of the cache key, so
+    bumping one loader invalidates exactly its modality's entries."""
+    if modality == "traces":
+        mod = tt_traces if testbed == "TT" else sn_traces
+    else:
+        mod = {"metrics": met_io, "logs": logs_io, "api": api_io,
+               "coverage": cov_io}[modality]
+    return mod.LOADER_VERSION
+
+
+def _parse_modality(modality: str, testbed: str, d: Path):
+    """Run the raw (uncached) loader for one modality dir.
+
+    Value conventions: ``logs`` yields the ``(LogBatch|None, summaries)``
+    pair; every other modality yields its batch or None.
+    """
+    if modality == "traces":
+        if testbed == "TT":
+            art = tt_traces.find_trace_artifact(d)
+            return tt_traces.load_skywalking_json(art) if art else None
+        art = sn_traces.find_trace_artifact(d)
+        if art and art.suffix == ".json":
+            return sn_traces.load_jaeger_json(art)
+        return sn_traces.load_jaeger_csv(art) if art else None
+    if modality == "metrics":
+        if testbed == "TT":
+            art = met_io.find_tt_metric_artifact(d)
+            return met_io.load_tt_metric_csv(art) if art else None
+        return met_io.load_sn_metric_dir(d)
+    if modality == "logs":
+        loader = (logs_io.load_tt_log_dir if testbed == "TT"
+                  else logs_io.load_sn_log_dir)
+        return loader(d)
+    if modality == "api":
+        art = api_io.find_api_artifact(d)
+        return api_io.load_api_jsonl(art) if art else None
+    if modality == "coverage":
+        loader = (cov_io.load_tt_coverage_report if testbed == "TT"
+                  else cov_io.load_sn_coverage_dir)
+        return loader(d)
+    raise ValueError(f"unknown modality {modality!r}")
+
+
+def _synth_modality(modality: str, label, n_synth_traces: int):
+    if modality == "traces":
+        return synth.generate_spans(label, n_traces=n_synth_traces)
+    if modality == "metrics":
+        return synth.generate_metrics(label)
+    if modality == "logs":
+        return synth.generate_logs(label)
+    if modality == "api":
+        return synth.generate_api(label)
+    if modality == "coverage":
+        return synth.generate_coverage(label)
+    raise ValueError(f"unknown modality {modality!r}")
+
+
+def _cache_kind(modality: str) -> str:
+    return {"traces": "spans", "metrics": "metrics", "logs": "logs",
+            "api": "api", "coverage": "coverage"}[modality]
+
+
+def synth_key_parts(modality: str, label, n_synth_traces: int,
+                    cfg: Config) -> dict:
+    """Cache key parts for a synth-fallback modality: generator version +
+    label (+ n_traces for the trace generator).  The generators derive
+    their seeds from the label name alone (synth._seed_for), so no config
+    seed belongs in the key — it would only manufacture spurious misses."""
+    parts = {
+        "source": "synth",
+        "synth_version": synth.SYNTH_VERSION,
+        "modality": modality,
+        "testbed": label.testbed,
+        "experiment": label.experiment,
+    }
+    if modality == "traces":
+        parts["n_traces"] = n_synth_traces
+    return parts
+
+
+def _source_key_parts(modality: str, testbed: str, experiment: str,
+                      d: Path) -> dict:
+    return {
+        "source": "parse",
+        "loader_version": loader_version(modality, testbed),
+        "modality": modality,
+        "testbed": testbed,
+        "experiment": experiment,
+        "fingerprint": cache.dir_fingerprint(d),
+    }
+
+
+def _modality_present(modality: str, value) -> bool:
+    if modality == "logs":
+        return value is not None and value[0] is not None
+    return value is not None
+
+
+def _load_modality(modality: str, label, testbed: str, d: Optional[Path],
+                   n_synth_traces: int, cfg: Config):
+    """One modality through the cache: parse path first, synth fallback.
+
+    Returns ``(value, synthetic)`` with the logs pair convention.  Parsed
+    results that come back empty are not cached (the parse was cheap);
+    partial logs results (real summaries, no lines) ARE cached.
+    """
+    value = None
+    caching = cache.cache_root(cfg) is not None
+    if d is not None:
+        if caching:
+            def cacheable(v):
+                if modality == "logs":
+                    return v is not None and (v[0] is not None
+                                              or (v[1] or None) is not None)
+                return v is not None
+            value, _, _ = cache.cached(
+                _cache_kind(modality),
+                _source_key_parts(modality, testbed, label.experiment, d),
+                lambda: _parse_modality(modality, testbed, d),
+                cfg=cfg, cacheable=cacheable)
+        else:
+            # no cache root: don't pay the source-fingerprint dir walk
+            # for a key nobody will use
+            value = _parse_modality(modality, testbed, d)
+    if modality == "logs" and value is None:
+        value = (None, None)
+    if _modality_present(modality, value) or not cfg.synth_on_lfs:
+        return value, False
+    syn, _, _ = cache.cached(
+        _cache_kind(modality),
+        synth_key_parts(modality, label, n_synth_traces, cfg),
+        lambda: _synth_modality(modality, label, n_synth_traces),
+        cfg=cfg)
+    if modality == "logs":
+        # keep real summaries when only the line payloads were stubs
+        syn_batch, syn_sum = syn
+        real_sum = value[1]
+        return (syn_batch, real_sum if real_sum else syn_sum), True
+    return syn, True
+
+
 def load_experiment(name: str, testbed: Optional[str] = None,
                     cfg: Optional[Config] = None,
                     modalities: Optional[List[str]] = None,
@@ -75,72 +228,134 @@ def load_experiment(name: str, testbed: Optional[str] = None,
     if label is None:
         raise KeyError(f"unknown experiment: {name}")
     testbed = testbed or label.testbed
-    modalities = modalities or ["traces", "metrics", "logs", "api", "coverage"]
+    modalities = modalities or list(MODALITIES)
     dirs = {e.name: e for e in discover(testbed, cfg)}.get(label.experiment)
     exp = Experiment(name=label.experiment, testbed=testbed)
     any_synth = False
 
     d = dirs.dirs if dirs else {}
-    if "traces" in modalities:
-        if "traces" in d:
-            if testbed == "TT":
-                art = tt_traces.find_trace_artifact(d["traces"])
-                exp.spans = tt_traces.load_skywalking_json(art) if art else None
-            else:
-                art = sn_traces.find_trace_artifact(d["traces"])
-                if art and art.suffix == ".json":
-                    exp.spans = sn_traces.load_jaeger_json(art)
-                elif art:
-                    exp.spans = sn_traces.load_jaeger_csv(art)
-        if exp.spans is None and cfg.synth_on_lfs:
-            exp.spans = synth.generate_spans(label, n_traces=n_synth_traces)
-            any_synth = True
-
-    if "metrics" in modalities:
-        if "metrics" in d:
-            if testbed == "TT":
-                art = met_io.find_tt_metric_artifact(d["metrics"])
-                exp.metrics = met_io.load_tt_metric_csv(art) if art else None
-            else:
-                exp.metrics = met_io.load_sn_metric_dir(d["metrics"])
-        if exp.metrics is None and cfg.synth_on_lfs:
-            exp.metrics = synth.generate_metrics(label)
-            any_synth = True
-
-    if "logs" in modalities:
-        if "logs" in d:
-            loader = logs_io.load_tt_log_dir if testbed == "TT" else logs_io.load_sn_log_dir
-            exp.logs, exp.log_summaries = loader(d["logs"])
-        if exp.logs is None and cfg.synth_on_lfs:
-            exp.logs, syn_sum = synth.generate_logs(label)
-            if not exp.log_summaries:
-                exp.log_summaries = syn_sum
-            any_synth = True
-
-    if "api" in modalities:
-        if "api" in d:
-            art = api_io.find_api_artifact(d["api"])
-            exp.api = api_io.load_api_jsonl(art) if art else None
-        if exp.api is None and cfg.synth_on_lfs:
-            exp.api = synth.generate_api(label)
-            any_synth = True
-
-    if "coverage" in modalities:
-        if "coverage" in d:
-            loader = (cov_io.load_tt_coverage_report if testbed == "TT"
-                      else cov_io.load_sn_coverage_dir)
-            exp.coverage = loader(d["coverage"])
-        if exp.coverage is None and cfg.synth_on_lfs:
-            exp.coverage = synth.generate_coverage(label)
-            any_synth = True
+    for modality in modalities:
+        value, syn = _load_modality(modality, label, testbed,
+                                    d.get(modality), n_synth_traces, cfg)
+        any_synth = any_synth or syn
+        if modality == "traces":
+            exp.spans = value
+        elif modality == "metrics":
+            exp.metrics = value
+        elif modality == "logs":
+            exp.logs, exp.log_summaries = value
+        elif modality == "api":
+            exp.api = value
+        elif modality == "coverage":
+            exp.coverage = value
 
     exp.synthetic = any_synth
     return exp
 
 
+def _load_experiment_task(name: str, testbed: str, cfg: Config,
+                          modalities: Optional[List[str]],
+                          n_synth_traces: int):
+    """Top-level (picklable) worker entry for the process-pool loader.
+
+    Ships the worker's cache-counter snapshot home with the Experiment —
+    the spawn child's module globals never propagate back on their own,
+    and an all-zero report would defeat the hit/miss honesty signal."""
+    cache.reset_stats()
+    exp = load_experiment(name, testbed, cfg, modalities, n_synth_traces)
+    return exp, cache.stats().to_dict()
+
+
 def load_corpus(testbed: str, cfg: Optional[Config] = None,
                 modalities: Optional[List[str]] = None,
-                n_synth_traces: int = 200) -> List[Experiment]:
-    """All 13 experiments of a testbed (12 faults + normal)."""
-    return [load_experiment(l.experiment, testbed, cfg, modalities, n_synth_traces)
-            for l in labels_mod.labels_for_testbed(testbed)]
+                n_synth_traces: int = 200,
+                workers: Optional[int] = None) -> List[Experiment]:
+    """All 13 experiments of a testbed (12 faults + normal).
+
+    ``workers`` (default ``Config.ingest_workers``; 0/1 = serial) fans the
+    per-experiment loads across a spawn-context process pool — spawn, not
+    fork, because the parent may have an initialized JAX backend and the
+    loaders only need numpy.  Cache writes from workers are safe: entries
+    publish atomically and collisions are identical by construction.
+    """
+    cfg = cfg or get_config()
+    names = [l.experiment for l in labels_mod.labels_for_testbed(testbed)]
+    if workers is None:
+        workers = cfg.ingest_workers
+    if workers and workers > 1 and len(names) > 1:
+        import multiprocessing
+        from concurrent.futures import ProcessPoolExecutor
+        ctx = multiprocessing.get_context("spawn")
+        with ProcessPoolExecutor(max_workers=min(workers, len(names)),
+                                 mp_context=ctx) as pool:
+            futs = [pool.submit(_load_experiment_task, n, testbed, cfg,
+                                modalities, n_synth_traces) for n in names]
+            out = []
+            for f in futs:
+                exp, worker_stats = f.result()
+                cache.merge_stats(worker_stats)
+                out.append(exp)
+            return out
+    return [load_experiment(n, testbed, cfg, modalities, n_synth_traces)
+            for n in names]
+
+
+# ---------------------------------------------------------------------------
+# Bench ingest helpers — the corpus bench.py replays, read through the cache
+# at the CONCATENATED level: one entry per (testbed, n_traces), so the warm
+# path is a single bulk columnar read with no per-label re-intern concat.
+# ---------------------------------------------------------------------------
+
+def bench_corpus_key_parts(testbed: str, n_traces: int,
+                           cfg: Optional[Config] = None) -> dict:
+    cfg = cfg or get_config()
+    return {
+        "source": "synth-corpus",
+        "synth_version": synth.SYNTH_VERSION,
+        "testbed": testbed,
+        "n_traces": n_traces,
+        "experiments": [l.experiment
+                        for l in labels_mod.labels_for_testbed(testbed)],
+    }
+
+
+def load_bench_corpus(testbed: str, n_traces: int,
+                      cfg: Optional[Config] = None):
+    """The concatenated bench replay corpus, read through the cache.
+
+    Returns ``(SpanBatch, info)`` where ``info`` carries the honest
+    cold-vs-warm accounting: ``parse_s`` is the recorded cold
+    generate+concat wall (measured now on a miss, read from the entry on a
+    hit), so the cold number survives even when the batch came warm.
+    """
+    cfg = cfg or get_config()
+    import time as _time
+    from anomod.schemas import concat_span_batches
+
+    def compute():
+        return concat_span_batches(
+            [synth.generate_spans(l, n_traces=n_traces)
+             for l in labels_mod.labels_for_testbed(testbed)])
+
+    t0 = _time.perf_counter()
+    batch, hit, meta = cache.cached(
+        "spans", bench_corpus_key_parts(testbed, n_traces, cfg),
+        compute, cfg=cfg)
+    info = {"cache_hit": hit,
+            "parse_s": float(meta.get("parse_s", 0.0)),
+            "load_s": _time.perf_counter() - t0,
+            "n_experiments": len(labels_mod.labels_for_testbed(testbed))}
+    return batch, info
+
+
+def bench_cache_status(testbed: str, n_traces: int,
+                       cfg: Optional[Config] = None) -> Tuple[int, int]:
+    """(present, total) bench-corpus cache entries — the pre-bench gate's
+    cold/warm check, without loading anything."""
+    cfg = cfg or get_config()
+    root = cache.cache_root(cfg)
+    if root is None:
+        return 0, 1
+    key = cache.full_key("spans",
+                         bench_corpus_key_parts(testbed, n_traces, cfg))
+    return (1 if cache.entry_paths(root, key)[0].is_file() else 0), 1

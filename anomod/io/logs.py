@@ -22,6 +22,10 @@ from anomod.io.lfs import is_lfs_pointer, read_text_or_none
 from anomod.schemas import (LOG_ERROR, LOG_INFO, LOG_OTHER, LOG_WARN, LogBatch,
                             LogSummary)
 
+#: Ingest-cache key component (anomod.io.cache): bump when this module's
+#: parsing semantics change, invalidating exactly the log entries.
+LOADER_VERSION = 1
+
 # "- ComposePostService: 124K (1001行) - 错误: 200, ..." or
 # "- ComposePostService: 124K (1001 lines) | errors=200, warnings=0, ..."
 _SUMMARY_LINE = re.compile(

@@ -21,6 +21,10 @@ import numpy as np
 from anomod.io.lfs import is_lfs_pointer
 from anomod.schemas import MetricBatch
 
+#: Ingest-cache key component (anomod.io.cache): bump when this module's
+#: parsing semantics change, invalidating exactly the metric entries.
+LOADER_VERSION = 1
+
 _SERVICE_LABELS = ("service", "name", "pod", "container", "app")
 
 

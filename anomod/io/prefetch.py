@@ -1,0 +1,136 @@
+"""Double-buffered host→device staging for the replay/stream hot paths.
+
+The input-bound pattern the GNN-DSA paper (PAPERS.md) attacks, applied to
+the corpus pipeline: while the jitted replay/stream dispatch consumes chunk
+``i`` on device, a background thread is already pushing chunk ``i+1``
+through ``jax.device_put`` — so the accelerator never waits on host-side
+chunk prep, and host packing of column ``j+1`` overlaps the H2D copy of
+column ``j`` during whole-corpus staging.
+
+Host-only consumers never import jax through this module: the device put is
+resolved lazily inside the worker thread, and :class:`Pipeline` itself is a
+generic bounded producer/consumer usable with any staging function.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
+
+import numpy as np
+
+_SENTINEL = object()
+
+
+class Pipeline:
+    """Bounded background-staging iterator (the double buffer).
+
+    A worker thread pulls items from ``iterable``, applies ``fn`` (the
+    staging step — typically ``jax.device_put``), and parks at most
+    ``depth`` staged results in a queue; the consumer iterates the staged
+    results in order.  ``depth=2`` is classic double buffering: one item
+    in flight on the device, one staged ahead.  Worker exceptions are
+    re-raised in the consumer.  A consumer that stops early (break,
+    exception) MUST call :meth:`close` — a ``finally`` block at every
+    in-repo call site — or the worker stays parked on the bounded queue
+    holding staged buffers; a dropped Pipeline makes a best-effort
+    ``close`` from ``__del__`` as a backstop.
+    """
+
+    def __init__(self, iterable: Iterable[Any],
+                 fn: Callable[[Any], Any], depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._err: Optional[BaseException] = None
+
+        def work():
+            try:
+                for item in iterable:
+                    if self._stop.is_set():
+                        return
+                    self._q.put(fn(item))
+            except BaseException as e:       # re-raised on the consumer side
+                self._err = e
+            finally:
+                self._q.put(_SENTINEL)
+
+        self._thread = threading.Thread(target=work, daemon=True,
+                                        name="anomod-prefetch")
+        self._thread.start()
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is _SENTINEL:
+            self._done = True
+            self._thread.join()
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Stop the worker and drain; safe to call more than once.
+        Free after normal exhaustion (the sentinel was already seen)."""
+        if self._done:
+            return
+        self._stop.set()
+        while True:
+            try:
+                if self._q.get(timeout=0.05) is _SENTINEL:
+                    break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    break
+        self._done = True
+        self._thread.join(timeout=5.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _device_put(x):
+    import jax
+    return jax.device_put(x)
+
+
+def prefetch_to_device(iterable: Iterable[Any], depth: int = 2,
+                       put: Optional[Callable[[Any], Any]] = None) -> Pipeline:
+    """Stage each item to device in a background thread, ``depth`` ahead."""
+    return Pipeline(iterable, put or _device_put, depth=depth)
+
+
+def iter_chunk_dicts(chunks: Dict[str, np.ndarray]) -> Iterator[Dict[str, Any]]:
+    """Per-chunk row dicts from stage_columns' stacked [n_chunks, C] arrays."""
+    n_chunks = next(iter(chunks.values())).shape[0]
+    for i in range(n_chunks):
+        yield {k: v[i] for k, v in chunks.items()}
+
+
+def device_put_columns(columns: Dict[str, np.ndarray],
+                       depth: int = 2) -> Dict[str, Any]:
+    """Stage a column dict to device with per-column transfer overlap.
+
+    Columns are put one at a time from the background thread while the
+    consumer collects the previous ones — on real hardware this overlaps
+    the H2D copy of column ``j`` with the dispatch bookkeeping of ``j+1``;
+    on CPU backends it degrades to a plain device_put loop.
+    """
+    staged = prefetch_to_device(
+        list(columns.items()), depth=depth,
+        put=lambda kv: (kv[0], _device_put(kv[1])))
+    try:
+        return dict(staged)
+    finally:
+        staged.close()

@@ -21,6 +21,10 @@ from anomod.io.lfs import is_lfs_pointer
 from anomod.schemas import (KIND_ENTRY, KIND_EXIT, KIND_LOCAL, SpanBatch,
                             empty_span_batch)
 
+#: Ingest-cache key component (anomod.io.cache): bump when this module's
+#: parsing semantics change, invalidating exactly the SN trace entries.
+LOADER_VERSION = 1
+
 _JKIND = {"server": KIND_ENTRY, "client": KIND_EXIT, "consumer": KIND_ENTRY,
           "producer": KIND_EXIT}
 

@@ -22,6 +22,10 @@ from anomod.io.lfs import is_lfs_pointer
 from anomod.schemas import (KIND_ENTRY, KIND_EXIT, KIND_LOCAL, SpanBatch,
                             empty_span_batch)
 
+#: Ingest-cache key component (anomod.io.cache): bump when this module's
+#: parsing semantics change, invalidating exactly the TT trace entries.
+LOADER_VERSION = 1
+
 _KIND = {"Entry": KIND_ENTRY, "Exit": KIND_EXIT, "Local": KIND_LOCAL}
 
 

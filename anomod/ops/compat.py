@@ -1,0 +1,13 @@
+"""Version shims for the moving pallas API surface (ops-side analog of
+anomod.parallel.mesh's shard_map/pvary shims)."""
+
+from __future__ import annotations
+
+
+def tpu_compiler_params(**kwargs):
+    """pltpu compiler params across the rename (``CompilerParams`` in newer
+    jax, ``TPUCompilerParams`` before)."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)

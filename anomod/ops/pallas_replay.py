@@ -35,6 +35,10 @@ Falls back to interpret mode off-TPU (used by the CPU-mesh tests).
 
 from __future__ import annotations
 
+
+from anomod.ops.compat import tpu_compiler_params as _compiler_params
+
+
 import numpy as np
 
 # staged-column order fed to the kernel (matches anomod.replay plane order:
@@ -128,7 +132,7 @@ def make_pallas_replay_fn(n_segments: int, n_hist: int = 16,
             ],
             out_specs=pl.BlockSpec((ROWS, SW1), lambda r, i: (0, 0)),
             out_shape=jax.ShapeDtypeStruct((ROWS, SW1), jnp.float32),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("arbitrary", "arbitrary")),
             interpret=interpret,
         )(sid, planes)
@@ -251,7 +255,7 @@ def make_pallas_replay_sorted_fn(n_segments: int, n_hist: int = 16,
                 out_specs=pl.BlockSpec((ROWS, NWK), lambda r, i, w: (0, 0)),
             ),
             out_shape=jax.ShapeDtypeStruct((ROWS, NWK), jnp.float32),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("arbitrary", "arbitrary")),
             interpret=interpret,
         )(wids, sid_local, planes)

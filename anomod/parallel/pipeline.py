@@ -136,8 +136,9 @@ def make_pipeline_forward(mesh, cfg: PipelineConfig, S: int, W: int):
         mask = (idx == n_stages - 1).astype(micro.dtype)
         return lax.psum(out * mask, AXIS)
 
-    pipe = jax.shard_map(pipeline_local, mesh=mesh,
-                         in_specs=(P(AXIS), P()), out_specs=P())
+    from anomod.parallel.mesh import shard_map_compat
+    pipe = shard_map_compat(pipeline_local, mesh=mesh,
+                            in_specs=(P(AXIS), P()), out_specs=P())
 
     def _embed_all(params, x):
         return jax.vmap(lambda xi: embed.apply(params["embed"], xi))(x)

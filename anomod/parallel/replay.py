@@ -100,7 +100,7 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
                            hist=jax.lax.psum(state.hist, axis),
                            hll=hll)
 
-    from jax import shard_map
+    from anomod.parallel.mesh import shard_map_compat
     # the pallas kernel's internal constants (iota tiles, zero-init) carry
     # no mesh varying-axes metadata, so shard_map's static vma checker
     # rejects the mix unconditionally (interpret or compiled, with or
@@ -109,13 +109,14 @@ def make_sharded_replay_fn(cfg: ReplayConfig, mesh, axis: str = "data",
     # static checker is off for this variant
     kwargs = {"check_vma": False} if kernel == "pallas" else {}
     state_spec = P(axis) if merge == "scattered" else P()
-    fn = shard_map(shard_body, mesh=mesh,
-                   in_specs=({k: P(axis) for k in
-                              ("sid", "dur", "dur_raw", "err", "s5", "valid",
-                               "tid")},),
-                   out_specs=ReplayState(agg=state_spec, hist=state_spec,
-                                         hll=P() if with_hll else None),
-                   **kwargs)
+    fn = shard_map_compat(
+        shard_body, mesh=mesh,
+        in_specs=({k: P(axis) for k in
+                   ("sid", "dur", "dur_raw", "err", "s5", "valid",
+                    "tid")},),
+        out_specs=ReplayState(agg=state_spec, hist=state_spec,
+                              hll=P() if with_hll else None),
+        **kwargs)
     return jax.jit(fn)
 
 

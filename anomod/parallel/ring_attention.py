@@ -90,7 +90,8 @@ def make_sharded_attention(local_fn, mesh, axis: str = "data"):
 
     @functools.partial(jax.jit, out_shardings=NamedSharding(mesh, spec))
     def attend(q, k, v):
-        fn = jax.shard_map(
+        from anomod.parallel.mesh import shard_map_compat
+        fn = shard_map_compat(
             functools.partial(local_fn, axis_name=axis),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)

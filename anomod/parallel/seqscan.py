@@ -46,8 +46,9 @@ def make_seqpar_recurrence(mesh, axis: str = "data"):
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from anomod.parallel.mesh import shard_map_compat
 
     n_dev = mesh.shape[axis]
 
@@ -82,7 +83,7 @@ def make_seqpar_recurrence(mesh, axis: str = "data"):
         corr = (a[None] ** t_idx) * carry_in[None]
         return h_local + corr
 
-    fn = shard_map(body, mesh=mesh,
+    fn = shard_map_compat(body, mesh=mesh,
                    in_specs=(P(axis), P()),
                    out_specs=P(axis))
     return jax.jit(fn)

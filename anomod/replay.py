@@ -538,9 +538,11 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
                          ) * replicate
     elif kernel == "pallas":
         import jax
+        from anomod.io.prefetch import device_put_columns
         from anomod.ops.pallas_replay import make_pallas_replay_fn
         sid_np, planes_np = stage_pallas_planes(chunks_np)
-        sid, planes = jax.device_put(sid_np), jax.device_put(planes_np)
+        staged = device_put_columns({"sid": sid_np, "planes": planes_np})
+        sid, planes = staged["sid"], staged["planes"]
         # off-TPU backends can't execute Mosaic — run the kernel's
         # interpret path so this branch stays testable on the CPU mesh
         interpret = jax.devices()[0].platform != "tpu"
@@ -561,9 +563,11 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
         # windows so the kernel's one-hot is 128 lanes wide, not SW+1
         sid_l, planes_s, wids = stage_sorted_planes(
             sid_np, planes_np, cfg.sw, block=block)
-        sid_d = jax.device_put(sid_l)
-        planes_d = jax.device_put(planes_s)
-        wids_d = jax.device_put(wids)
+        from anomod.io.prefetch import device_put_columns
+        staged = device_put_columns(
+            {"sid": sid_l, "planes": planes_s, "wids": wids})
+        sid_d, planes_d, wids_d = (staged["sid"], staged["planes"],
+                                   staged["wids"])
         interpret = jax.devices()[0].platform != "tpu"
         pfn = make_pallas_replay_sorted_fn(cfg.sw, cfg.n_hist_buckets,
                                            block=block,
@@ -573,8 +577,11 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
             agg = np.asarray(pfn(sid_d, planes_d, wids_d))
             return float(agg[:, F_COUNT].astype(np.float64).sum())
     else:
-        import jax
-        chunks = jax.device_put(chunks_np)
+        import jax  # noqa: F401 — backend init before the staged puts
+        # double-buffered staging (anomod.io.prefetch): the H2D copy of
+        # column j overlaps the enqueue of column j+1
+        from anomod.io.prefetch import device_put_columns
+        chunks = device_put_columns(chunks_np)
         xfn = make_replay_fn(cfg, inner_repeats=replicate)
         def run_once():
             agg = np.asarray(xfn(chunks).agg)

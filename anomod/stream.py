@@ -255,9 +255,17 @@ class StreamReplay:
             self._roll(w_need - (self.cfg.n_windows - 1))
             w_need = self.cfg.n_windows - 1
         chunks, n = stage_columns(batch, self.cfg, t0_us=self.t0_us)
-        for i in range(next(iter(chunks.values())).shape[0]):
-            self.state = self._step(self.state,
-                                    {k: v[i] for k, v in chunks.items()})
+        # double-buffered host→device staging (anomod.io.prefetch): chunk
+        # i+1 transfers while the jitted step on chunk i is in flight
+        from anomod.io.prefetch import iter_chunk_dicts, prefetch_to_device
+        pipe = prefetch_to_device(iter_chunk_dicts(chunks))
+        try:
+            for staged in pipe:
+                self.state = self._step(self.state, staged)
+        finally:
+            # a consumer-side error must not leave the worker parked on
+            # the bounded queue holding staged device buffers
+            pipe.close()
         self.n_spans += n
         return self.window_offset + max(w_need, 0)
 

@@ -42,6 +42,11 @@ from anomod.schemas import (
     MetricBatch, SpanBatch, coverage_batch_from_files,
 )
 
+#: Ingest-cache key component (anomod.io.cache) for synth-fallback entries:
+#: bump whenever generator output changes for the same (label, seed,
+#: n_traces), invalidating every cached synthetic modality.
+SYNTH_VERSION = 1
+
 # ---------------------------------------------------------------------------
 # Service topologies.
 # SN: the 12 core services of DeathStarBench SocialNetwork

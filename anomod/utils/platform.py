@@ -174,16 +174,25 @@ def pin_cpu(n_devices: int = 1) -> None:
     device-touching call to avoid the dead-tunnel hang.
     """
     global _PINNED
+    import re
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+    want = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       want, flags)
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
     os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
     from jax.extend.backend import clear_backends
     clear_backends()
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        # older jax: no such option — the XLA_FLAGS host-platform count
+        # (set above, read at the post-clear_backends re-init) is the knob
+        pass
     _PINNED = True

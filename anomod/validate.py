@@ -158,3 +158,21 @@ def validate_experiment(exp: Experiment) -> ValidationReport:
     return ValidationReport(experiment=exp.name, testbed=exp.testbed,
                             synthetic=exp.synthetic, counts=counts,
                             issues=issues)
+
+
+def corpus_summary(testbed: str, reports: List[ValidationReport],
+                   cache_stats: Optional[dict] = None) -> dict:
+    """The corpus-level validation document the CLI emits.
+
+    ``cache_stats`` (anomod.io.cache hit/miss/store/error counters for the
+    load that produced the corpus) rides along when the corpus came from
+    the archived tree — an all-miss load on a supposedly warm cache is
+    itself a data-pipeline quality signal worth surfacing."""
+    out = {
+        "testbed": testbed,
+        "ok": all(r.ok for r in reports),
+        "reports": [r.to_dict() for r in reports],
+    }
+    if cache_stats is not None:
+        out["ingest_cache"] = dict(cache_stats)
+    return out

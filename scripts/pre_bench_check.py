@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Pre-bench ingest gate: refuse a capture on a cold cache unless --cold.
+
+A throughput capture taken against a cold ingest cache silently folds host
+synth/parse time into the session (and, before the cache, re-measured it on
+every invocation) — the recorded kernel numbers stop being comparable.
+This gate is the scripts/ hook a driver runs before ``python bench.py``:
+
+    python scripts/pre_bench_check.py            # exit 0 iff cache is warm
+    python scripts/pre_bench_check.py --cold     # cold capture, on purpose
+
+Exit codes: 0 = warm (or --cold / caching disabled is explicit), 1 = cold
+cache without --cold, 2 = caching disabled without --cold.  Always prints
+one JSON line describing the decision.  ``--traces`` must match the bench
+invocation's span count (the cache key includes it).
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--testbed", choices=["SN", "TT"], default="TT")
+    ap.add_argument("--traces", type=int, default=2_000,
+                    help="bench.py span corpus size (default matches "
+                         "bench.py's argv default)")
+    ap.add_argument("--cold", action="store_true",
+                    help="allow the capture anyway; the bench line still "
+                         "records cache_hit=false for honesty")
+    args = ap.parse_args(argv)
+
+    from anomod.io import cache
+    from anomod.io.dataset import bench_cache_status
+
+    root = cache.cache_root()
+    out = {"check": "pre_bench_ingest", "testbed": args.testbed,
+           "traces": args.traces,
+           "cache_dir": str(root) if root else None,
+           "cold_ok": bool(args.cold)}
+    if root is None:
+        out["status"] = "caching-disabled"
+        print(json.dumps(out))
+        if args.cold:
+            return 0
+        print("pre_bench_check: ANOMOD_CACHE_DIR is disabled — captures "
+              "would re-synthesize the corpus every run; pass --cold to "
+              "record one anyway", file=sys.stderr)
+        return 2
+    present, total = bench_cache_status(args.testbed, args.traces)
+    out.update(entries_present=present, entries_total=total,
+               status="warm" if present == total else "cold")
+    print(json.dumps(out))
+    if present == total or args.cold:
+        return 0
+    print(f"pre_bench_check: ingest cache at {root} is cold for the "
+          f"{args.testbed}/{args.traces}-trace bench corpus — run "
+          f"`anomod ingest --warm-cache --bench-traces {args.traces}` "
+          "first, or pass --cold to capture an ingest-bound number on "
+          "purpose", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,8 @@ def test_bench_cpu_fallback_contract(tmp_path):
     env["ANOMOD_BENCH_KERNEL"] = "pallas"
     # keep the provenance record out of the repo's bench_runs/
     env["ANOMOD_BENCH_RUNS_DIR"] = str(tmp_path / "runs")
+    # fresh ingest cache: the run must be cold-then-self-warming
+    env["ANOMOD_CACHE_DIR"] = str(tmp_path / "cache")
     # small corpus keeps the fallback fast; the platform pin bypasses the
     # subprocess backend probe entirely
     r = subprocess.run(
@@ -37,6 +39,15 @@ def test_bench_cpu_fallback_contract(tmp_path):
     # median-of-N: the recorded wall is the median of >=3 raw repeats
     assert len(out["raw_wall_s"]) >= 3
     assert out["wall_s"] == sorted(out["raw_wall_s"])[len(out["raw_wall_s"]) // 2]
+    # ingest split: a fresh cache dir means a cold first load, an honest
+    # recorded parse_s, and a warm-vs-cold throughput metric in the line
+    assert out["cache_hit"] is False
+    assert out["parse_s"] > 0
+    tp = out["tt_ingest_throughput"]
+    assert tp["unit"] == "experiments/sec"
+    assert tp["warm"] > 0 and tp["cold"] > 0
+    assert tp["speedup"] > 1.0, \
+        "warm columnar read must beat cold synth+concat"
     # provenance record: committed-capture schema with device + versions + SHA
     runs = list((tmp_path / "runs").glob("*.json"))
     assert len(runs) == 1
@@ -56,6 +67,7 @@ def test_bench_replicate_override_contract(tmp_path):
     base = dict(os.environ)
     base["ANOMOD_BENCH_PLATFORM"] = "cpu"
     base["ANOMOD_BENCH_RUNS_DIR"] = str(tmp_path / "runs")
+    base["ANOMOD_CACHE_DIR"] = str(tmp_path / "cache")
 
     env = dict(base, ANOMOD_BENCH_REPLICATE="7")
     r = subprocess.run(
