@@ -321,6 +321,51 @@ def main(argv=None) -> int:
                                "spans_per_sec comparability with those "
                                "records) at the cost of edge-locus RCA")
 
+    p_serve = sub.add_parser(
+        "serve", help="multi-tenant serving plane: admission control + "
+        "dynamic micro-batching + SLO-aware load shedding over the "
+        "streaming detectors, driven by a seeded power-law tenant fleet "
+        "on a deterministic virtual clock (anomod.serve)")
+    p_serve.add_argument("--tenants", type=int, default=200)
+    p_serve.add_argument("--services", type=int, default=8)
+    p_serve.add_argument("--duration", type=float, default=120.0,
+                         help="virtual seconds to serve")
+    p_serve.add_argument("--tick", type=float, default=1.0,
+                         help="virtual scheduler tick (seconds)")
+    p_serve.add_argument("--capacity", type=float, default=20_000.0,
+                         help="serving capacity in spans/sec")
+    p_serve.add_argument("--overload", type=float, default=1.0,
+                         help="offered load as a multiple of capacity "
+                              "(2.0 = the bench's shed regime)")
+    p_serve.add_argument("--alpha", type=float, default=1.2,
+                         help="power-law exponent of the tenant rate "
+                              "distribution (0 = equal rates)")
+    p_serve.add_argument("--seed", type=int, default=0)
+    p_serve.add_argument("--window-seconds", type=float, default=5.0,
+                         help="detector window width on the virtual clock")
+    p_serve.add_argument("--baseline-windows", type=int, default=4)
+    p_serve.add_argument("--threshold", type=float, default=4.0)
+    p_serve.add_argument("--buckets", default=None,
+                         help="comma-separated micro-batch bucket widths "
+                              "(default: ANOMOD_SERVE_BUCKETS)")
+    p_serve.add_argument("--max-backlog", type=int, default=None,
+                         help="global backlog bound in spans "
+                              "(default: ANOMOD_SERVE_MAX_BACKLOG)")
+    p_serve.add_argument("--fault-tenants", type=int, default=2,
+                         help="tenants given a scripted latency fault at "
+                              "mid-run (alert latency under load)")
+    p_serve.add_argument("--no-score", action="store_true",
+                         help="replay-plane only (skip per-tenant window "
+                              "scoring) — isolates the serving overhead")
+    p_serve.add_argument("--devices", type=int, default=0,
+                         help="serve over an N-device mesh plane "
+                              "(ShardedStreamReplay per tenant; use "
+                              "ANOMOD_PLATFORM=cpu + ANOMOD_CPU_DEVICES=N "
+                              "for a virtual mesh)")
+    p_serve.add_argument("--trace-out", default=None,
+                         help="dump the engine's own Jaeger-shaped trace "
+                              "(anomod.utils.tracing.Tracer)")
+
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
         "fault severity with noise + confounders (HardMode)")
@@ -563,6 +608,56 @@ def main(argv=None) -> int:
         print(json.dumps(out, indent=2))
         return 0
 
+    if args.cmd == "serve":
+        if args.tenants < 1:
+            parser.error("--tenants must be >= 1")
+        if args.services < 1:
+            parser.error("--services must be >= 1")
+        if args.capacity <= 0:
+            parser.error("--capacity must be positive")
+        if args.tick <= 0:
+            parser.error("--tick must be positive")
+        if args.window_seconds <= 0:
+            parser.error("--window-seconds must be positive")
+        if args.overload <= 0:
+            parser.error("--overload must be positive")
+        if args.fault_tenants < 0:
+            parser.error("--fault-tenants must be >= 0")
+        _probe_backend(args)
+        from anomod.serve.batcher import validate_buckets
+        from anomod.serve.engine import run_power_law
+        buckets = None
+        if args.buckets is not None:
+            try:
+                buckets = validate_buckets(
+                    [p.strip() for p in args.buckets.split(",")
+                     if p.strip()])
+            except ValueError as e:
+                parser.error(f"--buckets: {e}")
+        mesh = None
+        if args.devices:
+            from anomod.parallel import make_mesh
+            mesh = make_mesh(args.devices)
+        tracer = None
+        if args.trace_out:
+            from anomod.utils.tracing import Tracer
+            tracer = Tracer("anomod-serve")
+        _, report = run_power_law(
+            n_tenants=args.tenants, n_services=args.services,
+            capacity_spans_per_s=args.capacity, overload=args.overload,
+            duration_s=args.duration, tick_s=args.tick, seed=args.seed,
+            alpha=args.alpha, window_s=args.window_seconds,
+            baseline_windows=args.baseline_windows,
+            z_threshold=args.threshold, buckets=buckets,
+            max_backlog=args.max_backlog,
+            fault_tenants=args.fault_tenants, score=not args.no_score,
+            mesh=mesh, tracer=tracer)
+        if tracer is not None:
+            from pathlib import Path as _P
+            tracer.dump(_P(args.trace_out))
+        print(json.dumps(report.to_dict(), indent=2))
+        return 0
+
     if args.cmd == "quality":
         import dataclasses as _dc
 
@@ -785,10 +880,18 @@ def main(argv=None) -> int:
             corpus = [synth.generate_experiment(l, n_traces=args.traces)
                       for l in labels.labels_for_testbed(args.testbed)]
         reports = [validate_experiment(e) for e in corpus]
+        cache_stats = None
+        if args.from_data:
+            # a fresh/empty cache dir (or one the counters can't be read
+            # from) must degrade to zero counters, never crash the
+            # validation report — the counters are a quality SIGNAL, not
+            # a load-bearing dependency
+            try:
+                cache_stats = ingest_cache.stats().to_dict()
+            except Exception:
+                cache_stats = ingest_cache.CacheStats().to_dict()
         print(json.dumps(corpus_summary(
-            args.testbed, reports,
-            cache_stats=(ingest_cache.stats().to_dict()
-                         if args.from_data else None)), indent=2))
+            args.testbed, reports, cache_stats=cache_stats), indent=2))
         return 0
 
     if args.cmd == "campaign":

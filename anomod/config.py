@@ -63,6 +63,62 @@ def _ingest_workers_env() -> int:
     return n
 
 
+#: default serving-plane micro-batch bucket widths (spans) — one XLA
+#: compile per width (anomod.serve.batcher re-exports this and the
+#: validator below as its contract; they live HERE so Config()
+#: construction never pays the serve/stream import chain).
+DEFAULT_SERVE_BUCKETS = (256, 1024, 4096, 16384)
+
+
+def validate_serve_buckets(buckets) -> tuple:
+    """The one bucket-set contract: positive, strictly ascending ints."""
+    try:
+        out = tuple(int(b) for b in buckets)
+    except (TypeError, ValueError):
+        raise ValueError(f"bucket set must be integers, got {buckets!r}")
+    if not out:
+        raise ValueError("bucket set must not be empty")
+    if any(b < 1 for b in out):
+        raise ValueError(f"bucket widths must be >= 1, got {out}")
+    if any(b >= c for b, c in zip(out, out[1:])):
+        raise ValueError(f"bucket widths must be strictly ascending: {out}")
+    return out
+
+
+def _serve_buckets_env() -> tuple:
+    """ANOMOD_SERVE_BUCKETS: comma-separated micro-batch bucket widths
+    (spans) for the serving plane's dynamic batcher.
+
+    Validated at config construction (positive, strictly ascending ints)
+    so a typo'd bucket set fails loudly instead of compiling garbage
+    shapes mid-serve.
+    """
+    raw = _env("ANOMOD_SERVE_BUCKETS", "")
+    if not raw:
+        return DEFAULT_SERVE_BUCKETS
+    parts = [p.strip() for p in raw.split(",") if p.strip()]
+    try:
+        return validate_serve_buckets(parts)
+    except ValueError as e:
+        raise ValueError(f"ANOMOD_SERVE_BUCKETS: {e}") from e
+
+
+def _serve_max_backlog_env() -> int:
+    """ANOMOD_SERVE_MAX_BACKLOG: global admission backlog bound (spans) —
+    the serving plane's backpressure/shed budget."""
+    raw = _env("ANOMOD_SERVE_MAX_BACKLOG", "200000")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_MAX_BACKLOG must be a positive integer, "
+            f"got {raw!r}")
+    if n < 1:
+        raise ValueError(
+            f"ANOMOD_SERVE_MAX_BACKLOG must be >= 1, got {n}")
+    return n
+
+
 @dataclasses.dataclass(frozen=True)
 class Config:
     """Global framework configuration.
@@ -88,6 +144,14 @@ class Config:
     # ANOMOD_INGEST_WORKERS — load_corpus process-pool size (0/1 = serial).
     ingest_workers: int = dataclasses.field(
         default_factory=_ingest_workers_env)
+    # ANOMOD_SERVE_BUCKETS — serving-plane micro-batch bucket widths
+    # (anomod.serve.batcher; one XLA compile per width).
+    serve_buckets: tuple = dataclasses.field(
+        default_factory=_serve_buckets_env)
+    # ANOMOD_SERVE_MAX_BACKLOG — global admission backlog bound in spans
+    # (anomod.serve.queues; the backpressure/shed budget).
+    serve_max_backlog: int = dataclasses.field(
+        default_factory=_serve_max_backlog_env)
 
     @property
     def sn_data(self) -> Path:

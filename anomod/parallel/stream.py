@@ -67,14 +67,8 @@ class ShardedStreamReplay:
     # -- push -------------------------------------------------------------
 
     def _dead_chunk(self) -> dict:
-        c = self.cfg.chunk_size
-        return dict(sid=np.full((1, c), self.cfg.sw, np.int32),
-                    dur=np.zeros((1, c), np.float32),
-                    dur_raw=np.zeros((1, c), np.float32),
-                    err=np.zeros((1, c), np.float32),
-                    s5=np.zeros((1, c), np.float32),
-                    valid=np.zeros((1, c), np.float32),
-                    tid=np.zeros((1, c), np.int32))
+        from anomod.replay import dead_chunk
+        return {k: v[None] for k, v in dead_chunk(self.cfg, xp=np).items()}
 
     def _run_group(self, group: dict) -> ReplayState:
         import jax

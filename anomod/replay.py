@@ -93,6 +93,27 @@ def stage_columns(batch: SpanBatch, cfg: ReplayConfig, t0_us: Optional[int] = No
     return {k: v.reshape(n_chunks, cfg.chunk_size) for k, v in cols.items()}, n
 
 
+def dead_chunk(cfg: ReplayConfig, width: Optional[int] = None, xp=None):
+    """An all-dead staged chunk (sid = the dead pad lane, valid = 0) —
+    numerically a no-op on any replay state.  The ONE definition of the
+    chunk column schema's dummy instance, shared by every warm/compile
+    path (StreamReplay._warm, the sharded stream's group padding, the
+    serve BucketRunner) so a chunk-schema change cannot silently desync
+    a warm path from :func:`stage_columns`."""
+    if xp is None:
+        import jax.numpy as xp
+    w = int(width or cfg.chunk_size)
+    return {
+        "sid": xp.full((w,), cfg.sw, np.int32),
+        "dur": xp.zeros((w,), np.float32),
+        "dur_raw": xp.zeros((w,), np.float32),
+        "err": xp.zeros((w,), np.float32),
+        "s5": xp.zeros((w,), np.float32),
+        "valid": xp.zeros((w,), np.float32),
+        "tid": xp.zeros((w,), np.int32),
+    }
+
+
 def hll_scatter_update(regs, sid, tid, cfg: ReplayConfig):
     """Scatter-max trace-id ranks into per-service HLL registers — the ONE
     definition of the distinct-trace plane, shared by the single-chip chunk

@@ -1,0 +1,24 @@
+"""Multi-tenant serving plane over the streaming detectors.
+
+The reference pipeline is strictly post-hoc and the streaming layer
+(anomod.stream) assumes one well-behaved feed; this package is what stands
+between "millions of users" and the jitted chunk step: admission control
+with per-tenant weighted-fair queues (queues), a dynamic micro-batcher
+that coalesces tenant micro-batches into fixed padded bucket shapes so the
+shared chunk step compiles once per bucket (batcher), a deterministic
+virtual-clock serving engine with per-tenant SLO accounting (engine), and
+a seeded power-law traffic generator standing in for the tenant fleet
+(traffic).
+"""
+
+from anomod.serve.batcher import (BucketedStreamReplay, BucketRunner,
+                                  split_plan)
+from anomod.serve.engine import ServeEngine, ServeReport, VirtualClock
+from anomod.serve.queues import AdmissionController, QueuedBatch, TenantSpec
+from anomod.serve.traffic import PowerLawTraffic, ScriptedTraffic
+
+__all__ = [
+    "AdmissionController", "BucketRunner", "BucketedStreamReplay",
+    "PowerLawTraffic", "QueuedBatch", "ScriptedTraffic", "ServeEngine",
+    "ServeReport", "TenantSpec", "VirtualClock", "split_plan",
+]

@@ -1,0 +1,192 @@
+"""Dynamic micro-batching into fixed padded bucket shapes.
+
+The serving plane's hot path is the SAME jitted chunk step the batch
+replay scans with (anomod.replay.make_chunk_step) — but tenant
+micro-batches are small and ragged, and staging every 150-span batch
+into a 32768-wide chunk wastes 99% of each dispatch.  The batcher pads
+each admitted micro-batch to the smallest shape from a FIXED bucket set
+(``ANOMOD_SERVE_BUCKETS``), so XLA compiles the step once per bucket
+width and every later dispatch of that width reuses the executable.
+
+Replay parity is exact by construction: a batch is split at
+``cfg.chunk_size`` boundaries (full chunks stage exactly as the
+sequential StreamReplay would) and only the TAIL remainder is padded to
+a bucket.  Padding rows target the dead lane (sid = cfg.sw, valid = 0),
+whose one-hot contribution to every live segment is exactly 0.0 — and
+the real rows occupy the same leading positions they would in the
+sequential staging — so the f32 state after a bucketed push is
+BIT-IDENTICAL to the sequential fixed-chunk push on CPU
+(tests/test_serve.py pins this, alert stream included).
+
+:class:`BucketedStreamReplay` duck-types :class:`anomod.stream.StreamReplay`
+(it subclasses it and overrides only the dispatch), so
+``OnlineDetector(..., replay=...)`` runs the full alerting stack over the
+shared bucket runner unchanged — thousands of tenants share ONE compiled
+step per bucket instead of compiling per tenant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from anomod.config import DEFAULT_SERVE_BUCKETS as DEFAULT_BUCKETS
+from anomod.config import validate_serve_buckets as validate_buckets
+from anomod.replay import (N_FEATS, ReplayConfig, ReplayState,
+                           make_chunk_step, stage_columns)
+from anomod.schemas import SpanBatch, take_spans
+from anomod.stream import StreamReplay
+
+
+def split_plan(n_spans: int, chunk_size: int,
+               buckets: Tuple[int, ...]) -> List[Tuple[int, int, int]]:
+    """(lo, hi, staged_width) slices for one micro-batch.
+
+    Full ``chunk_size`` slices first (identical to sequential staging),
+    then the tail remainder padded to the smallest bucket that holds it
+    (``chunk_size`` itself when every bucket is narrower).  This is the
+    ONE definition of the parity-preserving split, shared by the runner
+    and its tests.
+    """
+    plan: List[Tuple[int, int, int]] = []
+    lo = 0
+    while n_spans - lo >= chunk_size:
+        plan.append((lo, lo + chunk_size, chunk_size))
+        lo += chunk_size
+    rem = n_spans - lo
+    if rem > 0:
+        width = next((b for b in buckets if b >= rem and b <= chunk_size),
+                     chunk_size)
+        plan.append((lo, n_spans, width))
+    return plan
+
+
+class BucketRunner:
+    """The shared compile-once-per-bucket chunk-step dispatcher.
+
+    One ``jax.jit`` of the shared chunk step serves every tenant; XLA
+    compiles one executable per distinct chunk width (= per bucket, plus
+    the full ``cfg.chunk_size``), tracked in ``compile_s_by_width`` /
+    ``dispatches_by_width`` for the ServeReport.
+    """
+
+    def __init__(self, cfg: ReplayConfig,
+                 buckets: Optional[Tuple[int, ...]] = None):
+        import jax
+        if buckets is None:
+            from anomod.config import get_config
+            buckets = get_config().serve_buckets
+        self.cfg = cfg
+        self.buckets = validate_buckets(buckets)
+        step = make_chunk_step(cfg, with_hll=False)
+        self._step = jax.jit(lambda st, ch: step(st, ch)[0])
+        self.compile_s_by_width: Dict[int, float] = {}
+        self.dispatches_by_width: Dict[int, int] = {}
+        self.n_dispatches = 0
+
+    @property
+    def widths(self) -> Tuple[int, ...]:
+        """Every chunk width this runner may dispatch."""
+        per_bucket = tuple(b for b in self.buckets
+                           if b <= self.cfg.chunk_size)
+        return tuple(sorted(set(per_bucket) | {self.cfg.chunk_size}))
+
+    def zero_state(self) -> ReplayState:
+        import jax.numpy as jnp
+        cfg = self.cfg
+        return ReplayState(
+            agg=jnp.zeros((cfg.sw, N_FEATS), jnp.float32),
+            hist=jnp.zeros((cfg.sw, cfg.n_hist_buckets), jnp.float32))
+
+    def warm(self) -> float:
+        """Compile every bucket width on an all-dead chunk (numerically a
+        no-op on any state) so serving never pays a compile wall mid-
+        stream.  Returns the total compile wall; idempotent."""
+        from anomod.replay import dead_chunk
+        total = 0.0
+        state = self.zero_state()
+        for width in self.widths:
+            if width in self.compile_s_by_width:
+                continue
+            t0 = time.perf_counter()
+            state = self._step(state, dead_chunk(self.cfg, width))
+            np.asarray(state.agg)               # compile + execute barrier
+            self.compile_s_by_width[width] = time.perf_counter() - t0
+            total += self.compile_s_by_width[width]
+        return total
+
+    @property
+    def compile_s(self) -> float:
+        return float(sum(self.compile_s_by_width.values()))
+
+    def push_into(self, state: ReplayState, batch: SpanBatch,
+                  t0_us: int) -> ReplayState:
+        """Fold one micro-batch into ``state`` via the bucketed split.
+
+        ``t0_us`` is the caller's (rolled) window anchor — binning is the
+        caller's contract, exactly as in StreamReplay.push.
+        """
+        cfg = self.cfg
+        for lo, hi, width in split_plan(batch.n_spans, cfg.chunk_size,
+                                        self.buckets):
+            sub = take_spans(batch, slice(lo, hi)) \
+                if (lo, hi) != (0, batch.n_spans) else batch
+            staged_cfg = dataclasses.replace(cfg, chunk_size=width)
+            chunks, _ = stage_columns(sub, staged_cfg, t0_us=t0_us)
+            for i in range(chunks["sid"].shape[0]):
+                state = self._step(state,
+                                   {k: v[i] for k, v in chunks.items()})
+                self.n_dispatches += 1
+                self.dispatches_by_width[width] = \
+                    self.dispatches_by_width.get(width, 0) + 1
+        return state
+
+
+class BucketedStreamReplay(StreamReplay):
+    """StreamReplay whose dispatch rides a shared :class:`BucketRunner`.
+
+    Same ring/anchor bookkeeping as the parent (``_roll`` is inherited —
+    ONE definition of the eviction math); only ``push`` and ``_warm``
+    differ: chunks stage through the runner's bucket plan and the
+    compiled executables are shared across every tenant on the runner.
+    """
+
+    def __init__(self, cfg: ReplayConfig, t0_us: int, runner: BucketRunner):
+        if runner.cfg != cfg:
+            raise ValueError("runner cfg disagrees with the replay cfg")
+        # deliberately NOT super().__init__: the parent builds a
+        # per-instance jitted step and zero planes this subclass never
+        # uses (the runner owns the ONE jit for the whole fleet), and a
+        # live-looking unused self._step would dispatch outside the
+        # runner's accounting if anything ever called it
+        self.cfg = cfg
+        self.t0_us = int(t0_us)
+        self.window_offset = 0
+        self.n_spans = 0
+        self._step = None                 # dispatch goes through the runner
+        self.compile_s = 0.0
+        self._warmed = False
+        self._runner = runner
+        self.state = runner.zero_state()
+
+    def _warm(self) -> None:
+        self._runner.warm()
+        self.compile_s = self._runner.compile_s
+        self._warmed = True
+
+    def push(self, batch: SpanBatch) -> int:
+        if batch.n_spans == 0:
+            return -1
+        if not self._warmed:
+            self._warm()
+        w_need = int((int(batch.start_us.max()) - self.t0_us)
+                     // self.cfg.window_us)
+        if w_need > self.cfg.n_windows - 1:
+            self._roll(w_need - (self.cfg.n_windows - 1))
+            w_need = self.cfg.n_windows - 1
+        self.state = self._runner.push_into(self.state, batch, self.t0_us)
+        self.n_spans += batch.n_spans
+        return self.window_offset + max(w_need, 0)

@@ -1,0 +1,474 @@
+"""The serving engine: virtual-clock tick loop over admission → dynamic
+batching → the shared jitted chunk step → per-tenant SLO accounting.
+
+Deterministic by construction (the anomod.recovery pattern): a virtual
+clock advances in fixed ticks, arrivals come from a seeded traffic
+source, and every admission/shedding/serving decision is pure
+bookkeeping — a seeded overload replay is bit-reproducible, and the
+whole engine unit-tests without a single wall sleep.  Wall time is
+measured (never waited on) around the serving path only, for the
+sustained spans/sec number the bench reports.
+
+Each tenant runs the UNCHANGED detector stack: an
+``anomod.stream.OnlineDetector`` whose replay plane is a
+:class:`anomod.serve.batcher.BucketedStreamReplay` sharing one compiled
+chunk step per bucket across the whole fleet (or, with ``mesh``, an
+``anomod.parallel.stream.ShardedStreamReplay`` — the pod-sharded plane,
+reused wholesale).  Admission→scored latency per micro-batch folds into
+per-tenant t-digests (anomod.ops.tdigest — the repo's one sketch path),
+so the ServeReport's p50/p99 are sketch-backed, mergeable across tenants
+and priorities.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from anomod.ops.tdigest import (TDigest, tdigest_build, tdigest_merge_many,
+                                tdigest_quantile)
+from anomod.replay import ReplayConfig
+from anomod.serve.batcher import BucketedStreamReplay, BucketRunner
+from anomod.serve.queues import (AdmissionController, QueuedBatch,
+                                 TenantSpec)
+
+#: t-digest centroid capacity for the latency sketches (compact enough to
+#: keep per tenant, accurate to well under a tick at the tails)
+_DIGEST_K = 32
+#: latency samples buffered per tenant before folding into the digest
+_FOLD_EVERY = 256
+
+
+class VirtualClock:
+    """Tick-based deterministic time (no wall sleeps — recovery.py's
+    pattern, shared contract with the chaos/recovery controllers)."""
+
+    def __init__(self, tick_s: float = 1.0, t0_s: float = 0.0):
+        if tick_s <= 0:
+            raise ValueError("tick_s must be positive")
+        self.tick_s = float(tick_s)
+        self.now_s = float(t0_s)
+        self.ticks = 0
+
+    def advance(self) -> float:
+        self.now_s += self.tick_s
+        self.ticks += 1
+        return self.now_s
+
+
+class _TenantSLO:
+    """Per-tenant latency sketch + alert bookkeeping."""
+
+    def __init__(self):
+        self.digest: Optional[TDigest] = None
+        self._buf: List[float] = []
+        self.n_samples = 0
+        self.max_latency_s = 0.0
+
+    def record(self, latency_s: float) -> None:
+        self._buf.append(float(latency_s))
+        self.n_samples += 1
+        self.max_latency_s = max(self.max_latency_s, float(latency_s))
+        if len(self._buf) >= _FOLD_EVERY:
+            self.fold()
+
+    def fold(self) -> None:
+        if not self._buf:
+            return
+        d = tdigest_build(np.asarray(self._buf, np.float32), k=_DIGEST_K)
+        self.digest = d if self.digest is None else \
+            tdigest_merge_many([self.digest, d])
+        self._buf = []
+
+    def quantile(self, q: float) -> Optional[float]:
+        self.fold()
+        if self.digest is None or float(self.digest.weight.sum()) <= 0:
+            return None
+        return float(tdigest_quantile(self.digest, q))
+
+
+def _merged_quantiles(slos: Sequence[_TenantSLO],
+                      qs=(0.5, 0.99)) -> Dict[str, Optional[float]]:
+    digests = []
+    for s in slos:
+        s.fold()
+        if s.digest is not None and float(s.digest.weight.sum()) > 0:
+            digests.append(s.digest)
+    if not digests:
+        return {f"p{int(q * 100)}_latency_s": None for q in qs}
+    merged = digests[0] if len(digests) == 1 else \
+        tdigest_merge_many(digests)
+    return {f"p{int(q * 100)}_latency_s":
+            round(float(tdigest_quantile(merged, q)), 6) for q in qs}
+
+
+@dataclasses.dataclass
+class ServeReport:
+    """The serving run's quality/throughput document (JSON-able)."""
+    n_tenants: int
+    duration_s: float
+    ticks: int
+    capacity_spans_per_s: float
+    offered_spans: int
+    admitted_spans: int
+    served_spans: int
+    shed_spans: int
+    shed_fraction: float
+    served_batches: int
+    peak_backlog_spans: int
+    max_backlog: int
+    buckets: Tuple[int, ...]
+    dispatches_by_width: Dict[int, int]
+    compile_s: float
+    latency: Dict[str, Optional[float]]          # aggregate p50/p99
+    per_priority: Dict[int, dict]
+    modality_events: Dict[str, int]              # multimodal sidecar volume
+    n_alerts: int
+    n_tenants_alerted: int
+    fault_detection: Optional[dict]
+    serve_wall_s: float
+    sustained_spans_per_sec: float
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["buckets"] = list(self.buckets)
+        d["dispatches_by_width"] = {str(k): v for k, v
+                                    in self.dispatches_by_width.items()}
+        d["per_priority"] = {str(k): v for k, v
+                             in self.per_priority.items()}
+        return d
+
+
+def serve_plane_cfg(n_services: int = 12, window_s: float = 5.0,
+                    n_windows: int = 32) -> ReplayConfig:
+    """The serve bench's replay-plane shape — ONE definition shared by
+    ``run_power_law`` (and thus ``bench.py --mode serve``, whose
+    serve_main passes these defaults) and the pre-bench serve gate
+    (``scripts/pre_bench_check.py --mode serve``), so the gate's
+    "bucket set compiles" check always covers the plane the capture
+    actually runs."""
+    return ReplayConfig(n_services=n_services, n_windows=n_windows,
+                        window_us=int(window_s * 1e6), chunk_size=4096)
+
+
+def run_power_law(n_tenants: int = 200, n_services: int = 8,
+                  capacity_spans_per_s: float = 20_000.0,
+                  overload: float = 1.0, duration_s: float = 120.0,
+                  tick_s: float = 1.0, seed: int = 0, alpha: float = 1.2,
+                  window_s: float = 5.0, baseline_windows: int = 4,
+                  z_threshold: float = 4.0,
+                  buckets: Optional[Tuple[int, ...]] = None,
+                  max_backlog: Optional[int] = None,
+                  fault_tenants: int = 2, score: bool = True,
+                  mesh=None, tracer=None,
+                  n_windows: int = 32) -> Tuple["ServeEngine", ServeReport]:
+    """The canonical seeded serve run shared by ``anomod serve`` and
+    ``bench.py --mode serve``: a power-law tenant fleet offering
+    ``overload``× the engine's capacity, with ``fault_tenants`` busiest
+    tenants given a scripted latency fault once calibration is past —
+    so one invocation measures sustained throughput, shed behavior AND
+    alert latency under load."""
+    from anomod.serve.traffic import PowerLawTraffic, TenantFault
+    onset_s = (baseline_windows + 2) * window_s
+    if duration_s <= onset_s + 2 * window_s:
+        fault_tenants = 0                 # too short for a fault phase
+    faults = {t: TenantFault("latency", service=1, onset_s=onset_s,
+                             factor=10.0)
+              for t in range(min(fault_tenants, n_tenants))}
+    traffic = PowerLawTraffic(
+        n_tenants=n_tenants,
+        total_rate_spans_per_s=capacity_spans_per_s * overload,
+        alpha=alpha, seed=seed, n_services=n_services, faults=faults)
+    cfg = serve_plane_cfg(n_services, window_s, n_windows)
+    engine = ServeEngine(traffic.specs, traffic.services, cfg,
+                         capacity_spans_per_s=capacity_spans_per_s,
+                         tick_s=tick_s, buckets=buckets,
+                         max_backlog=max_backlog, score=score,
+                         baseline_windows=baseline_windows,
+                         z_threshold=z_threshold, mesh=mesh,
+                         tracer=tracer)
+    report = engine.run(traffic, duration_s=duration_s)
+    return engine, report
+
+
+class ServeEngine:
+    """Multi-tenant serving plane over the streaming detectors."""
+
+    def __init__(self, specs: Sequence[TenantSpec], services: Sequence[str],
+                 cfg: Optional[ReplayConfig] = None, t0_us: int = 0,
+                 capacity_spans_per_s: float = 20_000.0, tick_s: float = 1.0,
+                 buckets: Optional[Tuple[int, ...]] = None,
+                 max_backlog: Optional[int] = None,
+                 max_tenant_backlog: Optional[int] = None,
+                 score: bool = True, baseline_windows: int = 4,
+                 z_threshold: float = 4.0, consecutive: int = 1,
+                 min_count: float = 5.0, mesh=None, tracer=None,
+                 multimodal: bool = False, testbed: Optional[str] = None):
+        from anomod.config import get_config
+        if capacity_spans_per_s <= 0:
+            raise ValueError("capacity must be positive")
+        app_cfg = get_config()
+        self.specs = list(specs)
+        self.services = tuple(services)
+        self.cfg = cfg or ReplayConfig(n_services=len(self.services),
+                                       chunk_size=4096)
+        if self.cfg.n_services != len(self.services):
+            raise ValueError("cfg.n_services disagrees with the service "
+                             "table")
+        self.t0_us = int(t0_us)
+        self.capacity_spans_per_s = float(capacity_spans_per_s)
+        self.clock = VirtualClock(tick_s)
+        self.max_backlog = int(max_backlog if max_backlog is not None
+                               else app_cfg.serve_max_backlog)
+        self.admission = AdmissionController(
+            self.specs, max_backlog=self.max_backlog,
+            max_tenant_backlog=max_tenant_backlog)
+        self.score = bool(score)
+        self.mesh = mesh
+        self.runner = BucketRunner(
+            self.cfg,
+            buckets if buckets is not None else app_cfg.serve_buckets)
+        self.tracer = tracer
+        self._det_kw = dict(baseline_windows=baseline_windows,
+                            z_threshold=z_threshold,
+                            consecutive=consecutive, min_count=min_count)
+        # per-tenant detector/replay state, built lazily at first served
+        # batch (a fleet of mostly-idle tenants must not pay T dead
+        # planes up front)
+        self.multimodal = bool(multimodal)
+        self.testbed = testbed
+        #: pushed log/metric/api events per modality (multimodal mode)
+        self.modality_events: Dict[str, int] = {}
+        self._tenant_replay: Dict[int, object] = {}
+        self._tenant_det: Dict[int, object] = {}
+        self._shared_sharded_fn = None
+        self._slo: Dict[int, _TenantSLO] = {s.tenant_id: _TenantSLO()
+                                            for s in self.specs}
+        self._credit = 0.0
+        self.serve_wall_s = 0.0
+        self.n_spans_served = 0
+
+    # -- per-tenant plane construction ------------------------------------
+
+    def _replay_for(self, tenant_id: int):
+        got = self._tenant_replay.get(tenant_id)
+        if got is None:
+            if self.mesh is not None:
+                from anomod.parallel.stream import ShardedStreamReplay
+                got = ShardedStreamReplay(self.cfg, self.t0_us, self.mesh)
+                # every tenant's plane runs the IDENTICAL sharded scan;
+                # sharing the first plane's jitted fn object gives the
+                # fleet one compile instead of T (a fresh closure per
+                # tenant would never hit jax's compile cache, and the
+                # T-1 redundant compiles would land inside the measured
+                # serving wall)
+                if self._shared_sharded_fn is None:
+                    self._shared_sharded_fn = got._fn
+                else:
+                    got._fn = self._shared_sharded_fn
+            else:
+                got = BucketedStreamReplay(self.cfg, self.t0_us,
+                                           self.runner)
+            self._tenant_replay[tenant_id] = got
+        return got
+
+    def _detector_for(self, tenant_id: int):
+        got = self._tenant_det.get(tenant_id)
+        if got is None:
+            if self.multimodal:
+                from anomod.stream import MultimodalDetector
+                got = MultimodalDetector(self.services, self.cfg,
+                                         self.t0_us, testbed=self.testbed,
+                                         replay=self._replay_for(tenant_id),
+                                         **self._det_kw)
+            else:
+                from anomod.stream import OnlineDetector
+                got = OnlineDetector(self.services, self.cfg, self.t0_us,
+                                     replay=self._replay_for(tenant_id),
+                                     **self._det_kw)
+            self._tenant_det[tenant_id] = got
+        return got
+
+    # -- modality sidecar (multimodal mode) -------------------------------
+
+    def offer_modality(self, tenant_id: int, kind: str, batch) -> None:
+        """Admit a log/metric/api micro-batch for a tenant.
+
+        Modality planes are per-window host aggregates a fraction the
+        span volume — control-plane data.  They bypass the weighted-fair
+        span queue and push straight into the tenant's MultimodalDetector
+        host planes: a window only CLOSES when a later span is pushed, and
+        queued spans can only delay that, so a modality batch admitted at
+        arrival is always in place before its window scores.
+        """
+        if not (self.multimodal and self.score):
+            raise ValueError("offer_modality needs multimodal=True and "
+                             "score=True")
+        det = self._detector_for(tenant_id)
+        if kind == "logs":
+            n = batch.n_lines
+            det.push_logs(batch)
+        elif kind == "metrics":
+            n = batch.n_samples
+            det.push_metrics(batch)
+        elif kind == "api":
+            n = batch.n_records
+            det.push_api(batch)
+        else:
+            raise ValueError(f"unknown modality kind {kind!r}")
+        self.modality_events[kind] = self.modality_events.get(kind, 0) + n
+
+    # -- the tick loop ----------------------------------------------------
+
+    def _span(self, name: str):
+        import contextlib
+        return (self.tracer.span(name) if self.tracer is not None
+                else contextlib.nullcontext())
+
+    def tick(self, arrivals, modality_arrivals=()) -> List[QueuedBatch]:
+        """One virtual tick: admit this tick's arrivals (modality
+        sidecar batches first — their windows must be populated before
+        any span push can close them), drain up to the tick's capacity
+        budget in weighted-fair order, score every drained batch,
+        advance the clock.  Returns the served batches."""
+        t_wall = time.perf_counter()
+        now = self.clock.now_s + self.clock.tick_s   # decisions at tick end
+        if modality_arrivals:
+            with self._span("serve.modality"):
+                for tenant_id, kind, batch in modality_arrivals:
+                    self.offer_modality(tenant_id, kind, batch)
+        with self._span("serve.admit"):
+            for tenant_id, spans in arrivals:
+                # one shared service table per engine: a batch whose ids
+                # mean different services would silently corrupt the
+                # shared plane rows
+                if spans.n_spans and spans.services != self.services:
+                    raise ValueError(
+                        f"tenant {tenant_id} batch carries a different "
+                        "service table than the engine's")
+                self.admission.offer(tenant_id, spans, now)
+        # capacity credit: unused budget does not bank across idle ticks
+        # beyond one tick's worth (no unbounded burst debt)
+        self._credit = min(self._credit, 0.0) \
+            + self.capacity_spans_per_s * self.clock.tick_s
+        with self._span("serve.drain"):
+            served = self.admission.drain(self._credit)
+        for qb in served:
+            self._credit -= qb.n_spans
+            with self._span("serve.score"):
+                if self.score:
+                    self._detector_for(qb.tenant_id).push(qb.spans)
+                else:
+                    self._replay_for(qb.tenant_id).push(qb.spans)
+            self._slo[qb.tenant_id].record(now - qb.enqueued_s)
+            self.n_spans_served += qb.n_spans
+        self.clock.advance()
+        self.serve_wall_s += time.perf_counter() - t_wall
+        return served
+
+    def run(self, traffic, duration_s: float,
+            warm: bool = True) -> "ServeReport":
+        """Drive the engine from a traffic source for ``duration_s``
+        virtual seconds, then close every tenant's last window."""
+        if warm and self.mesh is None:
+            self.runner.warm()                   # compiles outside the wall
+        n_ticks = max(int(round(duration_s / self.clock.tick_s)), 1)
+        mod_src = getattr(traffic, "modality_arrivals", None) \
+            if self.multimodal else None
+        with self._span("serve.run"):
+            for _ in range(n_ticks):
+                lo = self.clock.now_s
+                hi = lo + self.clock.tick_s
+                self.tick(traffic.arrivals(lo, hi),
+                          mod_src(lo, hi) if mod_src is not None else ())
+        t_wall = time.perf_counter()
+        if self.score:
+            for det in self._tenant_det.values():
+                det.finish()
+        self.serve_wall_s += time.perf_counter() - t_wall
+        return self.report(traffic=traffic)
+
+    # -- reporting --------------------------------------------------------
+
+    def alerts_for(self, tenant_id: int):
+        det = self._tenant_det.get(tenant_id)
+        return list(det.alerts) if det is not None else []
+
+    def _fault_detection(self, traffic) -> Optional[dict]:
+        faults = getattr(traffic, "faults", None)
+        if not faults:
+            return None
+        win_s = self.cfg.window_us / 1e6
+        lat = []
+        hits = 0
+        for tid, fault in sorted(faults.items()):
+            det = self._tenant_det.get(tid)
+            onset_w = int(fault.onset_s // win_s)
+            fw = None
+            if det is not None:
+                # only alerts AT or AFTER the onset can be the fault — a
+                # pre-onset noise alert on the culprit service must not
+                # count as (negative-latency) detection
+                ws = [a.window for a in det.alerts
+                      if a.service_name == self.services[fault.service]
+                      and a.window >= onset_w]
+                fw = min(ws) if ws else None
+            if fw is not None:
+                hits += 1
+                lat.append(fw - onset_w)
+        return {
+            "n_fault_tenants": len(faults),
+            "n_detected": hits,
+            "median_alert_latency_windows":
+                (float(np.median(lat)) if lat else None),
+        }
+
+    def report(self, traffic=None) -> ServeReport:
+        tot = self.admission.totals()
+        shed_fraction = (tot.shed_spans / tot.offered_spans
+                         if tot.offered_spans else 0.0)
+        per_pri = {}
+        pri_slos: Dict[int, List[_TenantSLO]] = {}
+        for spec in self.specs:
+            pri_slos.setdefault(spec.priority, []).append(
+                self._slo[spec.tenant_id])
+        for pri, c in sorted(self.admission.per_priority().items()):
+            per_pri[pri] = {
+                "offered_spans": c.offered_spans,
+                "served_spans": c.served_spans,
+                "shed_spans": c.shed_spans,
+                "shed_fraction": (c.shed_spans / c.offered_spans
+                                  if c.offered_spans else 0.0),
+                **_merged_quantiles(pri_slos.get(pri, ())),
+            }
+        n_alerts = sum(len(d.alerts) for d in self._tenant_det.values())
+        n_alerted = sum(1 for d in self._tenant_det.values() if d.alerts)
+        return ServeReport(
+            n_tenants=len(self.specs),
+            duration_s=round(self.clock.now_s, 6),
+            ticks=self.clock.ticks,
+            capacity_spans_per_s=self.capacity_spans_per_s,
+            offered_spans=tot.offered_spans,
+            admitted_spans=tot.admitted_spans,
+            served_spans=tot.served_spans,
+            shed_spans=tot.shed_spans,
+            shed_fraction=round(shed_fraction, 6),
+            served_batches=tot.served_batches,
+            peak_backlog_spans=self.admission.peak_backlog_spans,
+            max_backlog=self.admission.max_backlog,
+            buckets=self.runner.buckets,
+            dispatches_by_width=dict(self.runner.dispatches_by_width),
+            compile_s=round(self.runner.compile_s, 4),
+            latency=_merged_quantiles(list(self._slo.values())),
+            per_priority=per_pri,
+            modality_events=dict(self.modality_events),
+            n_alerts=n_alerts,
+            n_tenants_alerted=n_alerted,
+            fault_detection=self._fault_detection(traffic),
+            serve_wall_s=round(self.serve_wall_s, 4),
+            sustained_spans_per_sec=round(
+                self.n_spans_served / max(self.serve_wall_s, 1e-9), 1),
+        )

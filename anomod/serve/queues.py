@@ -1,0 +1,266 @@
+"""Admission control: per-tenant weighted-fair queues, bounded backlog,
+priority-aware load shedding.
+
+The shapes are the classic inference-serving ones (the ROADMAP's
+"thousands of concurrent feeds" regime): every tenant owns a FIFO of
+pending span micro-batches, service order across tenants is start-time
+fair queuing (SFQ — each batch gets a virtual finish tag
+``start + cost/weight``; the drain always serves the globally smallest
+tag), and two backlog bounds provide backpressure:
+
+- a per-tenant bound, so one runaway feed cannot monopolize the queue
+  memory (its own overflow is shed, nobody else's), and
+- a global bound (``ANOMOD_SERVE_MAX_BACKLOG``): when offered load
+  exceeds capacity the controller sheds in PRIORITY order — an arriving
+  batch may evict queued work of strictly lower priority (latest-served
+  first, so the evicted work is what fair queuing would have reached
+  last), and is itself shed when nothing lower-priority is queued.
+
+Everything is host-side bookkeeping over integers and floats — no wall
+clocks, no randomness — so a seeded overload replay is bit-reproducible
+(the determinism contract tests/test_serve.py pins).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from anomod.schemas import SpanBatch
+
+#: default scheduler weight per priority class (0 = most important).
+PRIORITY_WEIGHTS = {0: 4.0, 1: 2.0, 2: 1.0}
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's static admission contract."""
+    tenant_id: int
+    name: str
+    priority: int = 1          # 0 = gold, 1 = silver, 2 = bronze
+    weight: float = 0.0        # 0 -> PRIORITY_WEIGHTS[priority]
+    rate_spans_per_s: float = 0.0   # offered-load hint (traffic generator)
+
+    def effective_weight(self) -> float:
+        if self.weight > 0:
+            return self.weight
+        return PRIORITY_WEIGHTS.get(self.priority, 1.0)
+
+
+@dataclasses.dataclass
+class QueuedBatch:
+    """One admitted micro-batch waiting for the batcher."""
+    tenant_id: int
+    seq: int                   # global admission sequence number
+    spans: SpanBatch
+    n_spans: int
+    priority: int
+    enqueued_s: float          # virtual admission time
+    finish_tag: float          # SFQ virtual finish time
+
+
+@dataclasses.dataclass
+class TenantCounters:
+    offered_spans: int = 0
+    admitted_spans: int = 0
+    served_spans: int = 0
+    shed_spans: int = 0
+    offered_batches: int = 0
+    served_batches: int = 0
+    shed_batches: int = 0
+
+
+class AdmissionController:
+    """Weighted-fair admission over a bounded multi-tenant backlog."""
+
+    def __init__(self, tenants: Sequence[TenantSpec],
+                 max_backlog: int = 200_000,
+                 max_tenant_backlog: Optional[int] = None):
+        if max_backlog < 1:
+            raise ValueError("max_backlog must be >= 1 span")
+        self.specs: Dict[int, TenantSpec] = {t.tenant_id: t for t in tenants}
+        if len(self.specs) != len(tenants):
+            raise ValueError("duplicate tenant_id in tenant specs")
+        self.max_backlog = int(max_backlog)
+        self.max_tenant_backlog = int(max_tenant_backlog
+                                      if max_tenant_backlog is not None
+                                      else max(max_backlog // 8, 1))
+        self.counters: Dict[int, TenantCounters] = {
+            t.tenant_id: TenantCounters() for t in tenants}
+        self.backlog_spans = 0
+        self.peak_backlog_spans = 0
+        self._tenant_backlog: Dict[int, int] = {t.tenant_id: 0
+                                                for t in tenants}
+        # per-priority backlog totals: the eviction feasibility check
+        # must know how much strictly-lower-priority work is queued
+        # BEFORE destroying any of it
+        self._priority_backlog: Dict[int, int] = {}
+        # SFQ state: system virtual time + per-tenant last finish tag
+        self._vtime = 0.0
+        self._last_finish: Dict[int, float] = {t.tenant_id: 0.0
+                                               for t in tenants}
+        self._seq = 0
+        self._alive: Dict[int, QueuedBatch] = {}      # seq -> batch
+        # drain heap: smallest finish tag first (seq breaks ties
+        # deterministically); evict heap: lowest priority (largest
+        # number) first, then latest finish tag — the work fair queuing
+        # would serve last.  Both use lazy deletion against _alive.
+        self._drain_heap: List[Tuple[float, int]] = []
+        self._evict_heap: List[Tuple[int, float, int]] = []
+        self._evict_stale = 0
+
+    # -- admission --------------------------------------------------------
+
+    def offer(self, tenant_id: int, spans: SpanBatch,
+              now_s: float) -> bool:
+        """Admit (enqueue) or shed one tenant micro-batch.
+
+        Returns True iff admitted.  Shedding is deterministic:
+        per-tenant overflow sheds the arrival; global overflow evicts
+        strictly-lower-priority queued work first and sheds the arrival
+        only when none exists.
+        """
+        spec = self.specs[tenant_id]
+        n = spans.n_spans
+        c = self.counters[tenant_id]
+        c.offered_spans += n
+        c.offered_batches += 1
+        if n == 0:
+            return False
+        # both bounds refuse a batch only when queued work already exists
+        # (the admission mirror of drain()'s one-batch overdraw): a batch
+        # wider than a bound must still admit against an empty queue, or
+        # it would be starved forever at ANY load
+        if self._tenant_backlog[tenant_id] \
+                and self._tenant_backlog[tenant_id] + n \
+                > self.max_tenant_backlog:
+            c.shed_spans += n
+            c.shed_batches += 1
+            return False
+        if self.backlog_spans and self.backlog_spans + n > self.max_backlog:
+            # transactional eviction: only destroy lower-priority work if
+            # enough of it exists to actually admit the arrival —
+            # otherwise evicting would lose BOTH the victims and the
+            # arrival (shed the arrival alone instead).  Emptying the
+            # whole queue also admits (the empty-queue overdraw above),
+            # so the headroom requirement caps at the current backlog.
+            needed = min(self.backlog_spans + n - self.max_backlog,
+                         self.backlog_spans)
+            evictable = sum(v for p, v in self._priority_backlog.items()
+                            if p > spec.priority)
+            if evictable < needed:
+                c.shed_spans += n
+                c.shed_batches += 1
+                return False
+        while self.backlog_spans and self.backlog_spans + n > self.max_backlog:
+            victim = self._pop_eviction_candidate(spec.priority)
+            if victim is None:           # unreachable given the check above
+                c.shed_spans += n
+                c.shed_batches += 1
+                return False
+            vc = self.counters[victim.tenant_id]
+            vc.shed_spans += victim.n_spans
+            vc.shed_batches += 1
+            vc.admitted_spans -= victim.n_spans
+            self._remove(victim)
+        start = max(self._vtime, self._last_finish[tenant_id])
+        finish = start + n / spec.effective_weight()
+        self._last_finish[tenant_id] = finish
+        qb = QueuedBatch(tenant_id=tenant_id, seq=self._seq, spans=spans,
+                         n_spans=n, priority=spec.priority,
+                         enqueued_s=now_s, finish_tag=finish)
+        self._seq += 1
+        self._alive[qb.seq] = qb
+        heapq.heappush(self._drain_heap, (qb.finish_tag, qb.seq))
+        heapq.heappush(self._evict_heap,
+                       (-qb.priority, -qb.finish_tag, -qb.seq))
+        self.backlog_spans += n
+        self._tenant_backlog[tenant_id] += n
+        self._priority_backlog[spec.priority] = \
+            self._priority_backlog.get(spec.priority, 0) + n
+        self.peak_backlog_spans = max(self.peak_backlog_spans,
+                                      self.backlog_spans)
+        c.admitted_spans += n
+        return True
+
+    def _pop_eviction_candidate(self, incoming_priority: int):
+        """The queued batch a higher-priority arrival may displace:
+        strictly lower priority than the arrival, lowest class first,
+        latest finish tag first.  None when nothing qualifies."""
+        while self._evict_heap:
+            neg_pri, neg_fin, neg_seq = self._evict_heap[0]
+            qb = self._alive.get(-neg_seq)
+            if qb is None:                      # already drained/evicted
+                heapq.heappop(self._evict_heap)
+                continue
+            if -neg_pri <= incoming_priority:
+                return None                     # nothing strictly lower
+            heapq.heappop(self._evict_heap)
+            return qb
+        return None
+
+    def _remove(self, qb: QueuedBatch) -> None:
+        del self._alive[qb.seq]
+        self.backlog_spans -= qb.n_spans
+        self._tenant_backlog[qb.tenant_id] -= qb.n_spans
+        self._priority_backlog[qb.priority] -= qb.n_spans
+        # the evict heap prunes lazily only when overflow consults its
+        # top; a long never-overloaded run would otherwise accumulate one
+        # stale entry per drained batch forever — compact when stale
+        # entries dominate (amortized O(1) per removal)
+        self._evict_stale += 1
+        if self._evict_stale > max(64, len(self._alive)):
+            self._evict_heap = [(-q.priority, -q.finish_tag, -q.seq)
+                                for q in self._alive.values()]
+            heapq.heapify(self._evict_heap)
+            self._evict_stale = 0
+
+    # -- drain ------------------------------------------------------------
+
+    def drain(self, budget_spans: float) -> List[QueuedBatch]:
+        """Serve up to ``budget_spans`` in weighted-fair order.
+
+        The budget may overdraw by at most one batch (batches are never
+        split — the batcher needs them whole for replay parity), so a
+        batch wider than a whole tick's budget still drains instead of
+        deadlocking the queue.
+        """
+        out: List[QueuedBatch] = []
+        remaining = float(budget_spans)
+        while remaining > 0 and self._drain_heap:
+            fin, seq = self._drain_heap[0]
+            qb = self._alive.get(seq)
+            if qb is None:                      # evicted under overload
+                heapq.heappop(self._drain_heap)
+                continue
+            heapq.heappop(self._drain_heap)
+            self._remove(qb)
+            self._vtime = max(self._vtime, fin - qb.n_spans
+                              / self.specs[qb.tenant_id].effective_weight())
+            remaining -= qb.n_spans
+            c = self.counters[qb.tenant_id]
+            c.served_spans += qb.n_spans
+            c.served_batches += 1
+            out.append(qb)
+        return out
+
+    # -- report helpers ---------------------------------------------------
+
+    def totals(self) -> TenantCounters:
+        tot = TenantCounters()
+        for c in self.counters.values():
+            for f in dataclasses.fields(TenantCounters):
+                setattr(tot, f.name,
+                        getattr(tot, f.name) + getattr(c, f.name))
+        return tot
+
+    def per_priority(self) -> Dict[int, TenantCounters]:
+        out: Dict[int, TenantCounters] = {}
+        for tid, c in self.counters.items():
+            pri = self.specs[tid].priority
+            acc = out.setdefault(pri, TenantCounters())
+            for f in dataclasses.fields(TenantCounters):
+                setattr(acc, f.name,
+                        getattr(acc, f.name) + getattr(c, f.name))
+        return out

@@ -212,19 +212,9 @@ class StreamReplay:
         """Compile the chunk step on an all-dead dummy chunk (sid = dead
         lane, valid = 0 → numerically a no-op on the state) so push()
         walls measure the steady pipeline, not one-time compilation."""
-        import jax.numpy as jnp
-        cfg = self.cfg
+        from anomod.replay import dead_chunk
         t0 = time.perf_counter()
-        dummy = {
-            "sid": jnp.full((cfg.chunk_size,), cfg.sw, jnp.int32),
-            "dur": jnp.zeros((cfg.chunk_size,), jnp.float32),
-            "dur_raw": jnp.zeros((cfg.chunk_size,), jnp.float32),
-            "err": jnp.zeros((cfg.chunk_size,), jnp.float32),
-            "s5": jnp.zeros((cfg.chunk_size,), jnp.float32),
-            "valid": jnp.zeros((cfg.chunk_size,), jnp.float32),
-            "tid": jnp.zeros((cfg.chunk_size,), jnp.int32),
-        }
-        self.state = self._step(self.state, dummy)
+        self.state = self._step(self.state, dead_chunk(self.cfg))
         np.asarray(self.state.agg)                # compile + execute barrier
         self.compile_s = time.perf_counter() - t0
         self._warmed = True

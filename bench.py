@@ -32,6 +32,17 @@ where anything touching ``jax.devices()`` either raises or hangs forever):
   3. Any error after that still emits the JSON line with an ``error`` field.
 
 ``ANOMOD_BENCH_PLATFORM=cpu|tpu`` skips the probe and forces the platform.
+
+Serve mode (``python bench.py --mode serve`` or ``ANOMOD_BENCH_MODE=serve``):
+instead of the batch replay, drives the multi-tenant serving plane
+(anomod.serve) with a seeded power-law fleet offering 2x the engine's
+capacity and emits ONE JSON line with sustained spans/sec through
+admission+batching+scoring, the p99 admission->scored latency, and the
+shed fraction under that overload at the configured backlog budget.
+Gate serve captures on ``scripts/pre_bench_check.py --mode serve`` (bucket
+set must validate + compile).  Knobs: ``ANOMOD_SERVE_BENCH_CAPACITY``
+(spans/sec, default 25000), ``ANOMOD_SERVE_BENCH_DURATION`` (virtual
+seconds, default 60), ``ANOMOD_SERVE_BENCH_TENANTS`` (default 200).
 """
 
 import json
@@ -69,8 +80,121 @@ def _resolve_platform(attempts=None):
     return "cpu", f"device backend unavailable ({diag})"
 
 
+def _bench_mode(argv) -> str:
+    """"replay" (default) or "serve"; --mode beats ANOMOD_BENCH_MODE."""
+    if "--mode" in argv:
+        i = argv.index("--mode")
+        if i + 1 >= len(argv):
+            raise SystemExit("bench.py: --mode needs a value "
+                             "(replay|serve)")
+        mode = argv[i + 1].strip().lower()
+    else:
+        mode = os.environ.get("ANOMOD_BENCH_MODE", "replay").strip().lower()
+    if mode not in ("replay", "serve"):
+        raise SystemExit(f"bench.py: unknown mode {mode!r} (replay|serve)")
+    return mode
+
+
+def serve_main() -> int:
+    """The serve-mode capture: sustained spans/sec + p99 latency + shed
+    fraction under a seeded 2x overload (fixed backlog budget)."""
+    from anomod.utils.platform import env_number
+    out = {
+        "metric": "serve_sustained_throughput",
+        "value": 0.0,
+        "unit": "spans/sec",
+        "mode": "serve",
+    }
+    platform, diag = _resolve_platform()
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        from anomod.serve.engine import run_power_law
+        capacity = env_number("ANOMOD_SERVE_BENCH_CAPACITY", 25_000)
+        duration = env_number("ANOMOD_SERVE_BENCH_DURATION", 60)
+        tenants = env_number("ANOMOD_SERVE_BENCH_TENANTS", 200)
+        # the fixed shed budget: 8 seconds of capacity worth of backlog —
+        # scale-invariant, so a down-sized contract run sheds in the same
+        # regime as the headline capture (25k/s -> the committed 200k)
+        _, rep = run_power_law(
+            n_tenants=int(tenants), n_services=12,
+            capacity_spans_per_s=float(capacity), overload=2.0,
+            duration_s=float(duration), tick_s=0.5, seed=7,
+            window_s=5.0, baseline_windows=4, fault_tenants=2,
+            max_backlog=int(8 * float(capacity)))
+        d = rep.to_dict()
+        out.update({
+            "value": rep.sustained_spans_per_sec,
+            "p99_admission_to_scored_latency_s":
+                rep.latency.get("p99_latency_s"),
+            "p50_admission_to_scored_latency_s":
+                rep.latency.get("p50_latency_s"),
+            "shed_fraction": rep.shed_fraction,
+            "offered_spans": rep.offered_spans,
+            "served_spans": rep.served_spans,
+            "overload": 2.0,
+            "capacity_spans_per_s": rep.capacity_spans_per_s,
+            "max_backlog": rep.max_backlog,
+            "n_tenants": rep.n_tenants,
+            "duration_virtual_s": rep.duration_s,
+            "serve_wall_s": rep.serve_wall_s,
+            "compile_s": rep.compile_s,
+            "buckets": d["buckets"],
+            "dispatches_by_width": d["dispatches_by_width"],
+            "fault_detection": rep.fault_detection,
+            "n_alerts": rep.n_alerts,
+            "device": str(jax.devices()[0]),
+        })
+        if platform == "cpu":
+            out["device_note"] = diag
+        try:
+            from anomod.provenance import capture_record, write_capture
+            rec = capture_record(out["metric"], out["value"], out["unit"],
+                                 **{k: v for k, v in out.items()
+                                    if k not in ("metric", "value", "unit")})
+            path = write_capture(rec)
+            if path:
+                out["capture_file"] = os.path.relpath(
+                    path, os.path.dirname(os.path.abspath(__file__)))
+        except Exception:
+            pass
+        print(json.dumps(out))
+        return 0
+    except Exception as e:
+        out.update({
+            "device": "unavailable",
+            "error": f"{type(e).__name__}: {e}",
+            "device_note": diag,
+        })
+        print(json.dumps(out))
+        return 1
+
+
 def main() -> int:
-    n_traces = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000
+    argv = list(sys.argv[1:])
+    mode = _bench_mode(argv)
+    if "--mode" in argv:
+        i = argv.index("--mode")
+        del argv[i:i + 2]
+    if mode == "serve":
+        # serve mode is env-knob driven; stray argv must error, not
+        # silently record a capture at the default configuration
+        if argv:
+            raise SystemExit(f"bench.py --mode serve takes no positional "
+                             f"arguments (use ANOMOD_SERVE_BENCH_* env "
+                             f"knobs), got {argv!r}")
+        return serve_main()
+    # replay mode keeps the historical positional contract: one optional
+    # n_traces integer; anything else must error, not silently fall back
+    # to the 2000-trace default (the capture would record a throughput
+    # number for the wrong corpus size)
+    n_traces = 2_000
+    if argv:
+        if len(argv) > 1 or not argv[0].isdigit():
+            raise SystemExit(f"bench.py: expected a single positive "
+                             f"n_traces integer, got {argv!r}")
+        n_traces = int(argv[0])
     out = {
         "metric": "tt_replay_throughput",
         "value": 0.0,

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Pre-bench ingest gate: refuse a capture on a cold cache unless --cold.
+"""Pre-bench gate: ingest-cache warmth (replay mode) / bucket-set
+compilability (serve mode).
 
 A throughput capture taken against a cold ingest cache silently folds host
 synth/parse time into the session (and, before the cache, re-measured it on
@@ -8,11 +9,20 @@ This gate is the scripts/ hook a driver runs before ``python bench.py``:
 
     python scripts/pre_bench_check.py            # exit 0 iff cache is warm
     python scripts/pre_bench_check.py --cold     # cold capture, on purpose
+    python scripts/pre_bench_check.py --mode serve   # serve preconditions
 
-Exit codes: 0 = warm (or --cold / caching disabled is explicit), 1 = cold
-cache without --cold, 2 = caching disabled without --cold.  Always prints
-one JSON line describing the decision.  ``--traces`` must match the bench
-invocation's span count (the cache key includes it).
+Serve mode validates the serve bench's preconditions instead: the
+``ANOMOD_SERVE_BUCKETS`` / ``ANOMOD_SERVE_MAX_BACKLOG`` env contract must
+parse, and the bucket set must COMPILE (every bucket width traced through
+the shared chunk step on the pinned-CPU backend — a bucket set that can't
+compile would burn the capture window mid-serve).  Exit 3 = serve
+preconditions failed.
+
+Exit codes: 0 = ready (warm cache, or --cold / caching disabled is
+explicit, or serve preconditions hold), 1 = cold cache without --cold,
+2 = caching disabled without --cold, 3 = serve precondition failure.
+Always prints one JSON line describing the decision.  ``--traces`` must
+match the bench invocation's span count (the cache key includes it).
 """
 
 import argparse
@@ -23,8 +33,43 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def check_serve() -> int:
+    """Serve-bench preconditions: env contract parses, bucket set
+    compiles.  Runs on the pinned-CPU backend (the gate must never hang
+    on a dead device tunnel — compilability is backend-independent)."""
+    out = {"check": "pre_bench_serve", "mode": "serve"}
+    try:
+        from anomod.utils.platform import pin_cpu
+        pin_cpu(1)
+        from anomod.config import Config
+        cfg = Config()                    # validates the serve env knobs
+        out["buckets"] = list(cfg.serve_buckets)
+        out["max_backlog"] = cfg.serve_max_backlog
+        from anomod.serve.batcher import BucketRunner
+        from anomod.serve.engine import serve_plane_cfg
+        # the serve bench's plane shape (ONE definition with bench.py's
+        # serve path): compile every bucket width once so the capture's
+        # compile_s is warm-path bookkeeping, not a mid-capture stall
+        runner = BucketRunner(serve_plane_cfg(), cfg.serve_buckets)
+        compile_s = runner.warm()
+        out.update(status="ready", widths=list(runner.widths),
+                   compile_s=round(compile_s, 3))
+        print(json.dumps(out))
+        return 0
+    except Exception as e:
+        out.update(status="serve-precondition-failed",
+                   error=f"{type(e).__name__}: {e}")
+        print(json.dumps(out))
+        print(f"pre_bench_check: serve preconditions failed: {e}",
+              file=sys.stderr)
+        return 3
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mode", choices=["replay", "serve"], default="replay",
+                    help="replay: ingest-cache warmth gate (default); "
+                         "serve: serve-bench precondition gate")
     ap.add_argument("--testbed", choices=["SN", "TT"], default="TT")
     ap.add_argument("--traces", type=int, default=2_000,
                     help="bench.py span corpus size (default matches "
@@ -33,6 +78,9 @@ def main(argv=None) -> int:
                     help="allow the capture anyway; the bench line still "
                          "records cache_hit=false for honesty")
     args = ap.parse_args(argv)
+
+    if args.mode == "serve":
+        return check_serve()
 
     from anomod.io import cache
     from anomod.io.dataset import bench_cache_status

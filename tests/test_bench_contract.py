@@ -87,3 +87,40 @@ def test_bench_replicate_override_contract(tmp_path):
     out = json.loads([l for l in r.stdout.strip().splitlines()
                       if l.startswith("{")][0])
     assert out["value"] > 0                # capture survived the bad value
+
+
+def test_bench_serve_mode_contract(tmp_path):
+    """`bench.py --mode serve` on the CPU fallback: exit 0, one JSON line
+    with sustained spans/sec, p99 admission->scored latency and the shed
+    fraction under the seeded 2x overload, plus a provenance record."""
+    env = dict(os.environ)
+    env["ANOMOD_BENCH_PLATFORM"] = "cpu"
+    env["ANOMOD_BENCH_RUNS_DIR"] = str(tmp_path / "runs")
+    # tiny fleet keeps the tier-1 contract fast; the protocol (2x
+    # overload, seeded) is what's under test, not the absolute number
+    env["ANOMOD_SERVE_BENCH_CAPACITY"] = "1500"
+    env["ANOMOD_SERVE_BENCH_DURATION"] = "45"
+    env["ANOMOD_SERVE_BENCH_TENANTS"] = "12"
+    r = subprocess.run(
+        [sys.executable, str(Path(__file__).parent.parent / "bench.py"),
+         "--mode", "serve"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    out = json.loads(lines[0])
+    assert out["metric"] == "serve_sustained_throughput"
+    assert out["unit"] == "spans/sec"
+    assert out["value"] > 0
+    assert out["overload"] == 2.0
+    # 2x overload against a bounded backlog MUST shed
+    assert 0.2 < out["shed_fraction"] < 0.8
+    assert out["p99_admission_to_scored_latency_s"] is not None
+    assert out["served_spans"] > 0
+    assert out["offered_spans"] > out["served_spans"]
+    assert out["device"]
+    runs = list((tmp_path / "runs").glob("*.json"))
+    assert len(runs) == 1
+    rec = json.loads(runs[0].read_text())
+    assert rec["metric"] == "serve_sustained_throughput"
+    assert rec["shed_fraction"] == out["shed_fraction"]

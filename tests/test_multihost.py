@@ -21,6 +21,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+#: the jaxlib limitation fingerprint: CPU collectives backends that cannot
+#: run cross-process computations raise exactly this (the capability is a
+#: jaxlib build property, not a bug in the mesh path under test — real
+#: multi-host runs go over TPU/GPU backends that do implement it)
+_CPU_BACKEND_LIMITATION = (
+    "Multiprocess computations aren't implemented on the CPU backend")
+
+
 def test_two_process_hybrid_mesh_collectives():
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
@@ -36,6 +44,13 @@ def test_two_process_hybrid_mesh_collectives():
     try:
         for p in procs:
             out, err = p.communicate(timeout=240)
+            if p.returncode != 0 and _CPU_BACKEND_LIMITATION in (out + err):
+                pytest.skip(
+                    "this jaxlib's CPU backend cannot run multiprocess "
+                    f"computations ({_CPU_BACKEND_LIMITATION!r}); the "
+                    "2-process mesh path needs a collectives-capable "
+                    "backend (TPU/GPU, or a jaxlib with CPU gloo/mpi "
+                    "collectives)")
             assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
             outs.append(out)
     finally:

@@ -1,0 +1,499 @@
+"""Multi-tenant serving plane: replay parity, weighted-fair admission,
+priority-ordered shedding, SLO accounting, env contract.
+
+The two acceptance-critical pins:
+
+- PARITY: the batched+bucketed serving plane emits the EXACT (bit-
+  identical, CPU, seeded) alert stream of a per-tenant sequential
+  StreamReplay/OnlineDetector on the same spans — padding rows target
+  the dead lane, real rows keep their sequential positions, so the f32
+  state and every alert float match to the bit.
+- OVERLOAD: under a seeded 2x overload, shedding is priority-ordered
+  (gold < silver < bronze shed fractions) and the whole report is
+  deterministic (wall-clock fields aside).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from anomod import labels, synth
+from anomod.replay import ReplayConfig
+from anomod.schemas import take_spans
+from anomod.serve import (AdmissionController, BucketedStreamReplay,
+                          BucketRunner, PowerLawTraffic, ScriptedTraffic,
+                          ServeEngine, TenantSpec, split_plan)
+from anomod.serve.batcher import validate_buckets
+from anomod.serve.traffic import TenantFault
+from anomod.stream import OnlineDetector, StreamReplay
+
+
+# ---------------------------------------------------------------------------
+# batcher: split plan + bucket contract + state parity
+# ---------------------------------------------------------------------------
+
+def test_split_plan_full_chunks_then_bucketed_tail():
+    assert split_plan(0, 4096, (256, 1024)) == []
+    assert split_plan(100, 4096, (256, 1024)) == [(0, 100, 256)]
+    assert split_plan(256, 4096, (256, 1024)) == [(0, 256, 256)]
+    assert split_plan(300, 4096, (256, 1024)) == [(0, 300, 1024)]
+    # tail wider than every bucket pads to the full chunk width
+    assert split_plan(2000, 4096, (256, 1024)) == [(0, 2000, 4096)]
+    assert split_plan(5000, 4096, (256, 1024)) == [
+        (0, 4096, 4096), (4096, 5000, 1024)]
+    # buckets wider than chunk_size never stage (parity would break)
+    assert split_plan(100, 512, (256, 1024)) == [(0, 100, 256)]
+    assert split_plan(400, 512, (256, 1024)) == [(0, 400, 512)]
+
+
+def test_validate_buckets_contract():
+    assert validate_buckets((256, 1024)) == (256, 1024)
+    assert validate_buckets(["8", "16"]) == (8, 16)
+    with pytest.raises(ValueError):
+        validate_buckets(())
+    with pytest.raises(ValueError):
+        validate_buckets((1024, 256))          # not ascending
+    with pytest.raises(ValueError):
+        validate_buckets((256, 256))           # not strictly ascending
+    with pytest.raises(ValueError):
+        validate_buckets((0, 256))
+    with pytest.raises(ValueError):
+        validate_buckets(("x",))
+
+
+def test_bucketed_replay_state_bit_identical_to_stream_replay():
+    """Same pushes through the bucketed runner and the sequential
+    StreamReplay give bit-identical f32 state (the parity mechanism)."""
+    batch = synth.generate_spans(labels.label_for("Lv_P_CPU_preserve"),
+                                 n_traces=60)
+    cfg = ReplayConfig(n_services=batch.n_services, chunk_size=2048)
+    order = np.argsort(batch.start_us, kind="stable")
+    batch = take_spans(batch, order)
+    t0 = int(batch.start_us.min())
+
+    seq = StreamReplay(cfg, t0)
+    bucketed = BucketedStreamReplay(cfg, t0, BucketRunner(cfg, (256, 1024)))
+    cuts = [0, 137, 700, 2500, batch.n_spans]
+    for lo, hi in zip(cuts, cuts[1:]):
+        mb = take_spans(batch, slice(lo, hi))
+        assert seq.push(mb) == bucketed.push(mb)   # same window binning
+    assert seq.window_offset == bucketed.window_offset
+    np.testing.assert_array_equal(np.asarray(seq.state.agg),
+                                  np.asarray(bucketed.state.agg))
+    np.testing.assert_array_equal(np.asarray(seq.state.hist),
+                                  np.asarray(bucketed.state.hist))
+
+
+# ---------------------------------------------------------------------------
+# admission: WFQ order, backpressure, priority eviction
+# ---------------------------------------------------------------------------
+
+def _spans(n):
+    from anomod.schemas import SpanBatch
+    return SpanBatch(
+        trace=np.zeros(n, np.int32), parent=np.full(n, -1, np.int32),
+        service=np.zeros(n, np.int32), endpoint=np.zeros(n, np.int32),
+        start_us=np.arange(n, dtype=np.int64),
+        duration_us=np.ones(n, np.int64),
+        is_error=np.zeros(n, np.bool_), status=np.full(n, 200, np.int16),
+        kind=np.zeros(n, np.int8), services=("s",), endpoints=("e",),
+        trace_ids=("t",)).validate()
+
+
+def test_wfq_serves_by_weight_and_keeps_tenant_fifo():
+    specs = [TenantSpec(0, "gold", priority=0),     # weight 4
+             TenantSpec(1, "bronze", priority=2)]   # weight 1
+    adm = AdmissionController(specs, max_backlog=10_000,
+                              max_tenant_backlog=10_000)
+    for i in range(4):
+        assert adm.offer(0, _spans(100), now_s=0.0)
+        assert adm.offer(1, _spans(100), now_s=0.0)
+    served = adm.drain(500)
+    got = [(qb.tenant_id, qb.seq) for qb in served]
+    # weight 4 vs 1: gold finishes tags at 25/wf spacing vs 100 -> gold's
+    # first four batches drain before bronze's second
+    assert [t for t, _ in got[:4]].count(0) >= 3
+    # per-tenant FIFO: seqs strictly increase within each tenant
+    for tid in (0, 1):
+        seqs = [s for t, s in got if t == tid]
+        assert seqs == sorted(seqs)
+
+
+def test_per_tenant_backlog_bounds_runaway_feed():
+    specs = [TenantSpec(0, "noisy", priority=0),
+             TenantSpec(1, "quiet", priority=2)]
+    adm = AdmissionController(specs, max_backlog=10_000,
+                              max_tenant_backlog=250)
+    assert adm.offer(0, _spans(200), now_s=0.0)
+    assert not adm.offer(0, _spans(200), now_s=0.0)   # own overflow shed
+    assert adm.offer(1, _spans(200), now_s=0.0)       # nobody else pays
+    assert adm.counters[0].shed_spans == 200
+    assert adm.counters[1].shed_spans == 0
+
+
+def test_global_overflow_evicts_strictly_lower_priority_only():
+    specs = [TenantSpec(0, "gold", priority=0),
+             TenantSpec(1, "bronze", priority=2)]
+    adm = AdmissionController(specs, max_backlog=500,
+                              max_tenant_backlog=500)
+    assert adm.offer(1, _spans(400), now_s=0.0)
+    # gold arrival displaces queued bronze work
+    assert adm.offer(0, _spans(400), now_s=1.0)
+    assert adm.counters[1].shed_spans == 400
+    assert adm.backlog_spans == 400
+    # bronze arrival cannot displace queued gold work: it is shed itself
+    assert not adm.offer(1, _spans(400), now_s=2.0)
+    assert adm.counters[0].shed_spans == 0
+
+
+def test_oversized_batch_admits_against_empty_queue():
+    """A batch wider than a backlog bound must still admit when nothing
+    is queued (the admission mirror of drain()'s one-batch overdraw) —
+    otherwise it would be starved forever at any load (review finding)."""
+    specs = [TenantSpec(0, "t", priority=1)]
+    adm = AdmissionController(specs, max_backlog=100,
+                              max_tenant_backlog=100)
+    assert adm.offer(0, _spans(500), now_s=0.0)       # idle: overdraw
+    assert not adm.offer(0, _spans(10), now_s=0.0)    # now bounded
+    assert adm.drain(1_000_000)
+    assert adm.offer(0, _spans(500), now_s=1.0)       # drained: again ok
+    # a gold mega-batch may still displace an all-bronze backlog wholesale
+    specs = [TenantSpec(0, "gold", priority=0),
+             TenantSpec(1, "bronze", priority=2)]
+    adm = AdmissionController(specs, max_backlog=100,
+                              max_tenant_backlog=100)
+    assert adm.offer(1, _spans(80), now_s=0.0)
+    assert adm.offer(0, _spans(500), now_s=1.0)
+    assert adm.counters[1].shed_spans == 80
+
+
+def test_eviction_is_transactional_when_infeasible():
+    """An arrival that cannot fit even after evicting ALL lower-priority
+    work must be shed alone — evicting victims it still can't use would
+    lose both (review finding)."""
+    specs = [TenantSpec(0, "gold", priority=0),
+             TenantSpec(1, "bronze", priority=2)]
+    adm = AdmissionController(specs, max_backlog=500,
+                              max_tenant_backlog=500)
+    assert adm.offer(1, _spans(400), now_s=0.0)
+    assert adm.offer(0, _spans(100), now_s=0.0)       # backlog full: 500
+    # gold 450 needs 450 headroom; only 400 bronze is evictable -> the
+    # arrival sheds and the queued work survives untouched
+    assert not adm.offer(0, _spans(450), now_s=1.0)
+    assert adm.backlog_spans == 500
+    assert adm.counters[1].shed_spans == 0
+    assert adm.counters[0].shed_spans == 450
+
+
+def test_evict_heap_stays_bounded_on_long_healthy_run():
+    """Drained batches must not accumulate forever in the eviction heap
+    on a never-overloaded controller (review finding)."""
+    specs = [TenantSpec(0, "t", priority=1)]
+    adm = AdmissionController(specs, max_backlog=10_000,
+                              max_tenant_backlog=10_000)
+    for _ in range(2000):
+        adm.offer(0, _spans(10), now_s=0.0)
+        adm.drain(1_000_000)
+    assert adm.backlog_spans == 0
+    assert len(adm._evict_heap) < 200
+
+
+def test_drain_overdraws_at_most_one_batch():
+    specs = [TenantSpec(0, "t", priority=1)]
+    adm = AdmissionController(specs, max_backlog=10_000,
+                              max_tenant_backlog=10_000)
+    adm.offer(0, _spans(300), now_s=0.0)
+    adm.offer(0, _spans(300), now_s=0.0)
+    served = adm.drain(100)          # budget smaller than one batch
+    assert len(served) == 1          # overdraw by one, never deadlock
+    assert adm.backlog_spans == 300
+
+
+# ---------------------------------------------------------------------------
+# traffic: determinism, power-law shape, batch cap
+# ---------------------------------------------------------------------------
+
+def test_powerlaw_traffic_deterministic_and_capped():
+    def collect(seed):
+        tr = PowerLawTraffic(n_tenants=8, total_rate_spans_per_s=2000,
+                             seed=seed, n_services=4, batch_cap=128)
+        out = []
+        for k in range(5):
+            out.append([(t, b.n_spans, b.start_us.tolist())
+                        for t, b in tr.arrivals(k * 1.0, (k + 1) * 1.0)])
+        return out
+    a, b_, c = collect(1), collect(1), collect(2)
+    assert a == b_                       # seeded determinism
+    assert a != c                        # seed actually matters
+    assert all(n <= 128 for tick in a for _, n, _ in tick)
+    # power law: the head tenant offers more than the tail tenant
+    tr = PowerLawTraffic(n_tenants=8, total_rate_spans_per_s=2000,
+                         alpha=1.2, seed=0)
+    assert tr.specs[0].rate_spans_per_s > 3 * tr.specs[7].rate_spans_per_s
+
+
+def test_scripted_traffic_slices_by_virtual_time():
+    b = synth.generate_spans(labels.label_for("Normal_case"), n_traces=30)
+    t0 = int(b.start_us.min())
+    tr = ScriptedTraffic({0: b}, [TenantSpec(0, "t")], t0)
+    total = 0
+    t, end = 0.0, tr.end_s() + 60.0
+    while t < end:
+        for tid, mb in tr.arrivals(t, t + 60.0):
+            assert tid == 0
+            assert (mb.start_us >= t0 + t * 1e6).all()
+            assert (mb.start_us < t0 + (t + 60.0) * 1e6).all()
+            total += mb.n_spans
+        t += 60.0
+    assert total == b.n_spans
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pins
+# ---------------------------------------------------------------------------
+
+def test_serving_plane_alert_stream_bit_identical_to_sequential():
+    """THE parity criterion: multi-tenant batched+bucketed serving emits
+    the exact alert stream of per-tenant sequential StreamReplay/
+    OnlineDetector on the same spans (CPU, seeded)."""
+    streams = {
+        0: synth.generate_spans(labels.label_for("Lv_P_CPU_preserve"),
+                                n_traces=120),
+        1: synth.generate_spans(
+            labels.label_for("Lv_C_travel_detail_failure"), n_traces=120),
+    }
+    services = streams[0].services
+    t0 = min(int(b.start_us.min()) for b in streams.values())
+    cfg = ReplayConfig(n_services=len(services), chunk_size=4096)
+    specs = [TenantSpec(tenant_id=i, name=f"t{i}", priority=i % 3)
+             for i in streams]
+    traffic = ScriptedTraffic(streams, specs, t0)
+    duration = traffic.end_s() + 60.0
+
+    eng = ServeEngine(specs, services, cfg, t0_us=t0,
+                      capacity_spans_per_s=10_000_000, tick_s=60.0,
+                      buckets=(256, 1024), max_backlog=10_000_000,
+                      max_tenant_backlog=10_000_000, baseline_windows=8)
+    rep = eng.run(traffic, duration_s=duration)
+    assert rep.shed_spans == 0                      # ample capacity
+    assert rep.n_alerts > 0                         # faults actually alert
+
+    for tid in streams:
+        solo = OnlineDetector(services, cfg, t0,
+                              replay=StreamReplay(cfg, t0),
+                              baseline_windows=8)
+        t = 0.0
+        while t < duration:
+            for tid2, mb in traffic.arrivals(t, t + 60.0):
+                if tid2 == tid:
+                    solo.push(mb)
+            t += 60.0
+        solo.finish()
+        assert [dataclasses.asdict(a) for a in eng.alerts_for(tid)] \
+            == [dataclasses.asdict(a) for a in solo.alerts]
+
+
+def test_multimodal_serving_parity_with_sequential_detector():
+    """Log/metric/api micro-batches ride the serving plane too
+    (MultimodalDetector per tenant): the alert stream stays bit-identical
+    to a sequential multimodal baseline fed the same one-clock slices."""
+    from anomod.stream import MultimodalDetector
+    label = labels.label_for("Svc_Kill_UserTimeline")
+    exp = synth.generate_experiment(label, n_traces=100, seed=0)
+    services = exp.spans.services
+    t0 = int(exp.spans.start_us.min())
+    cfg = ReplayConfig(n_services=len(services), chunk_size=4096)
+    specs = [TenantSpec(tenant_id=0, name="t0")]
+    traffic = ScriptedTraffic({0: exp.spans}, specs, t0,
+                              experiments={0: exp})
+    duration = traffic.end_s() + 60.0
+
+    eng = ServeEngine(specs, services, cfg, t0_us=t0,
+                      capacity_spans_per_s=10_000_000, tick_s=60.0,
+                      buckets=(256, 1024), max_backlog=10_000_000,
+                      max_tenant_backlog=10_000_000, baseline_windows=8,
+                      multimodal=True, testbed=label.testbed)
+    rep = eng.run(traffic, duration_s=duration)
+    assert rep.modality_events["logs"] > 0
+    assert rep.modality_events["metrics"] > 0
+    assert rep.modality_events["api"] > 0
+
+    solo = MultimodalDetector(services, cfg, t0, testbed=label.testbed,
+                              replay=StreamReplay(cfg, t0),
+                              baseline_windows=8)
+    t = 0.0
+    while t < duration:
+        for _, kind, mb in traffic.modality_arrivals(t, t + 60.0):
+            getattr(solo, f"push_{kind}")(mb)
+        for _, mb in traffic.arrivals(t, t + 60.0):
+            solo.push(mb)
+        t += 60.0
+    solo.finish()
+    assert solo.alerts                           # the kill fault alerts
+    assert [dataclasses.asdict(a) for a in eng.alerts_for(0)] \
+        == [dataclasses.asdict(a) for a in solo.alerts]
+
+
+def _overload_report(seed, score=False):
+    traffic = PowerLawTraffic(
+        n_tenants=12, total_rate_spans_per_s=2000, alpha=0.0, seed=seed,
+        n_services=4, batch_cap=128)
+    cfg = ReplayConfig(n_services=4, n_windows=16, window_us=5_000_000,
+                       chunk_size=1024)
+    eng = ServeEngine(traffic.specs, traffic.services, cfg,
+                      capacity_spans_per_s=1000, tick_s=1.0,
+                      buckets=(128, 512), max_backlog=1500,
+                      max_tenant_backlog=1500, score=score,
+                      baseline_windows=4)
+    return eng.run(traffic, duration_s=40.0)
+
+
+def test_overload_shedding_is_priority_ordered_and_deterministic():
+    """Seeded 2x overload: shed fractions order strictly by priority
+    class, and the whole report reproduces bit-for-bit (wall-clock
+    fields aside)."""
+    rep = _overload_report(5)
+    assert rep.offered_spans > 1.8 * rep.served_spans   # real overload
+    assert 0.3 < rep.shed_fraction < 0.7
+    pp = rep.per_priority
+    assert pp[0]["shed_fraction"] < pp[1]["shed_fraction"] \
+        < pp[2]["shed_fraction"]
+    # gold's weighted share exceeds its equal-rate demand -> barely shed
+    assert pp[0]["shed_fraction"] < 0.1
+    # backpressure: the backlog never exceeded its bound
+    assert rep.peak_backlog_spans <= rep.max_backlog
+    # queueing under overload is visible in the latency sketch
+    assert rep.latency["p99_latency_s"] > 0
+
+    wall = ("serve_wall_s", "sustained_spans_per_sec", "compile_s")
+    a = {k: v for k, v in _overload_report(5).to_dict().items()
+         if k not in wall}
+    b = {k: v for k, v in _overload_report(5).to_dict().items()
+         if k not in wall}
+    assert a == b
+
+
+def test_engine_smoke_scores_and_detects_fault_under_load():
+    """Tier-1 smoke (<5s): a small scored run serves, sheds, tracks SLOs
+    and detects a scripted tenant fault."""
+    traffic = PowerLawTraffic(
+        n_tenants=6, total_rate_spans_per_s=1200, alpha=0.0, seed=3,
+        n_services=4, batch_cap=256,
+        faults={1: TenantFault("latency", service=1, onset_s=30.0,
+                               factor=12.0)})
+    cfg = ReplayConfig(n_services=4, n_windows=16, window_us=5_000_000,
+                       chunk_size=1024)
+    eng = ServeEngine(traffic.specs, traffic.services, cfg,
+                      capacity_spans_per_s=900, tick_s=1.0,
+                      buckets=(256,), max_backlog=2000, baseline_windows=4)
+    rep = eng.run(traffic, duration_s=60.0)
+    assert rep.served_spans > 0 and rep.shed_spans > 0
+    assert rep.fault_detection == {
+        "n_fault_tenants": 1, "n_detected": 1,
+        "median_alert_latency_windows":
+            rep.fault_detection["median_alert_latency_windows"]}
+    assert rep.fault_detection["median_alert_latency_windows"] is not None
+    assert rep.fault_detection["median_alert_latency_windows"] <= 4
+    assert rep.sustained_spans_per_sec > 0
+    d = rep.to_dict()
+    import json
+    json.dumps(d)                                  # report is JSON-able
+    assert d["dispatches_by_width"] and \
+        set(d["dispatches_by_width"]) <= {"256", "1024"}
+
+
+def test_mesh_serve_matches_bucketed_alert_set():
+    """With ``mesh=`` every tenant's plane is the pod-sharded
+    ShardedStreamReplay, reused unchanged.  psum merge reorders the f32
+    moment additions, so the pin is alert (window, service) identity,
+    not bit equality (same contract as the existing sharded-stream
+    parity tests)."""
+    from anomod.parallel import make_mesh
+    traffic = PowerLawTraffic(
+        n_tenants=2, total_rate_spans_per_s=600, alpha=0.0, seed=2,
+        n_services=4, batch_cap=256,
+        faults={0: TenantFault("latency", service=1, onset_s=30.0,
+                               factor=12.0)})
+    cfg = ReplayConfig(n_services=4, n_windows=16, window_us=5_000_000,
+                       chunk_size=512)
+    kw = dict(capacity_spans_per_s=10_000, tick_s=1.0, buckets=(256,),
+              max_backlog=100_000, max_tenant_backlog=100_000,
+              baseline_windows=4)
+    eng_mesh = ServeEngine(traffic.specs, traffic.services, cfg,
+                           mesh=make_mesh(2), **kw)
+    eng_mesh.run(traffic, duration_s=50.0)
+    traffic2 = PowerLawTraffic(
+        n_tenants=2, total_rate_spans_per_s=600, alpha=0.0, seed=2,
+        n_services=4, batch_cap=256,
+        faults={0: TenantFault("latency", service=1, onset_s=30.0,
+                               factor=12.0)})
+    eng_bkt = ServeEngine(traffic2.specs, traffic2.services, cfg, **kw)
+    eng_bkt.run(traffic2, duration_s=50.0)
+    for tid in (0, 1):
+        assert [(a.window, a.service) for a in eng_mesh.alerts_for(tid)] \
+            == [(a.window, a.service) for a in eng_bkt.alerts_for(tid)]
+    assert eng_mesh.alerts_for(0)          # the fault actually alerted
+
+
+def test_tracer_records_serving_phases():
+    from anomod.utils.tracing import Tracer
+    tracer = Tracer("anomod-serve")
+    traffic = PowerLawTraffic(n_tenants=3, total_rate_spans_per_s=300,
+                              seed=0, n_services=4)
+    cfg = ReplayConfig(n_services=4, n_windows=16, window_us=5_000_000,
+                       chunk_size=512)
+    eng = ServeEngine(traffic.specs, traffic.services, cfg,
+                      capacity_spans_per_s=500, tick_s=1.0,
+                      buckets=(256,), score=False, tracer=tracer)
+    eng.run(traffic, duration_s=10.0)
+    names = {s["operationName"]
+             for s in tracer.to_jaeger()["data"][0]["spans"]}
+    assert {"serve.run", "serve.admit", "serve.drain",
+            "serve.score"} <= names
+
+
+# ---------------------------------------------------------------------------
+# env contract
+# ---------------------------------------------------------------------------
+
+def test_serve_env_knobs_registered_and_validated(monkeypatch):
+    from anomod.config import Config
+    monkeypatch.setenv("ANOMOD_SERVE_BUCKETS", "128, 512,2048")
+    monkeypatch.setenv("ANOMOD_SERVE_MAX_BACKLOG", "5000")
+    cfg = Config()
+    assert cfg.serve_buckets == (128, 512, 2048)
+    assert cfg.serve_max_backlog == 5000
+
+    monkeypatch.setenv("ANOMOD_SERVE_BUCKETS", "512,128")
+    with pytest.raises(ValueError, match="ANOMOD_SERVE_BUCKETS"):
+        Config()
+    monkeypatch.setenv("ANOMOD_SERVE_BUCKETS", "banana")
+    with pytest.raises(ValueError, match="ANOMOD_SERVE_BUCKETS"):
+        Config()
+    monkeypatch.delenv("ANOMOD_SERVE_BUCKETS")
+    monkeypatch.setenv("ANOMOD_SERVE_MAX_BACKLOG", "0")
+    with pytest.raises(ValueError, match="ANOMOD_SERVE_MAX_BACKLOG"):
+        Config()
+    monkeypatch.setenv("ANOMOD_SERVE_MAX_BACKLOG", "many")
+    with pytest.raises(ValueError, match="ANOMOD_SERVE_MAX_BACKLOG"):
+        Config()
+    monkeypatch.delenv("ANOMOD_SERVE_MAX_BACKLOG")
+    from anomod.serve.batcher import DEFAULT_BUCKETS
+    assert Config().serve_buckets == DEFAULT_BUCKETS
+
+
+def test_serve_cli_emits_report(capsys):
+    from anomod.cli import main
+    rc = main(["serve", "--tenants", "4", "--services", "4",
+               "--duration", "20", "--capacity", "400",
+               "--overload", "2.0", "--buckets", "128,512",
+               "--max-backlog", "800", "--fault-tenants", "0",
+               "--no-score", "--seed", "1"])
+    assert rc == 0
+    import json
+    out = json.loads(capsys.readouterr().out)
+    assert out["n_tenants"] == 4
+    assert out["offered_spans"] > 0
+    assert out["buckets"] == [128, 512]
+    assert 0.0 <= out["shed_fraction"] <= 1.0

@@ -61,3 +61,38 @@ def test_parent_resolution_rate_reported_and_warned():
     rep2 = validate_experiment(broken)
     assert rep2.counts["parent_resolution_rate"] == 0.0
     assert any("resolved parent" in i.message for i in rep2.issues)
+
+
+def test_from_data_with_fresh_cache_dir_reports_zero_counters(tmp_path,
+                                                              monkeypatch):
+    """Regression (serving-plane PR satellite): `anomod validate
+    --from-data` pointed at an EMPTY/fresh ANOMOD_CACHE_DIR must not
+    crash — the corpus loads through an all-miss cache and the report
+    carries honest zero-hit counters."""
+    import dataclasses
+
+    from anomod.config import Config
+    from anomod.io import cache as ingest_cache
+    from anomod.io import dataset
+    from anomod.validate import corpus_summary, validate_experiment
+
+    fresh = tmp_path / "fresh-cache"          # does not even exist yet
+    cfg = dataclasses.replace(Config(), cache_dir=fresh,
+                              data_root=tmp_path / "no-data-root")
+    ingest_cache.reset_stats()
+    exp = dataset.load_experiment("Normal_case", cfg=cfg,
+                                  modalities=["traces", "logs"],
+                                  n_synth_traces=3)
+    rep = validate_experiment(exp)
+    out = corpus_summary("TT", [rep],
+                         cache_stats=ingest_cache.stats().to_dict())
+    assert out["ingest_cache"]["hits"] == 0
+    assert out["ingest_cache"]["errors"] == 0
+    assert out["ingest_cache"]["misses"] > 0
+    assert out["reports"][0]["counts"]["spans"] > 0
+    # and the fresh dir is now a populated cache: a second load hits
+    ingest_cache.reset_stats()
+    dataset.load_experiment("Normal_case", cfg=cfg,
+                            modalities=["traces", "logs"],
+                            n_synth_traces=3)
+    assert ingest_cache.stats().hits > 0
