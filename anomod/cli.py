@@ -366,6 +366,34 @@ def main(argv=None) -> int:
                          help="dump the engine's own Jaeger-shaped trace "
                               "(anomod.utils.tracing.Tracer)")
 
+    p_obs = sub.add_parser(
+        "obs", help="self-scraping telemetry plane (anomod.obs): snapshot "
+        "the metrics registry, export it (Prometheus text / the "
+        "framework's own TT metric CSV), or score a self-scrape capture "
+        "through the framework's own OnlineDetector stack")
+    p_obs.add_argument("action", choices=["snapshot", "export", "score"])
+    p_obs.add_argument("--from", dest="from_path", default=None,
+                       help="score: TT-CSV self-scrape capture to load "
+                            "(default: run the self-exercise and score "
+                            "its own telemetry)")
+    p_obs.add_argument("--out", default=None,
+                       help="export: output file path (required)")
+    p_obs.add_argument("--format", choices=["json", "prom", "tt-csv"],
+                       default=None,
+                       help="snapshot: json (default) or prom; "
+                            "export: tt-csv (default) or prom")
+    p_obs.add_argument("--serve-seconds", type=float, default=20.0,
+                       help="virtual seconds of the seeded self-exercise "
+                            "serve run that populates the registry")
+    p_obs.add_argument("--tenants", type=int, default=24)
+    p_obs.add_argument("--capacity", type=float, default=4000.0,
+                       help="self-exercise serving capacity (spans/sec)")
+    p_obs.add_argument("--seed", type=int, default=0)
+    p_obs.add_argument("--window-seconds", type=float, default=5.0,
+                       help="score: detector window width")
+    p_obs.add_argument("--baseline-windows", type=int, default=4)
+    p_obs.add_argument("--threshold", type=float, default=4.0)
+
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
         "fault severity with noise + confounders (HardMode)")
@@ -606,6 +634,64 @@ def main(argv=None) -> int:
                 out["top1_hit"] = bool(ranked) and \
                     ranked[0] == label.target_service
         print(json.dumps(out, indent=2))
+        return 0
+
+    if args.cmd == "obs":
+        if args.action == "export" and not args.out:
+            parser.error("obs export needs --out")
+        if args.action != "score" and args.from_path:
+            parser.error("--from applies to obs score")
+        if args.action == "snapshot" and args.format == "tt-csv":
+            parser.error("snapshot prints point-in-time state; the time "
+                         "series export is `obs export` (tt-csv)")
+        if args.action == "export" and args.format == "json":
+            parser.error("obs export writes prom or tt-csv; `obs "
+                         "snapshot` is the JSON view")
+        from anomod.obs.selfscrape import score_self_scrape
+        if args.action == "score" and args.from_path:
+            # scoring an existing capture needs jax (the detector stack)
+            # but no serve run
+            _probe_backend(args)
+            print(json.dumps(score_self_scrape(
+                args.from_path, window_s=args.window_seconds,
+                baseline_windows=args.baseline_windows,
+                z_threshold=args.threshold), indent=2))
+            return 0
+        _probe_backend(args)
+        from anomod.obs.selfscrape import self_exercise
+        reg = self_exercise(duration_s=args.serve_seconds,
+                            n_tenants=args.tenants,
+                            capacity_spans_per_s=args.capacity,
+                            seed=args.seed)
+        if args.action == "snapshot":
+            if args.format == "prom":
+                from anomod.obs.export import to_prometheus_text
+                print(to_prometheus_text(reg), end="")
+            else:
+                print(json.dumps({"n_journal_samples": reg.n_samples,
+                                  "metrics": reg.snapshot()}, indent=2))
+            return 0
+        if args.action == "export":
+            if args.format == "prom":
+                from anomod.obs.export import export_prometheus_text
+                n = export_prometheus_text(reg, args.out)
+                # prom is a point-in-time view: count METRICS, not the
+                # journal's time-series samples
+                print(json.dumps({"out": args.out, "format": "prom",
+                                  "metrics": n}))
+            else:
+                from anomod.obs.export import export_tt_csv
+                n = export_tt_csv(reg, args.out)
+                print(json.dumps({"out": args.out, "format": "tt-csv",
+                                  "samples": n}))
+            return 0
+        # score the self-exercise's own telemetry (registry -> MetricBatch
+        # -> detector), no file round trip
+        from anomod.obs.export import to_metric_batch
+        print(json.dumps(score_self_scrape(
+            to_metric_batch(reg), window_s=args.window_seconds,
+            baseline_windows=args.baseline_windows,
+            z_threshold=args.threshold), indent=2))
         return 0
 
     if args.cmd == "serve":

@@ -119,6 +119,37 @@ def _serve_max_backlog_env() -> int:
     return n
 
 
+def _obs_enabled_env() -> bool:
+    """ANOMOD_OBS_ENABLED: process-wide metrics registry switch.
+
+    Default ON — the hot-path cost of a disabled-check-free counter bump
+    is nanoseconds, and the serve bench pins the enabled-vs-off overhead
+    at <= 5% — "0"/"false"/"off" turns every metric handle into a shared
+    no-op object (anomod.obs.registry)."""
+    return _env("ANOMOD_OBS_ENABLED", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+def _obs_max_samples_env() -> int:
+    """ANOMOD_OBS_MAX_SAMPLES: scrape-journal bound (samples).
+
+    The registry's time-series journal (what the TT-CSV self-scrape
+    export reads) is a bounded deque — oldest samples drop past this, so
+    an unbounded run cannot grow host memory without bound.  Validated
+    here so a typo fails loudly at config construction."""
+    raw = _env("ANOMOD_OBS_MAX_SAMPLES", "500000")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_OBS_MAX_SAMPLES must be a positive integer, "
+            f"got {raw!r}")
+    if n < 1:
+        raise ValueError(
+            f"ANOMOD_OBS_MAX_SAMPLES must be >= 1, got {n}")
+    return n
+
+
 @dataclasses.dataclass(frozen=True)
 class Config:
     """Global framework configuration.
@@ -152,6 +183,13 @@ class Config:
     # (anomod.serve.queues; the backpressure/shed budget).
     serve_max_backlog: int = dataclasses.field(
         default_factory=_serve_max_backlog_env)
+    # ANOMOD_OBS_ENABLED — process-wide metrics registry switch
+    # (anomod.obs.registry; off = shared no-op metric handles).
+    obs_enabled: bool = dataclasses.field(default_factory=_obs_enabled_env)
+    # ANOMOD_OBS_MAX_SAMPLES — scrape-journal bound in samples
+    # (anomod.obs.registry; oldest samples drop past it).
+    obs_max_samples: int = dataclasses.field(
+        default_factory=_obs_max_samples_env)
 
     @property
     def sn_data(self) -> Path:

@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from anomod import obs
 from anomod.schemas import (ApiBatch, CoverageBatch, LogBatch, LogSummary,
                             MetricBatch, SpanBatch)
 
@@ -64,6 +65,13 @@ class CacheStats:
 _STATS = CacheStats()
 
 
+def _count(event: str, n: int = 1) -> None:
+    """Bump the process CacheStats counter AND its registry mirror —
+    one call site per event, so the two views can never drift."""
+    setattr(_STATS, event, getattr(_STATS, event) + n)
+    obs.counter(f"anomod_ingest_cache_{event}_total").inc(n)
+
+
 def stats() -> CacheStats:
     return _STATS
 
@@ -78,7 +86,7 @@ def merge_stats(other: dict) -> None:
     (the spawn-pool loader's globals never propagate back on their own)."""
     for k, v in other.items():
         if hasattr(_STATS, k):
-            setattr(_STATS, k, getattr(_STATS, k) + int(v))
+            _count(k, int(v))
 
 
 # ---------------------------------------------------------------------------
@@ -327,7 +335,9 @@ def store(root: Path, key: str, kind: str, value,
         _atomic_publish(json_path,
                         lambda f: json.dump(meta, f, sort_keys=True),
                         mode="w")
-        _STATS.stores += 1
+        _count("stores")
+        obs.counter("anomod_ingest_cache_written_bytes_total").inc(
+            sum(int(a.nbytes) for a in arrays.values()))
         return True
     except OSError:
         return False
@@ -346,15 +356,16 @@ def load(root: Path, key: str, kind: str):
             data = bytearray(f.read())
     except OSError:
         return None
+    obs.counter("anomod_ingest_cache_read_bytes_total").inc(len(data))
     try:
         arrays, meta = _read_payload(data)
         if (meta.get("key") != key or meta.get("kind") != kind
                 or meta.get("cache_format_version") != CACHE_FORMAT_VERSION):
-            _STATS.errors += 1
+            _count("errors")
             return None
         return _decode(kind, arrays, meta), meta
     except Exception:
-        _STATS.errors += 1
+        _count("errors")
         return None
 
 
@@ -373,9 +384,9 @@ def cached(kind: str, key_parts: Dict[str, Any],
     if root is not None:
         got = load(root, key, kind)
         if got is not None:
-            _STATS.hits += 1
+            _count("hits")
             return got[0], True, got[1]
-        _STATS.misses += 1
+        _count("misses")
     t0 = time.perf_counter()
     value = compute()
     parse_s = time.perf_counter() - t0

@@ -284,12 +284,32 @@ def load_corpus(testbed: str, cfg: Optional[Config] = None,
         workers = cfg.ingest_workers
     if workers and workers > 1 and len(names) > 1:
         import multiprocessing
+        import time as _time
         from concurrent.futures import ProcessPoolExecutor
+
+        from anomod import obs
+        depth = obs.gauge("anomod_ingest_pool_pending")
+        wall = obs.histogram("anomod_ingest_pool_experiment_seconds")
         ctx = multiprocessing.get_context("spawn")
         with ProcessPoolExecutor(max_workers=min(workers, len(names)),
                                  mp_context=ctx) as pool:
-            futs = [pool.submit(_load_experiment_task, n, testbed, cfg,
-                                modalities, n_synth_traces) for n in names]
+            t0 = _time.perf_counter()
+
+            def done(_f):
+                # submit→result wall + queue depth recorded at COMPLETION
+                # (executor callback thread), not at the in-order drain
+                # below — a fast experiment finishing behind a slow one
+                # must not inherit the slow one's wall
+                wall.observe(_time.perf_counter() - t0)
+                depth.dec()
+
+            futs = []
+            for n in names:
+                depth.inc()        # before submit: a dec can never race
+                f = pool.submit(_load_experiment_task, n, testbed, cfg,
+                                modalities, n_synth_traces)
+                f.add_done_callback(done)
+                futs.append(f)
             out = []
             for f in futs:
                 exp, worker_stats = f.result()

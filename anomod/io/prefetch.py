@@ -16,9 +16,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
 import numpy as np
+
+from anomod import obs
 
 _SENTINEL = object()
 
@@ -46,13 +49,28 @@ class Pipeline:
         self._stop = threading.Event()
         self._done = False
         self._err: Optional[BaseException] = None
+        # staging telemetry: per-item staging wall (the host->device
+        # transfer seconds when fn is device_put) + the buffer occupancy
+        # the consumer sees — a persistently full queue means the device
+        # is the bottleneck, a persistently empty one means the host is
+        stage_s = obs.histogram("anomod_prefetch_stage_seconds")
+        # one handle shared by producer AND consumer (__next__): cached
+        # here so the per-item hot path never pays a registry lookup and
+        # a mid-iteration set_registry swap can't split the two sides
+        # across registries
+        self._occupancy = obs.gauge("anomod_prefetch_queue_depth")
+        occupancy = self._occupancy
 
         def work():
             try:
                 for item in iterable:
                     if self._stop.is_set():
                         return
-                    self._q.put(fn(item))
+                    t0 = time.perf_counter()
+                    staged = fn(item)
+                    stage_s.observe(time.perf_counter() - t0)
+                    self._q.put(staged)
+                    occupancy.set(self._q.qsize())
             except BaseException as e:       # re-raised on the consumer side
                 self._err = e
             finally:
@@ -69,6 +87,7 @@ class Pipeline:
         if self._done:
             raise StopIteration
         item = self._q.get()
+        self._occupancy.set(self._q.qsize())
         if item is _SENTINEL:
             self._done = True
             self._thread.join()

@@ -1,0 +1,140 @@
+"""Registry exporters: Prometheus text format + the framework's own shapes.
+
+Two sinks, one registry:
+
+- :func:`to_prometheus_text` renders the point-in-time state in the
+  Prometheus exposition format (``# TYPE`` + samples; histograms as
+  summaries with ``quantile`` labels) — the shape every external scraper
+  speaks, and the shape the reference testbeds' own monitoring exported
+  (fetch_prometheus_metrics.py).
+- :func:`to_metric_batch` / :func:`export_tt_csv` materialize the scrape
+  JOURNAL (the time series, not the last value) as the framework's own
+  ``MetricBatch`` / TT long-CSV shapes — ``write_metric_batch_tt_csv``
+  out, ``load_tt_metric_csv`` back — which is what closes the dogfood
+  loop: a run's telemetry scores through the same detector stack as any
+  monitored SUT (anomod.obs.selfscrape).
+
+The CSV export publishes atomically (same-directory tmp + ``os.replace``,
+the anomod.io.cache idiom) so a killed run never leaves a truncated
+capture.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Dict, List
+
+import numpy as np
+
+from anomod.obs.registry import Registry, render_labels, subsystem_of
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integral floats render bare."""
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def to_prometheus_text(registry: Registry) -> str:
+    """Point-in-time registry state in the Prometheus text format."""
+    lines: List[str] = []
+    for m in sorted(registry.metrics(), key=lambda m: m.name):
+        base = render_labels(m.labels)
+        brace = f"{{{base}}}" if base else ""
+        if m.kind == "histogram":
+            # t-digest histograms export as Prometheus SUMMARIES: the
+            # sketch stores quantiles, not cumulative bucket counts
+            lines.append(f"# TYPE {m.name} summary")
+            p50 = m.quantile(0.5)
+            if p50 is not None:
+                for q, v in (("0.5", p50), ("0.99", m.quantile(0.99))):
+                    ql = render_labels({**m.labels, "quantile": q})
+                    lines.append(f"{m.name}{{{ql}}} {_fmt(v)}")
+            lines.append(f"{m.name}_sum{brace} {_fmt(m.sum)}")
+            lines.append(f"{m.name}_count{brace} {_fmt(m.count)}")
+        else:
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.append(f"{m.name}{brace} {_fmt(m.value)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def to_metric_batch(registry: Registry):
+    """The scrape journal as a ``MetricBatch``.
+
+    Services are the metric-name subsystems (``anomod_serve_...`` ->
+    ``serve``) and every series key carries a ``service="<subsystem>"``
+    label alongside the metric's own labels, so the batch drops straight
+    into ``MultimodalDetector.push_metrics`` with correct per-service
+    attribution — no re-derivation needed on the direct (non-CSV) path.
+    """
+    from anomod.schemas import MetricBatch
+    rows = registry.journal()
+    metric_names: Dict[str, int] = {}
+    series_keys: Dict[str, int] = {}
+    services: Dict[str, int] = {}
+    series_service: List[int] = []
+    n = len(rows)
+    metric_c = np.zeros(n, np.int32)
+    series_c = np.zeros(n, np.int32)
+    t_c = np.zeros(n, np.float64)
+    v_c = np.zeros(n, np.float64)
+    for i, (t_s, name, labels_str, value) in enumerate(rows):
+        metric_c[i] = metric_names.setdefault(name, len(metric_names))
+        sub = subsystem_of(name)
+        key = f'service="{sub}"' + (f",{labels_str}" if labels_str else "")
+        if key not in series_keys:
+            series_keys[key] = len(series_keys)
+            series_service.append(
+                services.setdefault(sub, len(services)))
+        series_c[i] = series_keys[key]
+        t_c[i] = t_s
+        v_c[i] = value
+    return MetricBatch(
+        metric=metric_c, series=series_c, t_s=t_c, value=v_c,
+        metric_names=tuple(metric_names), series_keys=tuple(series_keys),
+        series_service=np.asarray(series_service or [0],
+                                  np.int32)[:len(series_keys)],
+        services=tuple(services))
+
+
+def export_prometheus_text(registry: Registry, path) -> int:
+    """Write the point-in-time Prometheus text view (atomic publish);
+    returns the number of metrics rendered."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
+    try:
+        tmp.write_text(to_prometheus_text(registry))
+        os.replace(tmp, path)
+    finally:
+        if tmp.exists():
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+    return len(registry.metrics())
+
+
+def export_tt_csv(registry: Registry, path) -> int:
+    """Write the scrape journal in the TT long-CSV shape (atomic publish);
+    returns the number of samples written.
+
+    The file round-trips through ``anomod.io.metrics.load_tt_metric_csv``
+    — the framework's own loader — which is the self-scrape contract the
+    scorer (anomod.obs.selfscrape) and the committed bench capture rely
+    on."""
+    from anomod.io.metrics import write_metric_batch_tt_csv
+    batch = to_metric_batch(registry)
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
+    try:
+        write_metric_batch_tt_csv(batch, tmp)
+        os.replace(tmp, path)
+    finally:
+        if tmp.exists():
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+    return batch.n_samples

@@ -1,0 +1,399 @@
+"""Process-wide metrics registry: Counter / Gauge / Histogram.
+
+The framework's whole premise is synchronized telemetry feeding anomaly
+detection — so its OWN runtime emits the same three shapes every
+monitoring stack does, with two twists that keep it true to the repo:
+
+- **Histograms are t-digest sketches** (anomod.ops.tdigest — the repo's
+  one sketch path), not fixed buckets: per-tenant serving telemetry is
+  power-law-skewed (cf. the Sparse Allreduce observation, PAPERS.md), so
+  a fixed bucket ladder either saturates or wastes resolution, while the
+  digest keeps mergeable tail accuracy at a constant 32-centroid
+  footprint.  The same ``TDigest`` merges the serving plane's private
+  per-tenant SLO digests straight into the registry
+  (:meth:`Histogram.merge_digest`).
+- **The registry is a time series, not just a last-value store**:
+  :meth:`Registry.scrape` appends every metric's current samples to a
+  bounded journal with a caller-supplied clock (the serving plane scrapes
+  on its deterministic VIRTUAL clock), and the journal exports to the
+  framework's own ``MetricBatch`` / TT-CSV shapes (anomod.obs.export) so
+  a run's telemetry loads back through ``load_tt_metric_csv`` and scores
+  through the detector stack — the framework monitors itself.
+
+Hot-path cost: one dict ``get`` at handle lookup (call sites cache
+handles where it matters) and one small-lock update per record.  With
+``ANOMOD_OBS_ENABLED=0`` every constructor returns the shared
+:data:`NULL` no-op handle, so instrumented code never branches.
+
+Metric naming convention: ``anomod_<subsystem>_<what>[_unit][_total]``
+— the subsystem token is load-bearing: the self-scrape scorer
+(anomod.obs.selfscrape) maps each metric to its subsystem as the
+detector's "service", which is what lets an injected serve-plane stall
+localize to ``serve``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from anomod.ops.tdigest import (TDigest, tdigest_build, tdigest_merge_many,
+                                tdigest_quantile)
+
+#: digest capacity for histogram sketches (same accuracy class as the
+#: serving plane's _TenantSLO digests)
+_DIGEST_K = 32
+#: samples buffered per histogram before folding into the digest
+_FOLD_EVERY = 256
+
+
+def render_labels(labels: Dict[str, str]) -> str:
+    """Canonical label rendering — the io.metrics series-key shape
+    (``k="v"`` sorted, comma-joined), so exported series keys read the
+    same as every loaded corpus's."""
+    return ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+
+
+def subsystem_of(name: str) -> str:
+    """The subsystem token of a metric name (``anomod_serve_...`` ->
+    ``serve``) — the self-scrape scorer's service identity."""
+    parts = name.split("_")
+    if len(parts) >= 2 and parts[0] == "anomod":
+        return parts[1]
+    return parts[0] or "anomod"
+
+
+class _NullMetric:
+    """Shared no-op handle for a disabled registry: every recording
+    method exists and does nothing, so instrumented hot paths never
+    branch on enablement."""
+
+    __slots__ = ()
+    value = 0.0
+    count = 0
+    sum = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def dec(self, n: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+    def merge_digest(self, digest) -> None:
+        pass
+
+    def quantile(self, q: float):
+        return None
+
+    def samples(self):
+        return []
+
+
+NULL = _NullMetric()
+
+
+class Counter:
+    """Monotone accumulator; ``samples()`` exports the running total."""
+
+    kind = "counter"
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def samples(self) -> List[Tuple[str, float]]:
+        return [(self.name, self._value)]
+
+
+class Gauge:
+    """Last-value metric with inc/dec convenience."""
+
+    kind = "gauge"
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def samples(self) -> List[Tuple[str, float]]:
+        return [(self.name, self._value)]
+
+
+class Histogram:
+    """t-digest-backed distribution sketch.
+
+    ``observe`` appends to a small buffer and folds into the digest every
+    ``_FOLD_EVERY`` samples (the _TenantSLO cadence) — the hot path is a
+    list append, the sketch work is amortized.  ``merge_digest`` folds a
+    foreign :class:`TDigest` (e.g. a serve tenant's SLO sketch) into this
+    histogram's, weight-preserving, so pre-sketched telemetry joins the
+    registry without replaying raw samples.
+    """
+
+    kind = "histogram"
+    __slots__ = ("name", "labels", "_lock", "_buf", "_digest",
+                 "count", "sum", "_max", "_n_folds", "_q_cache")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+        self._buf: List[float] = []
+        self._digest: Optional[TDigest] = None
+        self.count = 0
+        self.sum = 0.0
+        self._max = 0.0
+        self._n_folds = 0
+        # (fold generation, p50, p99) — the scrape path recomputes
+        # quantiles only when the DIGEST changed, so a per-tick scrape
+        # costs dict lookups, not a tdigest build (the <=5% serve
+        # telemetry-overhead bar is won here)
+        self._q_cache: Optional[Tuple[int, float, float]] = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._buf.append(v)
+            self.count += 1
+            self.sum += v
+            self._max = max(self._max, v)
+            if len(self._buf) >= _FOLD_EVERY:
+                self._fold_locked()
+
+    def merge_digest(self, digest: TDigest) -> None:
+        """Fold a pre-built digest in (count/sum book via its weights)."""
+        w = float(np.asarray(digest.weight).sum())
+        if w <= 0:
+            return
+        with self._lock:
+            self.count += int(round(w))
+            self.sum += float((np.asarray(digest.mean)
+                               * np.asarray(digest.weight)).sum())
+            self._max = max(self._max,
+                            float(np.asarray(digest.mean)[
+                                np.asarray(digest.weight) > 0].max()))
+            self._digest = digest if self._digest is None else \
+                tdigest_merge_many([self._digest, digest])
+            self._n_folds += 1
+
+    def _fold_locked(self) -> None:
+        if not self._buf:
+            return
+        d = tdigest_build(np.asarray(self._buf, np.float32), k=_DIGEST_K)
+        self._digest = d if self._digest is None else \
+            tdigest_merge_many([self._digest, d])
+        self._buf = []
+        self._n_folds += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            self._fold_locked()
+            if self._digest is None or \
+                    float(self._digest.weight.sum()) <= 0:
+                return None
+            return float(tdigest_quantile(self._digest, q))
+
+    def _quantiles_cached(self) -> Optional[Tuple[float, float]]:
+        """(p50, p99) from the digest alone, recomputed only when the
+        digest changed.  The scrape path's cheap read: pending buffer
+        samples fold in early only once enough of them pile up (64), so
+        scrape-time quantiles may lag the newest few observations — the
+        price of a per-tick scrape that costs microseconds."""
+        with self._lock:
+            if self._digest is None or len(self._buf) >= 64:
+                self._fold_locked()
+            if self._digest is None:
+                return None
+            cached = self._q_cache
+            if cached is not None and cached[0] == self._n_folds:
+                return cached[1], cached[2]
+            if float(self._digest.weight.sum()) <= 0:
+                return None
+            p50 = float(tdigest_quantile(self._digest, 0.5))
+            p99 = float(tdigest_quantile(self._digest, 0.99))
+            self._q_cache = (self._n_folds, p50, p99)
+            return p50, p99
+
+    def samples(self) -> List[Tuple[str, float]]:
+        out = [(f"{self.name}_count", float(self.count)),
+               (f"{self.name}_sum", self.sum)]
+        qs = self._quantiles_cached()
+        if qs is not None:
+            out.append((f"{self.name}_p50", qs[0]))
+            out.append((f"{self.name}_p99", qs[1]))
+            out.append((f"{self.name}_max", self._max))
+        return out
+
+
+#: one journal row: (t_s, sample_name, series_labels_rendered, value)
+Sample = Tuple[float, str, str, float]
+
+
+class Registry:
+    """Thread-safe metric registry + bounded scrape journal.
+
+    ``enabled``/``max_samples`` default from the validated Config env
+    contract (``ANOMOD_OBS_ENABLED`` / ``ANOMOD_OBS_MAX_SAMPLES``).
+    """
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 max_samples: Optional[int] = None):
+        if enabled is None or max_samples is None:
+            from anomod.config import get_config
+            cfg = get_config()
+            enabled = cfg.obs_enabled if enabled is None else enabled
+            max_samples = (cfg.obs_max_samples if max_samples is None
+                           else max_samples)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, str], object] = {}
+        self._journal: "collections.deque[Sample]" = collections.deque(
+            maxlen=int(max_samples))
+
+    # -- handle construction (memoized by name + rendered labels) ---------
+
+    def _get(self, cls, name: str, labels: Dict[str, str]):
+        if not self.enabled:
+            return NULL
+        key = (name, render_labels(labels))
+        got = self._metrics.get(key)
+        if got is None:
+            with self._lock:
+                got = self._metrics.get(key)
+                if got is None:
+                    got = cls(name, labels)
+                    self._metrics[key] = got
+        if not isinstance(got, cls):
+            raise ValueError(
+                f"metric {name!r} already registered as {got.kind}, "
+                f"not {cls.kind}")
+        return got
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def metrics(self) -> List[object]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # -- time series -------------------------------------------------------
+
+    def scrape(self, now_s: Optional[float] = None) -> int:
+        """Append every metric's current samples to the journal.
+
+        ``now_s`` is the caller's clock — wall time by default, the
+        VIRTUAL clock for the serving plane, so a seeded serve run's
+        self-scrape timeline is deterministic and windows bin cleanly.
+        Returns the number of samples appended (0 when disabled)."""
+        if not self.enabled:
+            return 0
+        if now_s is None:
+            import time
+            now_s = time.time()
+        n = 0
+        for m in self.metrics():
+            series = render_labels(m.labels)
+            for sname, val in m.samples():
+                self._journal.append((float(now_s), sname, series,
+                                      float(val)))
+                n += 1
+        return n
+
+    @property
+    def n_samples(self) -> int:
+        return len(self._journal)
+
+    def journal(self) -> List[Sample]:
+        return list(self._journal)
+
+    def snapshot(self) -> dict:
+        """Point-in-time JSON-able view of every metric (no journal)."""
+        out: Dict[str, dict] = {}
+        for m in self.metrics():
+            key = m.name if not m.labels else \
+                f"{m.name}{{{render_labels(m.labels)}}}"
+            if m.kind == "histogram":
+                out[key] = {"kind": m.kind, "count": m.count,
+                            "sum": round(m.sum, 6)}
+                p50 = m.quantile(0.5)
+                if p50 is not None:
+                    out[key].update(p50=round(p50, 6),
+                                    p99=round(m.quantile(0.99), 6))
+            else:
+                out[key] = {"kind": m.kind, "value": m.value}
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._journal.clear()
+
+
+_DEFAULT: Optional[Registry] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_registry() -> Registry:
+    """The process-wide registry (constructed lazily from the env
+    contract so import order never races config)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = Registry()
+    return _DEFAULT
+
+
+def set_registry(registry: Registry) -> Registry:
+    """Swap the process-wide registry (tests, the bench's off/on pair);
+    returns the previous one so callers can restore it."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        prev, _DEFAULT = _DEFAULT, registry
+    return prev if prev is not None else registry

@@ -78,12 +78,16 @@ class ShardedStreamReplay:
         return self._fn(dev)
 
     def _warm(self) -> None:
+        from anomod import obs
         t0 = time.perf_counter()
         dead = self._dead_chunk()
         group = {k: np.repeat(v, self.n_dev, axis=0)
                  for k, v in dead.items()}
         np.asarray(self._run_group(group).agg)     # compile barrier
         self.compile_s = time.perf_counter() - t0
+        obs.counter("anomod_stream_compile_total", plane="sharded").inc()
+        obs.counter("anomod_stream_compile_seconds_total",
+                    plane="sharded").inc(self.compile_s)
         self._warmed = True
 
     def push(self, batch: SpanBatch) -> int:
@@ -94,6 +98,8 @@ class ShardedStreamReplay:
             return -1
         if not self._warmed:
             self._warm()
+        from anomod import obs
+        t_push = time.perf_counter()
         w_need = int((int(batch.start_us.max()) - self.t0_us)
                      // self.cfg.window_us)
         if w_need > self.cfg.n_windows - 1:
@@ -114,6 +120,9 @@ class ShardedStreamReplay:
                 agg=self.state.agg + delta.agg,
                 hist=self.state.hist + jnp.asarray(delta.hist))
         self.n_spans += n
+        obs.histogram("anomod_stream_push_seconds",
+                      plane="sharded").observe(
+            time.perf_counter() - t_push)
         return self.window_offset + max(w_need, 0)
 
     def agg_plane(self) -> np.ndarray:

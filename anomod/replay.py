@@ -608,14 +608,22 @@ def measure_throughput(batch: SpanBatch, cfg: Optional[ReplayConfig] = None,
             agg = np.asarray(xfn(chunks).agg)
             return float(agg[:, F_COUNT].astype(np.float64).sum())
 
+    from anomod import obs
     t0 = time.perf_counter()
     run_once()                                  # compile / cache warm-up
     compile_s = 0.0 if kernel == "numpy" else time.perf_counter() - t0
+    if compile_s:
+        obs.counter("anomod_replay_compile_total", kernel=kernel).inc()
+        obs.counter("anomod_replay_compile_seconds_total",
+                    kernel=kernel).inc(compile_s)
+    dispatch_s = obs.histogram("anomod_replay_dispatch_seconds",
+                               kernel=kernel)
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         total = run_once()
         times.append(time.perf_counter() - t0)
+        dispatch_s.observe(times[-1])
     # Sanity check with f32 headroom: per-segment counts accumulate on device
     # in f32 and lose exactness past 2^24 spans per (service, window) segment,
     # so allow a small relative slack instead of demanding exact equality.

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from anomod import obs
 from anomod.config import DEFAULT_SERVE_BUCKETS as DEFAULT_BUCKETS
 from anomod.config import validate_serve_buckets as validate_buckets
 from anomod.replay import (N_FEATS, ReplayConfig, ReplayState,
@@ -86,6 +87,14 @@ class BucketRunner:
         self.compile_s_by_width: Dict[int, float] = {}
         self.dispatches_by_width: Dict[int, int] = {}
         self.n_dispatches = 0
+        # registry mirrors (anomod.obs): staged-vs-live row counters make
+        # the bucket-pad waste fraction derivable from any scrape
+        # (waste = 1 - live/staged); handles cached — push_into is the
+        # serving hot path
+        self._obs_dispatches = obs.counter("anomod_serve_dispatches_total")
+        self._obs_staged = obs.counter("anomod_serve_staged_rows_total")
+        self._obs_live = obs.counter("anomod_serve_live_rows_total")
+        self._obs_waste = obs.gauge("anomod_serve_pad_waste_fraction")
 
     @property
     def widths(self) -> Tuple[int, ...]:
@@ -116,6 +125,9 @@ class BucketRunner:
             np.asarray(state.agg)               # compile + execute barrier
             self.compile_s_by_width[width] = time.perf_counter() - t0
             total += self.compile_s_by_width[width]
+            obs.counter("anomod_serve_compile_total").inc()
+            obs.counter("anomod_serve_compile_seconds_total").inc(
+                self.compile_s_by_width[width])
         return total
 
     @property
@@ -136,12 +148,19 @@ class BucketRunner:
                 if (lo, hi) != (0, batch.n_spans) else batch
             staged_cfg = dataclasses.replace(cfg, chunk_size=width)
             chunks, _ = stage_columns(sub, staged_cfg, t0_us=t0_us)
-            for i in range(chunks["sid"].shape[0]):
+            n_chunks = chunks["sid"].shape[0]
+            for i in range(n_chunks):
                 state = self._step(state,
                                    {k: v[i] for k, v in chunks.items()})
                 self.n_dispatches += 1
                 self.dispatches_by_width[width] = \
                     self.dispatches_by_width.get(width, 0) + 1
+            self._obs_dispatches.inc(n_chunks)
+            self._obs_staged.inc(n_chunks * width)
+            self._obs_live.inc(hi - lo)
+        staged = self._obs_staged.value
+        if staged:
+            self._obs_waste.set(1.0 - self._obs_live.value / staged)
         return state
 
 

@@ -27,6 +27,7 @@ import dataclasses
 import heapq
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from anomod import obs
 from anomod.schemas import SpanBatch
 
 #: default scheduler weight per priority class (0 = most important).
@@ -109,6 +110,21 @@ class AdmissionController:
         self._drain_heap: List[Tuple[float, int]] = []
         self._evict_heap: List[Tuple[int, float, int]] = []
         self._evict_stale = 0
+        # registry mirrors (anomod.obs): cached handles — offer/drain run
+        # per micro-batch on the serving hot path
+        self._obs_offered = obs.counter("anomod_serve_offered_spans_total")
+        self._obs_admitted = obs.counter("anomod_serve_admitted_spans_total")
+        self._obs_served = obs.counter("anomod_serve_served_spans_total")
+        self._obs_shed = obs.counter("anomod_serve_shed_spans_total")
+        self._obs_evicted = obs.counter("anomod_serve_evicted_batches_total")
+        self._obs_backlog = obs.gauge("anomod_serve_backlog_spans")
+        self._obs_tenant_backlog = obs.gauge(
+            "anomod_serve_max_tenant_backlog_spans")
+
+    def _obs_depths(self) -> None:
+        self._obs_backlog.set(self.backlog_spans)
+        self._obs_tenant_backlog.set(
+            max(self._tenant_backlog.values(), default=0))
 
     # -- admission --------------------------------------------------------
 
@@ -126,6 +142,7 @@ class AdmissionController:
         c = self.counters[tenant_id]
         c.offered_spans += n
         c.offered_batches += 1
+        self._obs_offered.inc(n)
         if n == 0:
             return False
         # both bounds refuse a batch only when queued work already exists
@@ -137,6 +154,7 @@ class AdmissionController:
                 > self.max_tenant_backlog:
             c.shed_spans += n
             c.shed_batches += 1
+            self._obs_shed.inc(n)
             return False
         if self.backlog_spans and self.backlog_spans + n > self.max_backlog:
             # transactional eviction: only destroy lower-priority work if
@@ -152,17 +170,21 @@ class AdmissionController:
             if evictable < needed:
                 c.shed_spans += n
                 c.shed_batches += 1
+                self._obs_shed.inc(n)
                 return False
         while self.backlog_spans and self.backlog_spans + n > self.max_backlog:
             victim = self._pop_eviction_candidate(spec.priority)
             if victim is None:           # unreachable given the check above
                 c.shed_spans += n
                 c.shed_batches += 1
+                self._obs_shed.inc(n)
                 return False
             vc = self.counters[victim.tenant_id]
             vc.shed_spans += victim.n_spans
             vc.shed_batches += 1
             vc.admitted_spans -= victim.n_spans
+            self._obs_shed.inc(victim.n_spans)
+            self._obs_evicted.inc()
             self._remove(victim)
         start = max(self._vtime, self._last_finish[tenant_id])
         finish = start + n / spec.effective_weight()
@@ -182,6 +204,8 @@ class AdmissionController:
         self.peak_backlog_spans = max(self.peak_backlog_spans,
                                       self.backlog_spans)
         c.admitted_spans += n
+        self._obs_admitted.inc(n)
+        self._obs_depths()
         return True
 
     def _pop_eviction_candidate(self, incoming_priority: int):
@@ -242,7 +266,10 @@ class AdmissionController:
             c = self.counters[qb.tenant_id]
             c.served_spans += qb.n_spans
             c.served_batches += 1
+            self._obs_served.inc(qb.n_spans)
             out.append(qb)
+        if out:
+            self._obs_depths()
         return out
 
     # -- report helpers ---------------------------------------------------

@@ -213,10 +213,15 @@ class StreamReplay:
         lane, valid = 0 → numerically a no-op on the state) so push()
         walls measure the steady pipeline, not one-time compilation."""
         from anomod.replay import dead_chunk
+
+        from anomod import obs
         t0 = time.perf_counter()
         self.state = self._step(self.state, dead_chunk(self.cfg))
         np.asarray(self.state.agg)                # compile + execute barrier
         self.compile_s = time.perf_counter() - t0
+        obs.counter("anomod_stream_compile_total").inc()
+        obs.counter("anomod_stream_compile_seconds_total").inc(
+            self.compile_s)
         self._warmed = True
 
     def _roll(self, k: int) -> None:
@@ -239,6 +244,8 @@ class StreamReplay:
             return -1
         if not self._warmed:
             self._warm()
+        from anomod import obs
+        t_push = time.perf_counter()
         w_need = int((int(batch.start_us.max()) - self.t0_us)
                      // self.cfg.window_us)
         if w_need > self.cfg.n_windows - 1:
@@ -257,6 +264,8 @@ class StreamReplay:
             # the bounded queue holding staged device buffers
             pipe.close()
         self.n_spans += n
+        obs.histogram("anomod_stream_push_seconds").observe(
+            time.perf_counter() - t_push)
         return self.window_offset + max(w_need, 0)
 
     def agg_plane(self) -> np.ndarray:

@@ -5,15 +5,46 @@ the analog for a TPU framework is (a) wall-clock span timing of pipeline
 stages emitted in a Jaeger-compatible JSON shape — so this framework's own
 trace can be loaded back through anomod.io.sn_traces — and (b) XLA device
 profiling via jax.profiler for kernel-level inspection.
+
+Thread-safety contract: spans may open from any thread (the prefetch
+Pipeline's staging worker, ingest pool callbacks) — each thread keeps its
+OWN span stack (thread-local), so parent links never cross threads and a
+worker's span can never corrupt the main thread's nesting; the span list
+itself is lock-protected.  A span opened on a fresh thread is a root of
+the same trace (no cross-thread parent inference — wrong more often than
+right, and the Jaeger shape has no way to say "maybe").
+
+Durability contract: :meth:`Tracer.dump` publishes atomically
+(same-directory tmp + ``os.replace``, the anomod.io.cache idiom), so a
+run killed mid-write never leaves a truncated JSON behind a valid path.
 """
 
 from __future__ import annotations
 
 import contextlib
 import json
+import os
+import threading
 import time
 from pathlib import Path
 from typing import List, Optional
+
+
+class Span:
+    """Handle yielded by :meth:`Tracer.span` — tag/event mutation only."""
+
+    __slots__ = ("_rec",)
+
+    def __init__(self, rec: dict):
+        self._rec = rec
+
+    def set_tag(self, key: str, value) -> None:
+        self._rec["tags"][str(key)] = value
+
+    def event(self, message: str, **fields) -> None:
+        """Append a timestamped span log (Jaeger ``logs`` entry)."""
+        self._rec["events"].append(
+            {"t": time.time(), "message": str(message), **fields})
 
 
 class Tracer:
@@ -22,45 +53,98 @@ class Tracer:
     def __init__(self, service: str = "anomod"):
         self.service = service
         self._spans: List[dict] = []
-        self._stack: List[int] = []
+        self._lock = threading.Lock()
+        self._tls = threading.local()
         self._trace_id = f"anomod-{int(time.time() * 1e6):x}"
 
+    def _stack(self) -> List[int]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    @property
+    def n_spans(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
     @contextlib.contextmanager
-    def span(self, name: str):
-        idx = len(self._spans)
-        parent = self._stack[-1] if self._stack else None
+    def span(self, name: str, **tags):
+        stack = self._stack()
+        parent = stack[-1] if stack else None
         start = time.time()
-        self._spans.append({"name": name, "start": start, "dur": 0.0,
-                            "parent": parent})
-        self._stack.append(idx)
+        rec = {"name": name, "start": start, "dur": 0.0, "parent": parent,
+               "tags": {str(k): v for k, v in tags.items()}, "events": []}
+        with self._lock:
+            idx = len(self._spans)
+            self._spans.append(rec)
+        stack.append(idx)
         try:
-            yield
+            yield Span(rec)
         finally:
-            self._stack.pop()
-            self._spans[idx]["dur"] = time.time() - start
+            stack.pop()
+            rec["dur"] = time.time() - start
+
+    def event(self, message: str, **fields) -> None:
+        """Attach an event to the CURRENT thread's innermost open span
+        (no-op outside any span — callers never need to guard)."""
+        stack = self._stack()
+        if not stack:
+            return
+        with self._lock:
+            rec = self._spans[stack[-1]]
+        Span(rec).event(message, **fields)
 
     def to_jaeger(self) -> dict:
         """Jaeger API JSON (loadable by anomod.io.sn_traces)."""
+        with self._lock:
+            # copy the mutable containers too: a worker thread may still
+            # be set_tag()/event()-ing an open span while we serialize
+            # (each event dict is write-once at append, so list() is
+            # deep enough)
+            recs = [{**s, "tags": dict(s["tags"]),
+                     "events": list(s["events"])} for s in self._spans]
         spans = []
-        for i, s in enumerate(self._spans):
+        for i, s in enumerate(recs):
             refs = ([{"refType": "CHILD_OF", "traceID": self._trace_id,
                       "spanID": f"s{s['parent']:08x}"}]
                     if s["parent"] is not None else [])
+            tags = [{"key": "span.kind", "value": "internal"}]
+            tags.extend({"key": k, "value": str(v)}
+                        for k, v in sorted(s["tags"].items()))
+            logs = [{"timestamp": int(e["t"] * 1e6),
+                     "fields": [{"key": k, "value": str(v)}
+                                for k, v in e.items() if k != "t"]}
+                    for e in s["events"]]
             spans.append({
                 "traceID": self._trace_id, "spanID": f"s{i:08x}",
                 "processID": "p0", "operationName": s["name"],
                 "startTime": int(s["start"] * 1e6),
                 "duration": int(s["dur"] * 1e6),
                 "references": refs,
-                "tags": [{"key": "span.kind", "value": "internal"}],
-                "logs": [],
+                "tags": tags,
+                "logs": logs,
             })
         return {"data": [{"traceID": self._trace_id,
                           "processes": {"p0": {"serviceName": self.service}},
                           "spans": spans}]}
 
     def dump(self, path: Path) -> None:
-        Path(path).write_text(json.dumps(self.to_jaeger()))
+        """Atomic publish (tmp + ``os.replace``): a killed run never
+        leaves a truncated trace behind a valid path."""
+        path = Path(path)
+        if path.parent and not path.parent.exists():
+            path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
+        try:
+            tmp.write_text(json.dumps(self.to_jaeger()))
+            os.replace(tmp, path)
+        finally:
+            if tmp.exists():
+                try:
+                    tmp.unlink()
+                except OSError:
+                    pass
 
 
 @contextlib.contextmanager

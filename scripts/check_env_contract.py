@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""CI gate: every ``ANOMOD_*`` env var the code reads must be covered.
+
+"Covered" means at least one of:
+
+- it appears in the validated ``Config`` env contract
+  (``anomod/config.py`` — the typed, fail-loud home for knobs that shape
+  framework behavior), or
+- it is documented (``README.md`` or any ``docs/*.md`` — the contract
+  for operational/driver knobs that deliberately stay out of Config,
+  e.g. the bench platform overrides).
+
+An env read that is neither is exactly how a knob rots: it works on the
+author's machine, nobody else can discover it, and a typo'd value fails
+silently.  This gate greps the whole package (plus ``bench.py`` and
+``scripts/``) for ``ANOMOD_[A-Z0-9_]+`` tokens and fails listing every
+uncovered name — including any new ``ANOMOD_OBS_*`` knob someone adds
+without teaching the Config/doc contract about it.
+
+Exit codes: 0 = every referenced var is covered, 1 = violations (listed
+in the JSON line and on stderr).  ``scripts/pre_bench_check.py`` runs
+this before every bench gate.
+"""
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+_VAR = re.compile(r"ANOMOD_[A-Z0-9_]+")
+
+
+def referenced_vars(root: Path) -> dict:
+    """Every ANOMOD_* token in the scanned sources -> the files naming it.
+
+    Tokens ending in ``_`` are glob-style prefixes in prose (e.g.
+    ``ANOMOD_SERVE_BENCH_*`` rendered without the star) — not reads."""
+    out: dict = {}
+    files = [root / "bench.py"]
+    files += sorted((root / "anomod").rglob("*.py"))
+    files += sorted((root / "scripts").glob("*.py"))
+    for p in files:
+        if not p.is_file():
+            continue
+        for m in _VAR.finditer(p.read_text(errors="replace")):
+            name = m.group(0)
+            if name.endswith("_"):
+                continue
+            out.setdefault(name, set()).add(
+                str(p.relative_to(root)))
+    return out
+
+
+def covered_vars(root: Path) -> str:
+    """The coverage corpus: the Config module + every markdown doc."""
+    parts = []
+    for p in [root / "anomod" / "config.py", root / "README.md",
+              *sorted((root / "docs").glob("*.md"))]:
+        if p.is_file():
+            parts.append(p.read_text(errors="replace"))
+    return "\n".join(parts)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=str(ROOT),
+                    help="repo root to scan (tests use a fixture tree)")
+    args = ap.parse_args(argv)
+    root = Path(args.root)
+    refs = referenced_vars(root)
+    corpus = covered_vars(root)
+    missing = {name: sorted(files) for name, files in sorted(refs.items())
+               if name not in corpus}
+    out = {"check": "env_contract", "n_vars": len(refs),
+           "n_missing": len(missing),
+           "status": "ok" if not missing else "uncovered-env-vars"}
+    if missing:
+        out["missing"] = missing
+    print(json.dumps(out))
+    if missing:
+        for name, files in missing.items():
+            print(f"check_env_contract: {name} (read in "
+                  f"{', '.join(files)}) is neither in the Config env "
+                  "contract (anomod/config.py) nor documented "
+                  "(README.md / docs/*.md)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,11 +18,19 @@ the shared chunk step on the pinned-CPU backend — a bucket set that can't
 compile would burn the capture window mid-serve).  Exit 3 = serve
 preconditions failed.
 
+Both modes FIRST run the env-contract gate
+(``scripts/check_env_contract.py``): every ``ANOMOD_*`` env var read in
+the package must be in the validated Config contract or documented —
+a capture driven by an undocumented knob is not reproducible from the
+record.  Exit 4 = env contract violation.
+
 Exit codes: 0 = ready (warm cache, or --cold / caching disabled is
 explicit, or serve preconditions hold), 1 = cold cache without --cold,
-2 = caching disabled without --cold, 3 = serve precondition failure.
-Always prints one JSON line describing the decision.  ``--traces`` must
-match the bench invocation's span count (the cache key includes it).
+2 = caching disabled without --cold, 3 = serve precondition failure,
+4 = env contract violation.
+Always prints one JSON line describing the decision (plus the contract
+gate's line).  ``--traces`` must match the bench invocation's span
+count (the cache key includes it).
 """
 
 import argparse
@@ -78,6 +86,23 @@ def main(argv=None) -> int:
                     help="allow the capture anyway; the bench line still "
                          "records cache_hit=false for honesty")
     args = ap.parse_args(argv)
+
+    # env-contract gate first (quiet on success: the drivers parse this
+    # script's stdout as ONE JSON line)
+    import check_env_contract as cec
+    root = Path(cec.ROOT)
+    corpus = cec.covered_vars(root)
+    missing = {name: sorted(files)
+               for name, files in sorted(cec.referenced_vars(root).items())
+               if name not in corpus}
+    if missing:
+        print(json.dumps({"check": "pre_bench_env_contract",
+                          "status": "uncovered-env-vars",
+                          "missing": missing}))
+        print("pre_bench_check: env contract violated — run "
+              "scripts/check_env_contract.py and fix the listed ANOMOD_* "
+              "vars (Config or docs) before capturing", file=sys.stderr)
+        return 4
 
     if args.mode == "serve":
         return check_serve()

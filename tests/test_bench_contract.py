@@ -119,8 +119,25 @@ def test_bench_serve_mode_contract(tmp_path):
     assert out["served_spans"] > 0
     assert out["offered_spans"] > out["served_spans"]
     assert out["device"]
+    # telemetry pair (observability PR): same seed off/on, overhead
+    # fraction recorded, the enabled leg's registry snapshotted inline
+    tel = out["telemetry"]
+    assert tel["spans_per_sec_off"] > 0 and tel["spans_per_sec_on"] > 0
+    assert 0.0 <= tel["overhead_fraction"] < 1.0
+    assert tel["journal_samples"] > 0
+    assert out["obs_snapshot"]["anomod_serve_served_spans_total"][
+        "value"] == out["served_spans"]
     runs = list((tmp_path / "runs").glob("*.json"))
     assert len(runs) == 1
     rec = json.loads(runs[0].read_text())
     assert rec["metric"] == "serve_sustained_throughput"
     assert rec["shed_fraction"] == out["shed_fraction"]
+    # the committed self-scrape capture: TT-CSV sidecar next to the
+    # record, loadable by the framework's own loader
+    scrape = out["self_scrape"]
+    csvs = list((tmp_path / "runs").glob("*_selfscrape.csv"))
+    assert len(csvs) == 1
+    assert scrape["samples"] > 0
+    from anomod.io.metrics import load_tt_metric_csv
+    batch = load_tt_metric_csv(csvs[0])
+    assert batch is not None and batch.n_samples == scrape["samples"]
