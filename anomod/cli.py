@@ -345,6 +345,13 @@ def main(argv=None) -> int:
                          help="detector window width on the virtual clock")
     p_serve.add_argument("--baseline-windows", type=int, default=4)
     p_serve.add_argument("--threshold", type=float, default=4.0)
+    p_serve.add_argument("--no-fuse", action="store_true",
+                         help="disable tenant-fused (lane-stacked) "
+                              "dispatch: one dispatch per tenant "
+                              "micro-batch, as before ANOMOD_SERVE_FUSE")
+    p_serve.add_argument("--lane-buckets", default=None,
+                         help="comma-separated fused-dispatch lane "
+                              "counts (default ANOMOD_SERVE_LANE_BUCKETS)")
     p_serve.add_argument("--buckets", default=None,
                          help="comma-separated micro-batch bucket widths "
                               "(default: ANOMOD_SERVE_BUCKETS)")
@@ -720,6 +727,15 @@ def main(argv=None) -> int:
                      if p.strip()])
             except ValueError as e:
                 parser.error(f"--buckets: {e}")
+        lane_buckets = None
+        if args.lane_buckets is not None:
+            from anomod.config import validate_lane_buckets
+            try:
+                lane_buckets = validate_lane_buckets(
+                    [p.strip() for p in args.lane_buckets.split(",")
+                     if p.strip()])
+            except ValueError as e:
+                parser.error(f"--lane-buckets: {e}")
         mesh = None
         if args.devices:
             from anomod.parallel import make_mesh
@@ -737,7 +753,9 @@ def main(argv=None) -> int:
             z_threshold=args.threshold, buckets=buckets,
             max_backlog=args.max_backlog,
             fault_tenants=args.fault_tenants, score=not args.no_score,
-            mesh=mesh, tracer=tracer)
+            mesh=mesh, tracer=tracer,
+            fuse=False if args.no_fuse else None,
+            lane_buckets=lane_buckets)
         if tracer is not None:
             from pathlib import Path as _P
             tracer.dump(_P(args.trace_out))

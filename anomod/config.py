@@ -66,8 +66,13 @@ def _ingest_workers_env() -> int:
 #: default serving-plane micro-batch bucket widths (spans) — one XLA
 #: compile per width (anomod.serve.batcher re-exports this and the
 #: validator below as its contract; they live HERE so Config()
-#: construction never pays the serve/stream import chain).
-DEFAULT_SERVE_BUCKETS = (256, 1024, 4096, 16384)
+#: construction never pays the serve/stream import chain).  The 64
+#: bucket joined with the tenant-fused dispatch path: a power-law
+#: fleet's tail tenants flush a handful of spans per tick, and staging
+#: them 256-wide was ~80% of all staged rows as padding — narrow
+#: buckets only became affordable once lane stacking amortized the
+#: per-dispatch cost across tenants.
+DEFAULT_SERVE_BUCKETS = (64, 256, 1024, 4096, 16384)
 
 
 def validate_serve_buckets(buckets) -> tuple:
@@ -101,6 +106,64 @@ def _serve_buckets_env() -> tuple:
         return validate_serve_buckets(parts)
     except ValueError as e:
         raise ValueError(f"ANOMOD_SERVE_BUCKETS: {e}") from e
+
+
+#: default serving-plane lane-bucket set for the FUSED dispatch path
+#: (anomod.serve.batcher): per tick, same-width staged chunks from many
+#: tenants stack into [lanes, width] dispatches, lanes padded up to the
+#: smallest bucket here (one XLA compile per (width, lane-bucket) shape).
+DEFAULT_SERVE_LANE_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def validate_lane_buckets(lanes) -> tuple:
+    """The lane-bucket contract: positive, strictly ascending ints —
+    the same shape discipline as the width buckets (every (width,
+    lane-bucket) pair is one compiled executable, so the set must be
+    small and fixed)."""
+    try:
+        out = tuple(int(b) for b in lanes)
+    except (TypeError, ValueError):
+        raise ValueError(f"lane-bucket set must be integers, got {lanes!r}")
+    if not out:
+        raise ValueError("lane-bucket set must not be empty")
+    if any(b < 1 for b in out):
+        raise ValueError(f"lane buckets must be >= 1, got {out}")
+    if any(b >= c for b, c in zip(out, out[1:])):
+        raise ValueError(f"lane buckets must be strictly ascending: {out}")
+    return out
+
+
+def _serve_lane_buckets_env() -> tuple:
+    """ANOMOD_SERVE_LANE_BUCKETS: comma-separated lane counts for the
+    serving plane's fused (lane-stacked) dispatch.
+
+    Validated at config construction, same contract as
+    ``ANOMOD_SERVE_BUCKETS`` — a typo'd set fails loudly instead of
+    compiling garbage lane shapes mid-serve.
+    """
+    raw = _env("ANOMOD_SERVE_LANE_BUCKETS", "")
+    if not raw:
+        return DEFAULT_SERVE_LANE_BUCKETS
+    parts = [p.strip() for p in raw.split(",") if p.strip()]
+    try:
+        return validate_lane_buckets(parts)
+    except ValueError as e:
+        raise ValueError(f"ANOMOD_SERVE_LANE_BUCKETS: {e}") from e
+
+
+def _serve_fuse_env() -> bool:
+    """ANOMOD_SERVE_FUSE: serving-plane fused-dispatch switch.
+
+    Default ON; "0"/"false"/"off" is the escape hatch back to one
+    dispatch per tenant micro-batch.  The fused path is pinned
+    bit-identical on CPU to SEQUENTIAL scoring of the same per-tick
+    COALESCED batches — coalescing itself regroups a tenant's same-tick
+    micro-batches into one staging, so flipping this switch can move
+    borderline f32 bits (and admission/SLO numbers are byte-identical
+    either way); see docs/SERVING.md "Fused dispatch" for the exact
+    contract."""
+    return _env("ANOMOD_SERVE_FUSE", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
 
 
 def _serve_max_backlog_env() -> int:
@@ -179,6 +242,13 @@ class Config:
     # (anomod.serve.batcher; one XLA compile per width).
     serve_buckets: tuple = dataclasses.field(
         default_factory=_serve_buckets_env)
+    # ANOMOD_SERVE_LANE_BUCKETS — fused-dispatch lane counts
+    # (anomod.serve.batcher; one XLA compile per (width, lane-bucket)).
+    serve_lane_buckets: tuple = dataclasses.field(
+        default_factory=_serve_lane_buckets_env)
+    # ANOMOD_SERVE_FUSE — serving-plane fused-dispatch switch
+    # (anomod.serve.engine; off = one dispatch per tenant micro-batch).
+    serve_fuse: bool = dataclasses.field(default_factory=_serve_fuse_env)
     # ANOMOD_SERVE_MAX_BACKLOG — global admission backlog bound in spans
     # (anomod.serve.queues; the backpressure/shed budget).
     serve_max_backlog: int = dataclasses.field(

@@ -73,22 +73,34 @@ def segment_ids(batch: SpanBatch, cfg: ReplayConfig,
     return batch.service.astype(np.int32) * cfg.n_windows + window
 
 
+def stage_columns_raw(batch: SpanBatch, cfg: ReplayConfig,
+                      t0_us: Optional[int] = None) -> dict:
+    """UNPADDED per-span chunk columns — the :func:`stage_columns`
+    transforms without the pad.  The serving batcher stages through this
+    and pads at scratch-fill time into pinned reused buffers (pad value
+    per column = the :func:`dead_chunk` fill, same bits as the
+    ``np.pad`` below), so the hot tick loop stops allocating."""
+    dur_raw = batch.duration_us.astype(np.float32)
+    return dict(
+        sid=segment_ids(batch, cfg, t0_us),
+        dur=np.log1p(dur_raw),
+        dur_raw=dur_raw,
+        err=batch.is_error.astype(np.float32),
+        s5=(batch.status >= 500).astype(np.float32),
+        valid=np.ones(batch.n_spans, np.float32),
+        tid=batch.trace.astype(np.int32),   # for distinct-trace HLL
+    )
+
+
 def stage_columns(batch: SpanBatch, cfg: ReplayConfig, t0_us: Optional[int] = None):
     """Host-side packing: SpanBatch -> padded int32/float32 chunk arrays."""
     n = batch.n_spans
     pad = (-n) % cfg.chunk_size
+    raw = stage_columns_raw(batch, cfg, t0_us)
     def p(a, fill=0):
         return np.pad(a, (0, pad), constant_values=fill)
-    cols = dict(
-        sid=p(segment_ids(batch, cfg, t0_us),
-              fill=cfg.sw),  # padding rows target a dead segment
-        dur=p(np.log1p(batch.duration_us.astype(np.float32))),
-        dur_raw=p(batch.duration_us.astype(np.float32)),
-        err=p(batch.is_error.astype(np.float32)),
-        s5=p((batch.status >= 500).astype(np.float32)),
-        valid=p(np.ones(n, np.float32)),
-        tid=p(batch.trace.astype(np.int32)),  # for distinct-trace HLL
-    )
+    cols = {k: p(v, fill=cfg.sw if k == "sid" else 0)
+            for k, v in raw.items()}   # padding rows target a dead segment
     n_chunks = (n + pad) // cfg.chunk_size
     return {k: v.reshape(n_chunks, cfg.chunk_size) for k, v in cols.items()}, n
 
@@ -131,19 +143,94 @@ def hll_scatter_update(regs, sid, tid, cfg: ReplayConfig):
     return hll_add(regs_ext, tid, p=cfg.hll_p, lane=lane, xp=jnp)[:-1]
 
 
-def make_chunk_step(cfg: ReplayConfig, with_hll: bool = False):
+def _scatter_rhs(chunk, cfg: ReplayConfig):
+    """The [rows, 3+3+3+H] per-row feature payload of the SCATTER-engine
+    step: bf16-rounded exact/hi/lo planes + masked bucket one-hot,
+    widened back to f32.  Each row's value equals its matmul-path product
+    against a one-hot 1.0 EXACTLY (the bf16 rounding happens before
+    either reduction), which is what makes the scatter engine's f32
+    accumulation bit-compatible with the matmul engine's on XLA:CPU —
+    both reduce a segment's rows in row order, and the matmul's extra
+    terms from other rows are exact ``+0.0``s.  ONE definition, shared by
+    the single-lane scatter step and the fused lane-delta kernel."""
+    import jax
+    import jax.numpy as jnp
+    H = cfg.n_hist_buckets
+    exact = jnp.stack([chunk["valid"], chunk["err"], chunk["s5"]],
+                      axis=1).astype(jnp.bfloat16)
+    bucket = jnp.clip(chunk["dur"].astype(jnp.int32), 0, H - 1)
+    bucket_oh = (jax.nn.one_hot(bucket, H, dtype=jnp.bfloat16)
+                 * chunk["valid"][:, None].astype(jnp.bfloat16))
+    durs = jnp.stack([chunk["dur_raw"], chunk["dur"],
+                      chunk["dur"] * chunk["dur"]], axis=1)
+    hi = durs.astype(jnp.bfloat16)
+    lo = (durs - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return jnp.concatenate([exact, hi, lo, bucket_oh],
+                           axis=1).astype(jnp.float32)
+
+
+def _split_acc(acc, state: ReplayState):
+    """Fold a [SW, 3+3+3+H] per-segment accumulation into the state:
+    recombine the hi/lo latency moments and apply the SAME elementwise
+    f32 adds the matmul step performs."""
+    import jax.numpy as jnp
+    a_dur = acc[:, 3:6] + acc[:, 6:9]
+    agg = state.agg + jnp.concatenate([acc[:, :3], a_dur], axis=1)
+    hist = state.hist + acc[:, 9:]
+    return agg, hist
+
+
+def default_step_engine() -> str:
+    """The chunk-step engine for the current backend: "scatter" on
+    XLA:CPU (a segment-sum over the staged rows — ~10x the one-hot
+    matmul there, and pinned BIT-identical to it in tests/test_serve.py,
+    so every downstream parity guarantee carries over), "matmul" on
+    accelerators (the one-hot bf16 MXU formulation — scatter is the slow
+    path on TPU)."""
+    import jax
+    return "scatter" if jax.default_backend() == "cpu" else "matmul"
+
+
+def make_chunk_step(cfg: ReplayConfig, with_hll: bool = False,
+                    engine: str = "matmul"):
     """The per-chunk aggregation step shared by the single-chip scan and the
     pod-sharded replay (one definition so the split-precision scheme can't
     diverge between them).  Returns ``step(state, chunk) -> (state, None)``
-    for ``lax.scan``."""
+    for ``lax.scan``.
+
+    ``engine="matmul"`` (default) is the one-hot bf16 MXU formulation
+    below; ``engine="scatter"`` computes the same per-segment sums with a
+    ``jax.ops.segment_sum`` over the identical bf16-rounded row payload —
+    on XLA:CPU the two accumulate each segment's rows in the same order,
+    so their f32 states are BIT-identical (pinned in tests/test_serve.py;
+    the serving plane's BucketRunner picks per backend via
+    :func:`default_step_engine`).
+    """
     import jax
     import jax.numpy as jnp
 
     SW = cfg.sw
     H = cfg.n_hist_buckets
+    if engine not in ("matmul", "scatter"):
+        raise ValueError(f"unknown chunk-step engine {engine!r} "
+                         "(matmul|scatter)")
 
     def hll_update(regs, chunk):
         return hll_scatter_update(regs, chunk["sid"], chunk["tid"], cfg)
+
+    if engine == "scatter":
+        def scatter_step(state: ReplayState, chunk):
+            # padding rows carry sid = SW (the dead lane): segment-sum
+            # them into an extra segment and drop it, exactly as the
+            # matmul drops its pad column
+            acc = jax.ops.segment_sum(_scatter_rhs(chunk, cfg),
+                                      chunk["sid"],
+                                      num_segments=SW + 1)[:SW]
+            agg, hist = _split_acc(acc, state)
+            hll = hll_update(state.hll, chunk) if with_hll else None
+            return ReplayState(agg=agg, hist=hist, hll=hll), None
+
+        return scatter_step
 
     def chunk_step(state: ReplayState, chunk):
         sid = chunk["sid"]                    # [C] int32, SW = padding
@@ -184,6 +271,65 @@ def make_chunk_step(cfg: ReplayConfig, with_hll: bool = False):
         return ReplayState(agg=agg, hist=hist, hll=hll), None
 
     return chunk_step
+
+
+def make_lane_delta(cfg: ReplayConfig, engine: str = "scatter"):
+    """The FUSED (lane-stacked) dispatch surface of the chunk step.
+
+    Returns ``delta(chunks) -> (dagg, dhist)`` where every column in
+    ``chunks`` is ``[lanes, width]`` (one staged micro-batch chunk per
+    lane, dead-padded lanes carry all-pad rows) and the outputs are
+    ``[lanes, SW, F]`` / ``[lanes, SW, H]`` per-lane aggregation DELTAS.
+    The caller folds lane ``i`` into its tenant's state with the same
+    elementwise f32 add the in-step update performs
+    (``state.agg + dagg[i]``) — bit-identical to dispatching that lane's
+    chunk through ``make_chunk_step`` alone, because the step's state
+    update is exactly ``state + delta`` and a zero-state delta IS the
+    per-segment sum.  One jit of this compiles once per
+    ``(lane-bucket, width)`` shape.
+
+    ``engine="scatter"`` flattens the lanes into ONE segment-sum over
+    ``lanes * (SW+1)`` segments (each lane's rows stay contiguous and in
+    row order, so per-lane bits match the single-lane scatter step — the
+    "many small irregular work items, one wide regular kernel" shape);
+    ``engine="matmul"`` is ``jax.vmap`` of the one-hot step for
+    accelerator backends.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    SW, H = cfg.sw, cfg.n_hist_buckets
+    if engine not in ("matmul", "scatter"):
+        raise ValueError(f"unknown chunk-step engine {engine!r} "
+                         "(matmul|scatter)")
+
+    if engine == "matmul":
+        step = make_chunk_step(cfg, with_hll=False, engine="matmul")
+
+        def one_lane(chunk):
+            zero = ReplayState(agg=jnp.zeros((SW, N_FEATS), jnp.float32),
+                               hist=jnp.zeros((SW, H), jnp.float32))
+            st, _ = step(zero, chunk)
+            return st.agg, st.hist
+
+        return jax.vmap(one_lane)
+
+    def lane_delta(chunks):
+        L, C = chunks["sid"].shape
+        flat = {k: v.reshape(L * C) for k, v in chunks.items()}
+        # offset each lane's segment ids into its own [SW+1] block (the
+        # +1 block absorbs that lane's padding rows), fold ONE segment
+        # sum over the whole stack, then peel the pad segments off
+        lane = jnp.repeat(jnp.arange(L, dtype=jnp.int32), C)
+        sid = lane * (SW + 1) + flat["sid"]
+        acc = jax.ops.segment_sum(_scatter_rhs(flat, cfg), sid,
+                                  num_segments=L * (SW + 1))
+        acc = acc.reshape(L, SW + 1, acc.shape[-1])[:, :SW]
+        a_dur = acc[..., 3:6] + acc[..., 6:9]
+        return (jnp.concatenate([acc[..., :3], a_dur], axis=-1),
+                acc[..., 9:])
+
+    return lane_delta
 
 
 def make_replay_fn(cfg: ReplayConfig, with_hll: bool = False,

@@ -5,10 +5,12 @@ The reference pipeline is strictly post-hoc and the streaming layer
 between "millions of users" and the jitted chunk step: admission control
 with per-tenant weighted-fair queues (queues), a dynamic micro-batcher
 that coalesces tenant micro-batches into fixed padded bucket shapes so the
-shared chunk step compiles once per bucket (batcher), a deterministic
-virtual-clock serving engine with per-tenant SLO accounting (engine), and
-a seeded power-law traffic generator standing in for the tenant fleet
-(traffic).
+shared chunk step compiles once per bucket — and, fused
+(ANOMOD_SERVE_FUSE), lane-stacks same-width chunks across tenants into
+one dispatch per (width, lane-bucket) shape, pinned bit-identical to
+sequential scoring (batcher) — a deterministic virtual-clock serving
+engine with per-tenant SLO accounting (engine), and a seeded power-law
+traffic generator standing in for the tenant fleet (traffic).
 """
 
 from anomod.serve.batcher import (BucketedStreamReplay, BucketRunner,

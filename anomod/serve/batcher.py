@@ -1,4 +1,5 @@
-"""Dynamic micro-batching into fixed padded bucket shapes.
+"""Dynamic micro-batching into fixed padded bucket shapes, with a FUSED
+lane-stacked dispatch path for the multi-tenant tick loop.
 
 The serving plane's hot path is the SAME jitted chunk step the batch
 replay scans with (anomod.replay.make_chunk_step) — but tenant
@@ -8,26 +9,48 @@ each admitted micro-batch to the smallest shape from a FIXED bucket set
 (``ANOMOD_SERVE_BUCKETS``), so XLA compiles the step once per bucket
 width and every later dispatch of that width reuses the executable.
 
-Replay parity is exact by construction: a batch is split at
-``cfg.chunk_size`` boundaries (full chunks stage exactly as the
-sequential StreamReplay would) and only the TAIL remainder is padded to
-a bucket.  Padding rows target the dead lane (sid = cfg.sw, valid = 0),
-whose one-hot contribution to every live segment is exactly 0.0 — and
-the real rows occupy the same leading positions they would in the
-sequential staging — so the f32 state after a bucketed push is
-BIT-IDENTICAL to the sequential fixed-chunk push on CPU
-(tests/test_serve.py pins this, alert stream included).
+On top of the width buckets, the FUSED path (``ANOMOD_SERVE_FUSE``,
+default on) batches across TENANTS: per engine tick, same-width staged
+chunks from many tenants stack into ``[lanes, width]`` arrays and run as
+ONE dispatch of the lane-stacked chunk step
+(anomod.replay.make_lane_delta), with lane counts padded up to a small
+fixed bucket set (``ANOMOD_SERVE_LANE_BUCKETS``) so XLA compiles once
+per (width, lane-bucket) shape.  Dead pad lanes carry all-pad rows and
+their outputs are dropped — the corresponding tenants' states pass
+through untouched.  This is the power-law-fleet shape: many small
+irregular work items, one wide regular kernel (cf. the Sparse-Allreduce
+and VersaGNN batched-aggregation framings in PAPERS.md).
+
+Replay parity is exact by construction, at every level:
+
+- WIDTH buckets: a batch is split at ``cfg.chunk_size`` boundaries (full
+  chunks stage exactly as the sequential StreamReplay would) and only
+  the TAIL remainder is padded to a bucket.  Padding rows target the
+  dead lane (sid = cfg.sw, valid = 0), whose contribution to every live
+  segment is exactly 0.0 — and the real rows occupy the same leading
+  positions they would in the sequential staging — so the f32 state
+  after a bucketed push is BIT-IDENTICAL to the sequential fixed-chunk
+  push on CPU (tests/test_serve.py pins this, alert stream included).
+- STEP engine: on XLA:CPU the runner dispatches the scatter
+  (segment-sum) formulation of the chunk step, pinned bit-identical to
+  the one-hot matmul formulation there (anomod.replay.make_chunk_step's
+  engine contract) — ~10x faster on a host core, same bits.
+- LANE stacking: each lane of the fused dispatch reduces its own rows in
+  the same order the single-lane dispatch would, and the per-lane DELTA
+  is folded into the tenant's state with the same elementwise f32 add
+  the in-step update performs — so a fused tick's states (and therefore
+  the alert stream) are BIT-IDENTICAL to dispatching every tenant's
+  chunks one by one (tests/test_serve.py pins this too).
 
 :class:`BucketedStreamReplay` duck-types :class:`anomod.stream.StreamReplay`
 (it subclasses it and overrides only the dispatch), so
 ``OnlineDetector(..., replay=...)`` runs the full alerting stack over the
 shared bucket runner unchanged — thousands of tenants share ONE compiled
-step per bucket instead of compiling per tenant.
+step per (width, lane-bucket) shape instead of compiling per tenant.
 """
 
 from __future__ import annotations
 
-import dataclasses
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -35,10 +58,12 @@ import numpy as np
 
 from anomod import obs
 from anomod.config import DEFAULT_SERVE_BUCKETS as DEFAULT_BUCKETS
+from anomod.config import validate_lane_buckets
 from anomod.config import validate_serve_buckets as validate_buckets
-from anomod.replay import (N_FEATS, ReplayConfig, ReplayState,
-                           make_chunk_step, stage_columns)
-from anomod.schemas import SpanBatch, take_spans
+from anomod.replay import (N_FEATS, ReplayConfig, ReplayState, dead_chunk,
+                           default_step_engine, make_chunk_step,
+                           make_lane_delta, stage_columns_raw)
+from anomod.schemas import SpanBatch
 from anomod.stream import StreamReplay
 
 
@@ -66,35 +91,77 @@ def split_plan(n_spans: int, chunk_size: int,
 
 
 class BucketRunner:
-    """The shared compile-once-per-bucket chunk-step dispatcher.
+    """The shared compile-once-per-shape chunk-step dispatcher.
 
     One ``jax.jit`` of the shared chunk step serves every tenant; XLA
     compiles one executable per distinct chunk width (= per bucket, plus
     the full ``cfg.chunk_size``), tracked in ``compile_s_by_width`` /
-    ``dispatches_by_width`` for the ServeReport.
+    ``dispatches_by_width`` for the ServeReport.  The FUSED path adds
+    one jit of the lane-stacked delta kernel, compiled once per
+    (width, lane-bucket) shape (``lane_shapes`` / ``lane_compile_s``).
     """
 
     def __init__(self, cfg: ReplayConfig,
-                 buckets: Optional[Tuple[int, ...]] = None):
+                 buckets: Optional[Tuple[int, ...]] = None,
+                 lane_buckets: Optional[Tuple[int, ...]] = None,
+                 engine: Optional[str] = None):
         import jax
+        from anomod.config import get_config
         if buckets is None:
-            from anomod.config import get_config
             buckets = get_config().serve_buckets
+        if lane_buckets is None:
+            lane_buckets = get_config().serve_lane_buckets
         self.cfg = cfg
         self.buckets = validate_buckets(buckets)
-        step = make_chunk_step(cfg, with_hll=False)
+        self.lane_buckets = validate_lane_buckets(lane_buckets)
+        #: chunk-step engine: scatter on XLA:CPU (bit-identical, ~10x),
+        #: the one-hot bf16 matmul on accelerators (the MXU shape)
+        self.engine = engine if engine is not None else \
+            default_step_engine()
+        step = make_chunk_step(cfg, with_hll=False, engine=self.engine)
         self._step = jax.jit(lambda st, ch: step(st, ch)[0])
+        self._lane_fn = jax.jit(make_lane_delta(cfg, engine=self.engine))
         self.compile_s_by_width: Dict[int, float] = {}
+        #: one compile wall per fused (width, lane-bucket) shape — the
+        #: compile-count pin asserts this never grows past the warm grid
+        self._lane_compile_s: Dict[Tuple[int, int], float] = {}
         self.dispatches_by_width: Dict[int, int] = {}
         self.n_dispatches = 0
+        self.fused_dispatches = 0
+        #: fused dispatches per lane-bucket (the lanes histogram's
+        #: deterministic report twin)
+        self.lanes_by_bucket: Dict[int, int] = {}
+        self.staged_lanes = 0
+        self.live_lanes = 0
+        # pinned host scratch, reused across ticks: one [lanes, width]
+        # buffer set per fused shape, so steady-state staging stops
+        # reallocating (and re-faulting) megabytes per tick — staged
+        # columns arrive UNPADDED (stage_columns_raw) and pad here.
+        # Reuse is safe ONLY because run_lanes materializes its outputs
+        # before every refill; the single-lane dispatch pads into fresh
+        # buffers instead (see dispatch()).
+        self._lane_scratch: Dict[Tuple[int, int],
+                                 Dict[str, np.ndarray]] = {}
+        self._dead_cols: Dict[int, dict] = {}
         # registry mirrors (anomod.obs): staged-vs-live row counters make
         # the bucket-pad waste fraction derivable from any scrape
-        # (waste = 1 - live/staged); handles cached — push_into is the
-        # serving hot path
+        # (waste = 1 - live/staged); handles cached — staging and the
+        # fused dispatch are the serving hot path.  The lane twins
+        # (staged/live LANES + lanes-per-dispatch histogram) price the
+        # fused path's dead-lane padding the same way.
         self._obs_dispatches = obs.counter("anomod_serve_dispatches_total")
         self._obs_staged = obs.counter("anomod_serve_staged_rows_total")
         self._obs_live = obs.counter("anomod_serve_live_rows_total")
         self._obs_waste = obs.gauge("anomod_serve_pad_waste_fraction")
+        self._obs_fused = obs.counter(
+            "anomod_serve_fused_dispatches_total")
+        self._obs_lanes = obs.histogram("anomod_serve_fused_lanes")
+        self._obs_staged_lanes = obs.counter(
+            "anomod_serve_staged_lanes_total")
+        self._obs_live_lanes = obs.counter(
+            "anomod_serve_live_lanes_total")
+        self._obs_lane_waste = obs.gauge(
+            "anomod_serve_lane_pad_waste_fraction")
 
     @property
     def widths(self) -> Tuple[int, ...]:
@@ -103,18 +170,25 @@ class BucketRunner:
                            if b <= self.cfg.chunk_size)
         return tuple(sorted(set(per_bucket) | {self.cfg.chunk_size}))
 
+    @property
+    def lane_shapes(self) -> set:
+        """Every (width, lane-bucket) fused shape compiled so far."""
+        return set(self._lane_compile_s)
+
     def zero_state(self) -> ReplayState:
-        import jax.numpy as jnp
+        # host-side zeros: the fused scatter-back keeps tenant states as
+        # host arrays (jit transfers them per dispatch either way on the
+        # shapes involved, and host residency makes the per-lane
+        # delta-add allocation-cheap)
         cfg = self.cfg
         return ReplayState(
-            agg=jnp.zeros((cfg.sw, N_FEATS), jnp.float32),
-            hist=jnp.zeros((cfg.sw, cfg.n_hist_buckets), jnp.float32))
+            agg=np.zeros((cfg.sw, N_FEATS), np.float32),
+            hist=np.zeros((cfg.sw, cfg.n_hist_buckets), np.float32))
 
     def warm(self) -> float:
         """Compile every bucket width on an all-dead chunk (numerically a
         no-op on any state) so serving never pays a compile wall mid-
         stream.  Returns the total compile wall; idempotent."""
-        from anomod.replay import dead_chunk
         total = 0.0
         state = self.zero_state()
         for width in self.widths:
@@ -130,38 +204,199 @@ class BucketRunner:
                 self.compile_s_by_width[width])
         return total
 
+    def warm_lanes(self) -> float:
+        """Compile the full (width x lane-bucket) fused-dispatch grid on
+        all-dead lane stacks, so a fused serve never pays a compile wall
+        mid-stream.  Returns the total compile wall; idempotent.  The
+        serve pre-bench gate drives this and fails on any shape miss."""
+        total = 0.0
+        for width in self.widths:
+            dead = self._dead_cols_for(width)
+            for lanes in self.lane_buckets:
+                key = (width, lanes)
+                if key in self._lane_compile_s:
+                    continue
+                stacked = {k: np.broadcast_to(
+                    v, (lanes, width)) for k, v in dead.items()}
+                t0 = time.perf_counter()
+                dagg, _ = self._lane_fn(stacked)
+                np.asarray(dagg)                # compile + execute barrier
+                self._record_lane_compile(key, time.perf_counter() - t0)
+                total += self._lane_compile_s[key]
+        return total
+
+    def _record_lane_compile(self, key: Tuple[int, int],
+                             wall_s: float) -> None:
+        self._lane_compile_s[key] = wall_s
+        obs.counter("anomod_serve_fused_compile_total").inc()
+        obs.counter("anomod_serve_fused_compile_seconds_total").inc(wall_s)
+
     @property
     def compile_s(self) -> float:
         return float(sum(self.compile_s_by_width.values()))
 
-    def push_into(self, state: ReplayState, batch: SpanBatch,
-                  t0_us: int) -> ReplayState:
-        """Fold one micro-batch into ``state`` via the bucketed split.
+    @property
+    def lane_compile_s(self) -> float:
+        return float(sum(self._lane_compile_s.values()))
+
+    def _dead_cols_for(self, width: int) -> dict:
+        got = self._dead_cols.get(width)
+        if got is None:
+            got = dead_chunk(self.cfg, width, xp=np)
+            self._dead_cols[width] = got
+        return got
+
+    # -- staging (shared by the sequential and fused paths) ---------------
+
+    def stage_plan(self, batch: SpanBatch,
+                   t0_us: int) -> List[Tuple[int, dict]]:
+        """Host-side staging of one micro-batch into its bucket plan:
+        the ordered ``(width, columns)`` chunks a push dispatches, with
+        UNPADDED columns (each entry holds its slice's live rows; the
+        pad to ``width`` happens at scratch-fill time with the
+        dead-chunk fill values — same bits, no per-batch allocation).
 
         ``t0_us`` is the caller's (rolled) window anchor — binning is the
-        caller's contract, exactly as in StreamReplay.push.
+        caller's contract, exactly as in StreamReplay.push.  This is the
+        ONE staging definition: the sequential path dispatches the
+        returned chunks one by one, the fused path stacks the identical
+        chunks across tenants — so the two paths cannot stage apart.
+        Logical-dispatch and pad-waste accounting live here for the same
+        reason (``dispatches_by_width`` counts staged chunks, identical
+        under either execution strategy).
         """
         cfg = self.cfg
+        raw = stage_columns_raw(batch, cfg, t0_us)
+        out: List[Tuple[int, dict]] = []
+        staged_rows = 0
         for lo, hi, width in split_plan(batch.n_spans, cfg.chunk_size,
                                         self.buckets):
-            sub = take_spans(batch, slice(lo, hi)) \
-                if (lo, hi) != (0, batch.n_spans) else batch
-            staged_cfg = dataclasses.replace(cfg, chunk_size=width)
-            chunks, _ = stage_columns(sub, staged_cfg, t0_us=t0_us)
-            n_chunks = chunks["sid"].shape[0]
-            for i in range(n_chunks):
-                state = self._step(state,
-                                   {k: v[i] for k, v in chunks.items()})
-                self.n_dispatches += 1
-                self.dispatches_by_width[width] = \
-                    self.dispatches_by_width.get(width, 0) + 1
-            self._obs_dispatches.inc(n_chunks)
-            self._obs_staged.inc(n_chunks * width)
-            self._obs_live.inc(hi - lo)
-        staged = self._obs_staged.value
-        if staged:
-            self._obs_waste.set(1.0 - self._obs_live.value / staged)
-        return state
+            out.append((width, {k: v[lo:hi] for k, v in raw.items()}))
+            self.n_dispatches += 1
+            self.dispatches_by_width[width] = \
+                self.dispatches_by_width.get(width, 0) + 1
+            staged_rows += width
+        if out:
+            self._obs_dispatches.inc(len(out))
+            self._obs_staged.inc(staged_rows)
+            self._obs_live.inc(batch.n_spans)
+            staged = self._obs_staged.value
+            if staged:
+                self._obs_waste.set(1.0 - self._obs_live.value / staged)
+        return out
+
+    def _pad_fill(self, key: str):
+        """The per-column dead-row fill value (= the dead_chunk fill)."""
+        return self.cfg.sw if key == "sid" else 0
+
+    def dispatch(self, state: ReplayState, cols: dict,
+                 width: int) -> ReplayState:
+        """Fold ONE staged chunk into ``state`` (single-lane path),
+        padding the live rows to ``width`` exactly as ``stage_columns``
+        would.
+
+        The pad buffers are FRESH per call, never reused: jax's CPU
+        backend may zero-copy an aligned host array into the dispatch
+        under an immutability promise, and this path hands the state
+        back WITHOUT materializing it — mutating a shared scratch here
+        while the async step still reads it corrupts the fold (the fused
+        ``run_lanes`` path is the one that may reuse pinned scratch,
+        because it materializes its outputs — completing the dispatch's
+        reads — before every refill).
+        """
+        n = cols["sid"].shape[0]
+        if n == width:
+            return self._step(state, cols)
+        padded = {}
+        for k, c in cols.items():
+            buf = np.empty(width, c.dtype)
+            buf[:n] = c
+            buf[n:] = self._pad_fill(k)
+            padded[k] = buf
+        return self._step(state, padded)
+
+    # -- the fused (lane-stacked) path ------------------------------------
+
+    def lane_plan(self, n: int) -> List[Tuple[int, int]]:
+        """``(n_live, lane_bucket)`` dispatch groups covering ``n``
+        lanes: the largest bucket repeatedly, then the smallest bucket
+        covering the remainder (dead-padded)."""
+        out: List[Tuple[int, int]] = []
+        big = self.lane_buckets[-1]
+        while n > big:
+            out.append((big, big))
+            n -= big
+        if n > 0:
+            out.append((n, next(b for b in self.lane_buckets if b >= n)))
+        return out
+
+    def run_lanes(self, width: int,
+                  work: List[Tuple[ReplayState, dict]]) -> List[ReplayState]:
+        """Fold ``work[i]``'s staged chunk into ``work[i]``'s state via
+        lane-bucketed fused dispatches; returns the updated states in
+        order.
+
+        Per-lane results are BIT-identical to :meth:`dispatch` per lane:
+        each lane reduces its own rows in the same order, dead pad lanes
+        contribute nothing and are dropped (their tenants' states pass
+        through untouched), and the per-lane delta folds into the state
+        with the same elementwise f32 add the in-step update performs.
+        Staging rides pinned scratch buffers reused across ticks.
+        """
+        out: List[ReplayState] = []
+        pos = 0
+        for n_live, lanes in self.lane_plan(len(work)):
+            group = work[pos:pos + n_live]
+            pos += n_live
+            key = (width, lanes)
+            scratch = self._lane_scratch.get(key)
+            if scratch is None:
+                scratch = {k: np.empty((lanes, width), v.dtype)
+                           for k, v in self._dead_cols_for(width).items()}
+                self._lane_scratch[key] = scratch
+            for k, buf in scratch.items():
+                fill = self._pad_fill(k)
+                for i, (_, cols) in enumerate(group):
+                    c = cols[k]
+                    m = c.shape[0]
+                    buf[i, :m] = c
+                    if m < width:
+                        buf[i, m:] = fill
+                if n_live < lanes:
+                    buf[n_live:] = fill
+            first = key not in self._lane_compile_s
+            t0 = time.perf_counter() if first else 0.0
+            dagg, dhist = self._lane_fn(scratch)
+            # materialize before the scratch is reused: the host copy is
+            # the execute barrier, and the scatter-back below reads it
+            dagg = np.asarray(dagg)
+            dhist = np.asarray(dhist)
+            if first:
+                self._record_lane_compile(key, time.perf_counter() - t0)
+            for i, (st, _) in enumerate(group):
+                out.append(ReplayState(
+                    agg=np.asarray(st.agg) + dagg[i],
+                    hist=np.asarray(st.hist) + dhist[i]))
+            self.fused_dispatches += 1
+            self.lanes_by_bucket[lanes] = \
+                self.lanes_by_bucket.get(lanes, 0) + 1
+            self.staged_lanes += lanes
+            self.live_lanes += n_live
+            self._obs_fused.inc()
+            self._obs_lanes.observe(n_live)
+            self._obs_staged_lanes.inc(lanes)
+            self._obs_live_lanes.inc(n_live)
+        if self.staged_lanes:
+            self._obs_lane_waste.set(
+                1.0 - self.live_lanes / self.staged_lanes)
+        return out
+
+    @property
+    def lane_pad_waste(self) -> float:
+        """Dead-lane fraction of every fused dispatch so far (the lane
+        twin of the row pad-waste gauge)."""
+        return (1.0 - self.live_lanes / self.staged_lanes
+                if self.staged_lanes else 0.0)
 
 
 class BucketedStreamReplay(StreamReplay):
@@ -171,6 +406,8 @@ class BucketedStreamReplay(StreamReplay):
     ONE definition of the eviction math); only ``push`` and ``_warm``
     differ: chunks stage through the runner's bucket plan and the
     compiled executables are shared across every tenant on the runner.
+    ``plan_push`` additionally exposes the staging half alone, for the
+    fused engine's lane-stacked dispatch.
     """
 
     def __init__(self, cfg: ReplayConfig, t0_us: int, runner: BucketRunner):
@@ -196,9 +433,16 @@ class BucketedStreamReplay(StreamReplay):
         self.compile_s = self._runner.compile_s
         self._warmed = True
 
-    def push(self, batch: SpanBatch) -> int:
+    def plan_push(self, batch: SpanBatch):
+        """The staging half of :meth:`push`: roll the ring, account the
+        spans, stage the bucket plan — WITHOUT dispatching.  Returns
+        ``(newest absolute window, ordered (width, columns) chunks)``;
+        applying the chunks to ``state`` in order (``runner.dispatch``,
+        or lanes of them stacked across tenants via ``runner.run_lanes``)
+        reproduces ``push()`` bit-exactly.  This is the fused engine's
+        gather seam."""
         if batch.n_spans == 0:
-            return -1
+            return -1, []
         if not self._warmed:
             self._warm()
         w_need = int((int(batch.start_us.max()) - self.t0_us)
@@ -206,6 +450,12 @@ class BucketedStreamReplay(StreamReplay):
         if w_need > self.cfg.n_windows - 1:
             self._roll(w_need - (self.cfg.n_windows - 1))
             w_need = self.cfg.n_windows - 1
-        self.state = self._runner.push_into(self.state, batch, self.t0_us)
+        plan = self._runner.stage_plan(batch, self.t0_us)
         self.n_spans += batch.n_spans
-        return self.window_offset + max(w_need, 0)
+        return self.window_offset + max(w_need, 0), plan
+
+    def push(self, batch: SpanBatch) -> int:
+        w_ret, plan = self.plan_push(batch)
+        for width, cols in plan:
+            self.state = self._runner.dispatch(self.state, cols, width)
+        return w_ret

@@ -32,6 +32,7 @@ from anomod import obs
 from anomod.ops.tdigest import (TDigest, tdigest_build, tdigest_merge_many,
                                 tdigest_quantile)
 from anomod.replay import ReplayConfig
+from anomod.schemas import concat_span_batches
 from anomod.serve.batcher import BucketedStreamReplay, BucketRunner
 from anomod.serve.queues import (AdmissionController, QueuedBatch,
                                  TenantSpec)
@@ -132,7 +133,13 @@ class ServeReport:
     max_backlog: int
     buckets: Tuple[int, ...]
     dispatches_by_width: Dict[int, int]
+    fused: bool                                  # lane-stacked dispatch on?
+    fused_dispatches: int                        # actual fused dispatches
+    lane_buckets: Tuple[int, ...]
+    lanes_by_bucket: Dict[int, int]              # fused dispatches per bucket
+    lane_pad_waste: float                        # dead-lane fraction
     compile_s: float
+    lane_compile_s: float
     latency: Dict[str, Optional[float]]          # aggregate p50/p99
     per_priority: Dict[int, dict]
     modality_events: Dict[str, int]              # multimodal sidecar volume
@@ -145,8 +152,11 @@ class ServeReport:
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["buckets"] = list(self.buckets)
+        d["lane_buckets"] = list(self.lane_buckets)
         d["dispatches_by_width"] = {str(k): v for k, v
                                     in self.dispatches_by_width.items()}
+        d["lanes_by_bucket"] = {str(k): v for k, v
+                                in self.lanes_by_bucket.items()}
         d["per_priority"] = {str(k): v for k, v
                              in self.per_priority.items()}
         return d
@@ -173,8 +183,10 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   buckets: Optional[Tuple[int, ...]] = None,
                   max_backlog: Optional[int] = None,
                   fault_tenants: int = 2, score: bool = True,
-                  mesh=None, tracer=None,
-                  n_windows: int = 32) -> Tuple["ServeEngine", ServeReport]:
+                  mesh=None, tracer=None, n_windows: int = 32,
+                  fuse: Optional[bool] = None,
+                  lane_buckets: Optional[Tuple[int, ...]] = None
+                  ) -> Tuple["ServeEngine", ServeReport]:
     """The canonical seeded serve run shared by ``anomod serve`` and
     ``bench.py --mode serve``: a power-law tenant fleet offering
     ``overload``× the engine's capacity, with ``fault_tenants`` busiest
@@ -199,7 +211,8 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          max_backlog=max_backlog, score=score,
                          baseline_windows=baseline_windows,
                          z_threshold=z_threshold, mesh=mesh,
-                         tracer=tracer)
+                         tracer=tracer, fuse=fuse,
+                         lane_buckets=lane_buckets)
     report = engine.run(traffic, duration_s=duration_s)
     return engine, report
 
@@ -216,7 +229,9 @@ class ServeEngine:
                  score: bool = True, baseline_windows: int = 4,
                  z_threshold: float = 4.0, consecutive: int = 1,
                  min_count: float = 5.0, mesh=None, tracer=None,
-                 multimodal: bool = False, testbed: Optional[str] = None):
+                 multimodal: bool = False, testbed: Optional[str] = None,
+                 fuse: Optional[bool] = None,
+                 lane_buckets: Optional[Tuple[int, ...]] = None):
         from anomod.config import get_config
         if capacity_spans_per_s <= 0:
             raise ValueError("capacity must be positive")
@@ -238,9 +253,20 @@ class ServeEngine:
             max_tenant_backlog=max_tenant_backlog)
         self.score = bool(score)
         self.mesh = mesh
+        #: tenant-fused scoring (ANOMOD_SERVE_FUSE): per tick, drained
+        #: same-tenant batches coalesce into one staging and same-width
+        #: chunks across tenants run as lane-stacked dispatches — pinned
+        #: bit-identical on CPU to sequential per-tenant scoring of the
+        #: same COALESCED batches (coalescing is the one documented
+        #: regrouping vs the unfused per-batch path: docs/SERVING.md).
+        #: The mesh plane manages its own sharded dispatch, so fusion
+        #: only applies to the bucket-runner plane.
+        self.fuse = bool(app_cfg.serve_fuse if fuse is None else fuse)
+        self._fused = self.fuse and mesh is None
         self.runner = BucketRunner(
             self.cfg,
-            buckets if buckets is not None else app_cfg.serve_buckets)
+            buckets if buckets is not None else app_cfg.serve_buckets,
+            lane_buckets=lane_buckets)
         # tracing is ON by default, gated on the one telemetry switch
         # (ANOMOD_OBS_ENABLED) so "telemetry off" means off end to end;
         # pass an explicit Tracer to force it on regardless
@@ -264,6 +290,10 @@ class ServeEngine:
         self._slo: Dict[int, _TenantSLO] = {s.tenant_id: _TenantSLO()
                                             for s in self.specs}
         self._credit = 0.0
+        #: widest batch ever served — the legitimate overdraw envelope
+        #: the per-tick credit clamp must respect (a >budget batch's debt
+        #: persists across idle ticks; forgiving it would forge capacity)
+        self._max_served_batch = 0
         self.serve_wall_s = 0.0
         self.n_spans_served = 0
         # self-scrape plumbing (anomod.obs): cached handles for the tick
@@ -380,17 +410,47 @@ class ServeEngine:
                 self.admission.offer(tenant_id, spans, now)
         # capacity credit: unused budget does not bank across idle ticks
         # beyond one tick's worth (no unbounded burst debt)
-        self._credit = min(self._credit, 0.0) \
-            + self.capacity_spans_per_s * self.clock.tick_s
+        budget = self.capacity_spans_per_s * self.clock.tick_s
+        self._credit = min(self._credit, 0.0) + budget
         with self._span("serve.drain"):
             served = self.admission.drain(self._credit)
         for qb in served:
             self._credit -= qb.n_spans
-            with self._span("serve.score"):
-                if self.score:
-                    self._detector_for(qb.tenant_id).push(qb.spans)
-                else:
-                    self._replay_for(qb.tenant_id).push(qb.spans)
+        # credit clamp: the residual is physically bounded — at most one
+        # tick's unused budget (positive), at most one batch's overdraw
+        # (negative) — so anything outside that envelope can only be
+        # accumulated float rounding (budget = capacity * tick_s is
+        # inexact for most tick widths).  Clamp it, and snap sub-span
+        # dust to zero, so a billion-tick run cannot drift phantom
+        # capacity or phantom debt into the schedule.  The negative
+        # bound uses the widest batch EVER served, not this tick's: a
+        # >budget batch's legitimate debt is paid down across several
+        # idle ticks, and a floor derived from the (empty) current tick
+        # would forgive it mid-repayment.
+        for qb in served:
+            if qb.n_spans > self._max_served_batch:
+                self._max_served_batch = qb.n_spans
+        self._credit = min(
+            max(self._credit, -max(budget, float(self._max_served_batch))),
+            budget)
+        if -1e-9 < self._credit < 1e-9:
+            self._credit = 0.0
+        if served:
+            if self._fused:
+                with self._span("serve.score_fused"):
+                    self._score_fused(served)
+            else:
+                for qb in served:
+                    with self._span("serve.score"):
+                        if self.score:
+                            self._detector_for(qb.tenant_id).push(qb.spans)
+                        else:
+                            self._replay_for(qb.tenant_id).push(qb.spans)
+        # per-batch SLO accounting is DEFERRED past scoring in both paths
+        # (the latency samples depend only on admission times and the
+        # tick clock, so fused and unfused runs record identical values
+        # in identical per-tenant order)
+        for qb in served:
             self._slo[qb.tenant_id].record(now - qb.enqueued_s)
             self.n_spans_served += qb.n_spans
         self.clock.advance()
@@ -406,12 +466,72 @@ class ServeEngine:
         self.serve_wall_s += time.perf_counter() - t_wall
         return served
 
+    def _score_fused(self, served: List[QueuedBatch]) -> None:
+        """Tenant-fused scoring of one tick's drained batches.
+
+        Three phases, each pinned bit-identical to the sequential path:
+
+        1. COALESCE (host): same-tenant batches drained this tick
+           concatenate in arrival order into ONE staging per tenant —
+           one roll, one split plan, one edge pass instead of per batch.
+        2. STACK + DISPATCH: per chunk ROUND (a tenant's own chunks must
+           apply in order), same-width staged chunks across tenants run
+           as lane-stacked fused dispatches (``runner.run_lanes``), lane
+           counts padded to the fixed lane-bucket set.  Tenant states
+           gather/scatter through the StreamReplay ``get_state`` /
+           ``set_state`` seam; dead pad lanes pass through untouched.
+        3. COMMIT (host): per tenant, the detector's post-replay half
+           (``note_pushed``) scores newly closed windows exactly as a
+           sequential push of the coalesced batch would.
+        """
+        per_tenant: Dict[int, List[QueuedBatch]] = {}
+        for qb in served:
+            per_tenant.setdefault(qb.tenant_id, []).append(qb)
+        pending = []
+        for tid, qbs in per_tenant.items():
+            batch = qbs[0].spans if len(qbs) == 1 else \
+                concat_span_batches([qb.spans for qb in qbs])
+            if self.score:
+                det = self._detector_for(tid)
+                replay = det.replay
+            else:
+                det = None
+                replay = self._replay_for(tid)
+            t0 = time.perf_counter()
+            rb = det.replay_batch(batch) if det is not None else batch
+            w_ret, plan = replay.plan_push(rb)
+            if det is not None:
+                det.push_wall_s += time.perf_counter() - t0
+            pending.append((det, replay, batch.n_spans, w_ret, plan))
+        rnd = 0
+        while True:
+            groups: Dict[int, List[int]] = {}
+            for i, (_, _, _, _, plan) in enumerate(pending):
+                if rnd < len(plan):
+                    groups.setdefault(plan[rnd][0], []).append(i)
+            if not groups:
+                break
+            for width in sorted(groups):
+                idxs = groups[width]
+                work = [(pending[i][1].get_state(), pending[i][4][rnd][1])
+                        for i in idxs]
+                for i, st in zip(idxs, self.runner.run_lanes(width, work)):
+                    pending[i][1].set_state(st)
+            rnd += 1
+        for det, replay, n_in, w_ret, plan in pending:
+            if det is not None:
+                t0 = time.perf_counter()
+                det.note_pushed(n_in, w_ret)
+                det.push_wall_s += time.perf_counter() - t0
+
     def run(self, traffic, duration_s: float,
             warm: bool = True) -> "ServeReport":
         """Drive the engine from a traffic source for ``duration_s``
         virtual seconds, then close every tenant's last window."""
         if warm and self.mesh is None:
             self.runner.warm()                   # compiles outside the wall
+            if self._fused:
+                self.runner.warm_lanes()
         n_ticks = max(int(round(duration_s / self.clock.tick_s)), 1)
         mod_src = getattr(traffic, "modality_arrivals", None) \
             if self.multimodal else None
@@ -498,7 +618,13 @@ class ServeEngine:
             max_backlog=self.admission.max_backlog,
             buckets=self.runner.buckets,
             dispatches_by_width=dict(self.runner.dispatches_by_width),
+            fused=self._fused,
+            fused_dispatches=self.runner.fused_dispatches,
+            lane_buckets=self.runner.lane_buckets,
+            lanes_by_bucket=dict(self.runner.lanes_by_bucket),
+            lane_pad_waste=round(self.runner.lane_pad_waste, 6),
             compile_s=round(self.runner.compile_s, 4),
+            lane_compile_s=round(self.runner.lane_compile_s, 4),
             latency=_merged_quantiles(list(self._slo.values())),
             per_priority=per_pri,
             modality_events=dict(self.modality_events),

@@ -273,6 +273,26 @@ class StreamReplay:
         absolute window ``window_offset + w``)."""
         return plane_view(self.state, self.cfg)
 
+    # -- the lane-stack gather/scatter seam (anomod.serve.batcher) --------
+    #
+    # Fused serving gathers many tenants' states, folds each tenant's
+    # staged chunk through ONE lane-stacked dispatch, and hands each
+    # lane's result back.  The seam is deliberately dumb — the state
+    # pytree round-trips verbatim — but it is the OFFICIAL boundary:
+    # consumers go through it instead of poking ``.state``, so a future
+    # replay that keeps extra device-side residency can hook the
+    # round-trip in one place.
+
+    def get_state(self) -> ReplayState:
+        """The replay plane's current state pytree (gather seam)."""
+        return self.state
+
+    def set_state(self, state: ReplayState) -> None:
+        """Install an externally-advanced state pytree (scatter seam).
+        The caller owns the parity contract: the installed state must be
+        what this plane's own dispatch would have produced."""
+        self.state = state
+
 
 class OnlineDetector:
     """Window-closed z-score alerting over a :class:`StreamReplay`.
@@ -515,26 +535,45 @@ class OnlineDetector:
             self.replay._warm()          # compile outside the timed wall
         t0 = time.perf_counter()
         try:
-            if self.edge_attribution and batch.n_spans:
-                svc = batch.service.astype(np.int32)
-                psvc = None if parent_service is None else \
-                    np.asarray(parent_service, np.int32)
-                if psvc is not None:
-                    self._accumulate_pairs(batch, svc, psvc)
-                eids = self._edge_ids(svc, psvc)
-                batch = batch._replace(
-                    service=np.concatenate([svc, eids]),
-                    **{f: np.concatenate([getattr(batch, f)] * 2)
-                       for f in self._DUP_FIELDS})
-            w_max = self.replay.push(batch)
-            if w_max < 0:
-                return []
-            self.n_spans_in += batch.n_spans // (
-                2 if self.edge_attribution else 1)
-            self._max_seen = max(self._max_seen, w_max)
-            return self._score_through(self._max_seen - 1)
+            w_max = self.replay.push(
+                self.replay_batch(batch, parent_service))
+            return self.note_pushed(batch.n_spans, w_max)
         finally:
             self.push_wall_s += time.perf_counter() - t0
+
+    def replay_batch(self, batch: SpanBatch,
+                     parent_service: Optional[np.ndarray] = None
+                     ) -> SpanBatch:
+        """Host-side pre-replay half of :meth:`push`: the EXACT batch
+        push() hands the replay plane (edge-id duplication + per-pair
+        phase accumulation applied; the identity when edge attribution is
+        off).  The fused serving plane (anomod.serve.engine) calls this,
+        folds the result through a lane-stacked dispatch, then finishes
+        with :meth:`note_pushed` — one definition of both halves, so the
+        fused and sequential scoring paths cannot drift."""
+        if not (self.edge_attribution and batch.n_spans):
+            return batch
+        svc = batch.service.astype(np.int32)
+        psvc = None if parent_service is None else \
+            np.asarray(parent_service, np.int32)
+        if psvc is not None:
+            self._accumulate_pairs(batch, svc, psvc)
+        eids = self._edge_ids(svc, psvc)
+        return batch._replace(
+            service=np.concatenate([svc, eids]),
+            **{f: np.concatenate([getattr(batch, f)] * 2)
+               for f in self._DUP_FIELDS})
+
+    def note_pushed(self, n_spans: int, w_max: int) -> List[Alert]:
+        """Post-replay half of :meth:`push`: bookkeeping plus scoring of
+        the newly closed windows.  ``n_spans`` is the ORIGINAL batch's
+        span count (pre edge duplication); ``w_max`` is the replay
+        plane's returned newest absolute window."""
+        if w_max < 0:
+            return []
+        self.n_spans_in += n_spans
+        self._max_seen = max(self._max_seen, w_max)
+        return self._score_through(self._max_seen - 1)
 
     def finish(self) -> List[Alert]:
         """End of stream: the newest window with data counts as closed.

@@ -49,6 +49,73 @@ def probe_device_platform(attempts=None):
     return "", last or "unknown"
 
 
+def _probe_verdict_path():
+    """Cache file for the device-probe verdict, or None when caching is
+    off.  Keyed by jax/jaxlib version + OS platform (importing jax does
+    NOT initialize the backend — versions are safe to read even with a
+    dead tunnel); lives under ``ANOMOD_CACHE_DIR`` next to the ingest
+    cache, so the one cache knob governs both."""
+    try:
+        from anomod.io.cache import cache_root
+        root = cache_root()
+    except Exception:
+        return None
+    if root is None:
+        return None
+    import sys
+
+    import jax
+    try:
+        import jaxlib
+        jaxlib_v = getattr(jaxlib, "__version__", "unknown")
+    except Exception:
+        jaxlib_v = "unknown"
+    key = f"{jax.__version__}_{jaxlib_v}_{sys.platform}".replace("/", "_")
+    return root / "probe" / f"verdict_{key}.json"
+
+
+def read_probe_verdict():
+    """The cached device-probe verdict as ``(platform, diagnostic)``, or
+    None when absent/unreadable/disabled.  A cached empty platform means
+    the probe timed out on this jax/jaxlib install — the caller's CPU
+    fallback applies without re-paying the probe deadline (the whole
+    point: CPU-only boxes stop burning ~60 s per bench run).  A revived
+    device tunnel needs a fresh probe (bench.py ``--probe-fresh``).
+
+    Callers must only WRITE (and trust) CPU/timeout verdicts: a cached
+    live-accelerator verdict would bypass the liveness probe on a
+    tunnel that has since died, and the first backend touch would hang
+    with no deadline (bench.py enforces this on both sides)."""
+    import json
+
+    path = _probe_verdict_path()
+    if path is None:
+        return None
+    try:
+        d = json.loads(path.read_text())
+        return str(d["platform"]), str(d["diag"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def write_probe_verdict(platform: str, diag: str) -> None:
+    """Publish the probe verdict atomically (tmp + os.replace, the
+    ingest cache's publish idiom); best-effort — an unwritable cache dir
+    must never fail the capture."""
+    import json
+
+    path = _probe_verdict_path()
+    if path is None:
+        return
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps({"platform": platform, "diag": diag}))
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def ensure_live_backend(n_cpu_fallback: int = 1, attempts=None) -> str:
     """Probe the device backend out-of-process; pin CPU when it is dead.
 

@@ -32,15 +32,21 @@ where anything touching ``jax.devices()`` either raises or hangs forever):
   3. Any error after that still emits the JSON line with an ``error`` field.
 
 ``ANOMOD_BENCH_PLATFORM=cpu|tpu`` skips the probe and forces the platform.
+The probe VERDICT is cached under ``ANOMOD_CACHE_DIR`` (keyed by
+jax/jaxlib version + OS platform), so a CPU-only box pays the dead-tunnel
+probe deadline once per install, not once per run; ``--probe-fresh``
+bypasses the cache (use after a device tunnel revives).
 
 Serve mode (``python bench.py --mode serve`` or ``ANOMOD_BENCH_MODE=serve``):
 instead of the batch replay, drives the multi-tenant serving plane
 (anomod.serve) with a seeded power-law fleet offering 2x the engine's
 capacity and emits ONE JSON line with sustained spans/sec through
 admission+batching+scoring, the p99 admission->scored latency, and the
-shed fraction under that overload at the configured backlog budget.
+shed fraction under that overload at the configured backlog budget —
+plus a ``fused_dispatch`` block comparing the tenant-fused (lane-stacked)
+path against one-dispatch-per-micro-batch on the same seed.
 Gate serve captures on ``scripts/pre_bench_check.py --mode serve`` (bucket
-set must validate + compile).  Knobs: ``ANOMOD_SERVE_BENCH_CAPACITY``
+set AND the (width x lane-bucket) fused grid must validate + compile).  Knobs: ``ANOMOD_SERVE_BENCH_CAPACITY``
 (spans/sec, default 25000), ``ANOMOD_SERVE_BENCH_DURATION`` (virtual
 seconds, default 60), ``ANOMOD_SERVE_BENCH_TENANTS`` (default 200).
 
@@ -58,34 +64,60 @@ import os
 import sys
 import time
 
-def _resolve_platform(attempts=None):
+def _resolve_platform(attempts=None, fresh=False):
     """Return ("default"|"cpu", diagnostic). Probes backend init out-of-process
     (anomod.utils.platform.probe_device_platform) with a hard deadline per
     attempt so a dead tunnel can't block the bench.  A backend that
     initializes but is CPU-only still resolves to "cpu" so the workload is
-    sized for the host, not for a TPU."""
+    sized for the host, not for a TPU.
+
+    The verdict is CACHED under ``ANOMOD_CACHE_DIR`` keyed by
+    jax/jaxlib version + OS platform, so a CPU-only box pays the probe
+    deadline (up to ~60 s per attempt on a dead tunnel) once per
+    install instead of once per run.  ``--probe-fresh`` bypasses the
+    cache and re-probes (use after a device tunnel revives)."""
     forced = os.environ.get("ANOMOD_BENCH_PLATFORM", "").strip().lower()
     if forced:
         plat = "cpu" if forced == "cpu" else "default"
         return plat, f"forced via ANOMOD_BENCH_PLATFORM={forced}"
-    from anomod.utils.platform import env_number, probe_device_platform
-    plat, diag = probe_device_platform(attempts)
-    # Bounded revival retry before conceding the CPU fallback: the axon
-    # tunnel drops and revives on minute scales, so a driver capture that
-    # lands in a dead window still has a chance to go on-chip.  Each extra
-    # probe is a fresh 60 s-deadline subprocess, 30 s apart — ~5 min worst
-    # case on top of the initial (75+30) s probe, then the fallback.
-    retries = env_number("ANOMOD_BENCH_PROBE_RETRIES", 3)
-    while not plat and retries > 0:
-        time.sleep(30)
-        plat, diag = probe_device_platform((60.0,))
-        retries -= 1
-        diag = f"{diag}; {retries} probe retries left"
+    from anomod.utils.platform import (env_number, probe_device_platform,
+                                       read_probe_verdict,
+                                       write_probe_verdict)
+    cached = None if fresh else read_probe_verdict()
+    if cached is not None and cached[0] not in ("", "cpu"):
+        cached = None        # never trust a cached live-device verdict
+    if cached is not None:
+        plat, diag = cached
+    else:
+        plat, diag = probe_device_platform(attempts)
+        # Bounded revival retry before conceding the CPU fallback: the axon
+        # tunnel drops and revives on minute scales, so a driver capture that
+        # lands in a dead window still has a chance to go on-chip.  Each extra
+        # probe is a fresh 60 s-deadline subprocess, 30 s apart — ~5 min worst
+        # case on top of the initial (75+30) s probe, then the fallback.
+        retries = env_number("ANOMOD_BENCH_PROBE_RETRIES", 3)
+        while not plat and retries > 0:
+            time.sleep(30)
+            plat, diag = probe_device_platform((60.0,))
+            retries -= 1
+            diag = f"{diag}; {retries} probe retries left"
+        # the FINAL verdict (post-retry) is what the cache records — but
+        # ONLY a CPU/timeout verdict.  Caching a live-accelerator verdict
+        # would let a later run skip the liveness probe entirely and then
+        # hang without a deadline at first backend touch when the tunnel
+        # has died since — the exact failure the out-of-process probe
+        # exists to prevent.  A CPU-only box's verdict cannot go stale
+        # that way (there is no tunnel to die), which is the case the
+        # cache is for.
+        if plat in ("", "cpu"):
+            write_probe_verdict(plat, diag)
+    note = " [cached verdict; --probe-fresh re-probes]" \
+        if cached is not None else ""
     if plat == "cpu":
-        return "cpu", "backend probe found CPU-only devices"
+        return "cpu", f"backend probe found CPU-only devices{note}"
     if plat:
-        return "default", f"device backend probe ok ({plat})"
-    return "cpu", f"device backend unavailable ({diag})"
+        return "default", f"device backend probe ok ({plat}){note}"
+    return "cpu", f"device backend unavailable ({diag}){note}"
 
 
 def _bench_mode(argv) -> str:
@@ -103,19 +135,25 @@ def _bench_mode(argv) -> str:
     return mode
 
 
-def serve_main() -> int:
+def serve_main(probe_fresh=False) -> int:
     """The serve-mode capture: sustained spans/sec + p99 latency + shed
     fraction under a seeded 2x overload (fixed backlog budget).
 
-    The run executes TWICE on the same seed: first with the
-    self-scraping registry (anomod.obs) + default tracer on, then with
+    The run executes THREE times on the same seed: first with the
+    self-scraping registry (anomod.obs) + default tracer on (the
+    headline numbers, fused dispatch per the config default), then with
     telemetry forced off — the ``telemetry`` block reports both
     sustained rates and the enabled-telemetry overhead fraction
     (acceptance bar: <= 5%; the off leg runs second so it inherits the
-    one-time process warmup and the fraction is an upper bound).
-    The enabled run's scrape journal is exported as a TT-CSV self-scrape
-    capture next to the provenance record and scored through the
-    framework's own detector stack (``self_scrape`` block)."""
+    one-time process warmup and the fraction is an upper bound) — and
+    finally with the tenant-FUSED dispatch forced off (telemetry on,
+    its own registry): the ``fused_dispatch`` block reports fused vs
+    unfused sustained spans/sec, p99 and shed fraction on the same seed
+    (the unfused leg runs LAST so the speedup is never flattered by
+    warmup order).  The enabled run's scrape journal is exported as a
+    TT-CSV self-scrape capture next to the provenance record and scored
+    through the framework's own detector stack (``self_scrape``
+    block)."""
     from anomod.utils.platform import env_number
     out = {
         "metric": "serve_sustained_throughput",
@@ -123,7 +161,7 @@ def serve_main() -> int:
         "unit": "spans/sec",
         "mode": "serve",
     }
-    platform, diag = _resolve_platform()
+    platform, diag = _resolve_platform(fresh=probe_fresh)
     import jax
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
@@ -153,6 +191,13 @@ def serve_main() -> int:
         set_registry(Registry(enabled=False))
         try:
             _, rep_off = run_power_law(**run_kw)
+            # the unfused reference leg: same seed, fused dispatch
+            # forced OFF, telemetry on (matching the headline leg) but
+            # in its OWN registry so the headline journal/snapshot stays
+            # the headline run's.  Runs last — it inherits every
+            # warmup, so the reported fused speedup is a lower bound.
+            set_registry(Registry(enabled=True))
+            _, rep_unfused = run_power_law(fuse=False, **run_kw)
         finally:
             set_registry(prev_reg)
         set_registry(reg)
@@ -179,6 +224,26 @@ def serve_main() -> int:
             "n_alerts": rep.n_alerts,
             "device": str(jax.devices()[0]),
         })
+        # fused vs unfused on the same seed (both telemetry-on): the
+        # tenant-fused lane-stacked dispatch against one dispatch per
+        # tenant micro-batch
+        out["fused_dispatch"] = {
+            "fused": rep.fused,
+            "spans_per_sec_fused": rep.sustained_spans_per_sec,
+            "spans_per_sec_unfused": rep_unfused.sustained_spans_per_sec,
+            "speedup": round(rep.sustained_spans_per_sec
+                             / max(rep_unfused.sustained_spans_per_sec,
+                                   1e-9), 2),
+            "p99_latency_s_unfused":
+                rep_unfused.latency.get("p99_latency_s"),
+            "shed_fraction_unfused": rep_unfused.shed_fraction,
+            "fused_dispatches": rep.fused_dispatches,
+            "lane_buckets": list(rep.lane_buckets),
+            "lanes_by_bucket": {str(k): v for k, v
+                                in rep.lanes_by_bucket.items()},
+            "lane_pad_waste": rep.lane_pad_waste,
+            "lane_compile_s": rep.lane_compile_s,
+        }
         # enabled-vs-off telemetry overhead on the same seed (acceptance
         # bar: <= 5% sustained spans/sec); both rates are steady-state
         # serving walls with compile excluded by warm()
@@ -245,6 +310,9 @@ def main() -> int:
     if "--mode" in argv:
         i = argv.index("--mode")
         del argv[i:i + 2]
+    probe_fresh = "--probe-fresh" in argv
+    if probe_fresh:
+        argv.remove("--probe-fresh")
     if mode == "serve":
         # serve mode is env-knob driven; stray argv must error, not
         # silently record a capture at the default configuration
@@ -252,7 +320,7 @@ def main() -> int:
             raise SystemExit(f"bench.py --mode serve takes no positional "
                              f"arguments (use ANOMOD_SERVE_BENCH_* env "
                              f"knobs), got {argv!r}")
-        return serve_main()
+        return serve_main(probe_fresh=probe_fresh)
     # replay mode keeps the historical positional contract: one optional
     # n_traces integer; anything else must error, not silently fall back
     # to the 2000-trace default (the capture would record a throughput
@@ -271,7 +339,7 @@ def main() -> int:
     }
     baseline = 1_000_000.0
 
-    platform, diag = _resolve_platform()
+    platform, diag = _resolve_platform(fresh=probe_fresh)
     import jax
     if platform == "cpu":
         # Pre-init platform pin (conftest.py technique); must run before any

@@ -58,10 +58,26 @@ def check_serve() -> int:
         # the serve bench's plane shape (ONE definition with bench.py's
         # serve path): compile every bucket width once so the capture's
         # compile_s is warm-path bookkeeping, not a mid-capture stall
-        runner = BucketRunner(serve_plane_cfg(), cfg.serve_buckets)
+        runner = BucketRunner(serve_plane_cfg(), cfg.serve_buckets,
+                              lane_buckets=cfg.serve_lane_buckets)
         compile_s = runner.warm()
         out.update(status="ready", widths=list(runner.widths),
                    compile_s=round(compile_s, 3))
+        if cfg.serve_fuse:
+            # the fused path additionally needs the full
+            # (width x lane-bucket) grid compiled — a shape miss here
+            # would stall (or crash) the capture mid-serve
+            out["lane_buckets"] = list(runner.lane_buckets)
+            lane_compile_s = runner.warm_lanes()
+            expected = {(w, l) for w in runner.widths
+                        for l in runner.lane_buckets}
+            missing = sorted(expected - runner.lane_shapes)
+            if missing:
+                raise RuntimeError(
+                    f"fused lane grid shape miss: {missing} did not "
+                    "compile")
+            out.update(lane_shapes=len(runner.lane_shapes),
+                       lane_compile_s=round(lane_compile_s, 3))
         print(json.dumps(out))
         return 0
     except Exception as e:

@@ -141,3 +141,112 @@ def test_bench_serve_mode_contract(tmp_path):
     from anomod.io.metrics import load_tt_metric_csv
     batch = load_tt_metric_csv(csvs[0])
     assert batch is not None and batch.n_samples == scrape["samples"]
+    # fused-vs-unfused on the same seed (PR-4): the tenant-fused
+    # lane-stacked path is the headline, the unfused leg rides along
+    fd = out["fused_dispatch"]
+    assert fd["fused"] is True
+    assert fd["spans_per_sec_fused"] == out["value"]
+    assert fd["spans_per_sec_unfused"] > 0
+    assert fd["speedup"] > 0
+    assert fd["fused_dispatches"] > 0
+    assert fd["lane_buckets"]
+    assert 0.0 <= fd["lane_pad_waste"] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# device-probe verdict cache (PR-4): CPU-only boxes stop paying the 60 s
+# init-probe timeout on every run
+# ---------------------------------------------------------------------------
+
+def _fresh_config():
+    from anomod.config import Config, set_config
+    set_config(Config())
+
+
+def test_probe_verdict_cache_roundtrip(tmp_path, monkeypatch):
+    from anomod.config import get_config, set_config
+    from anomod.utils import platform as plat
+    old = get_config()
+    try:
+        monkeypatch.setenv("ANOMOD_CACHE_DIR", str(tmp_path / "cache"))
+        _fresh_config()
+        assert plat.read_probe_verdict() is None
+        # the dead-tunnel timeout verdict IS cacheable — that's the
+        # whole point (the box pays the deadline once per install)
+        plat.write_probe_verdict("", "backend init probe timed out")
+        assert plat.read_probe_verdict() == \
+            ("", "backend init probe timed out")
+        plat.write_probe_verdict("cpu", "probe ok")
+        assert plat.read_probe_verdict() == ("cpu", "probe ok")
+        # a corrupted verdict file reads as absent, never crashes
+        plat._probe_verdict_path().write_text("{not json")
+        assert plat.read_probe_verdict() is None
+        # caching disabled: no path, writes are no-ops, reads absent
+        monkeypatch.setenv("ANOMOD_CACHE_DIR", "off")
+        _fresh_config()
+        assert plat._probe_verdict_path() is None
+        plat.write_probe_verdict("cpu", "x")
+        assert plat.read_probe_verdict() is None
+    finally:
+        set_config(old)
+
+
+def _load_bench_module():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", Path(__file__).parent.parent / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_resolve_platform_uses_cached_verdict(tmp_path, monkeypatch):
+    """A cached verdict short-circuits the probe entirely;
+    --probe-fresh re-probes and rewrites the cache with the new
+    verdict."""
+    from anomod.config import get_config, set_config
+    from anomod.utils import platform as plat
+    old = get_config()
+    try:
+        monkeypatch.delenv("ANOMOD_BENCH_PLATFORM", raising=False)
+        monkeypatch.setenv("ANOMOD_CACHE_DIR", str(tmp_path / "cache"))
+        _fresh_config()
+        plat.write_probe_verdict("", "backend init probe timed out")
+        bench = _load_bench_module()
+        calls = []
+        monkeypatch.setattr(
+            plat, "probe_device_platform",
+            lambda *a, **k: (calls.append(1), ("cpu", "probe ok"))[1])
+        got, diag = bench._resolve_platform()
+        assert got == "cpu"
+        assert "cached verdict" in diag and not calls
+        got, diag = bench._resolve_platform(fresh=True)
+        assert got == "cpu" and calls
+        assert "cached verdict" not in diag
+        assert plat.read_probe_verdict() == ("cpu", "probe ok")
+        # the refreshed verdict now serves from cache again
+        calls.clear()
+        got, diag = bench._resolve_platform()
+        assert got == "cpu" and "cached verdict" in diag and not calls
+        # a forced platform never touches probe OR cache
+        monkeypatch.setenv("ANOMOD_BENCH_PLATFORM", "cpu")
+        got, diag = bench._resolve_platform()
+        assert got == "cpu" and "forced" in diag and not calls
+        monkeypatch.delenv("ANOMOD_BENCH_PLATFORM")
+        # a live-accelerator verdict is NEVER trusted from cache (a
+        # tunnel that died since would hang the first backend touch
+        # with no deadline) — the probe must re-run...
+        plat.write_probe_verdict("tpu", "probe ok")
+        calls.clear()
+        got, diag = bench._resolve_platform()
+        assert calls and "cached verdict" not in diag
+        # ...and a live verdict is never WRITTEN either: the fresh
+        # "cpu" probe result above replaced the stale entry
+        assert plat.read_probe_verdict() == ("cpu", "probe ok")
+        monkeypatch.setattr(plat, "probe_device_platform",
+                            lambda *a, **k: ("tpu", "probe ok"))
+        got, diag = bench._resolve_platform(fresh=True)
+        assert got == "default"
+        assert plat.read_probe_verdict() == ("cpu", "probe ok")  # unchanged
+    finally:
+        set_config(old)

@@ -24,6 +24,7 @@ from anomod.schemas import take_spans
 from anomod.serve import (AdmissionController, BucketedStreamReplay,
                           BucketRunner, PowerLawTraffic, ScriptedTraffic,
                           ServeEngine, TenantSpec, split_plan)
+from anomod.serve.engine import run_power_law
 from anomod.serve.batcher import validate_buckets
 from anomod.serve.traffic import TenantFault
 from anomod.stream import OnlineDetector, StreamReplay
@@ -366,7 +367,8 @@ def test_overload_shedding_is_priority_ordered_and_deterministic():
     # queueing under overload is visible in the latency sketch
     assert rep.latency["p99_latency_s"] > 0
 
-    wall = ("serve_wall_s", "sustained_spans_per_sec", "compile_s")
+    wall = ("serve_wall_s", "sustained_spans_per_sec", "compile_s",
+            "lane_compile_s")
     a = {k: v for k, v in _overload_report(5).to_dict().items()
          if k not in wall}
     b = {k: v for k, v in _overload_report(5).to_dict().items()
@@ -437,20 +439,33 @@ def test_mesh_serve_matches_bucketed_alert_set():
 
 
 def test_tracer_records_serving_phases():
+    """The fused tick wraps its one dispatch phase in serve.score_fused;
+    the unfused escape hatch keeps the historical per-batch serve.score
+    span."""
     from anomod.utils.tracing import Tracer
-    tracer = Tracer("anomod-serve")
-    traffic = PowerLawTraffic(n_tenants=3, total_rate_spans_per_s=300,
-                              seed=0, n_services=4)
-    cfg = ReplayConfig(n_services=4, n_windows=16, window_us=5_000_000,
-                       chunk_size=512)
-    eng = ServeEngine(traffic.specs, traffic.services, cfg,
-                      capacity_spans_per_s=500, tick_s=1.0,
-                      buckets=(256,), score=False, tracer=tracer)
-    eng.run(traffic, duration_s=10.0)
-    names = {s["operationName"]
-             for s in tracer.to_jaeger()["data"][0]["spans"]}
+
+    def phases(fuse):
+        tracer = Tracer("anomod-serve")
+        traffic = PowerLawTraffic(n_tenants=3, total_rate_spans_per_s=300,
+                                  seed=0, n_services=4)
+        cfg = ReplayConfig(n_services=4, n_windows=16, window_us=5_000_000,
+                           chunk_size=512)
+        eng = ServeEngine(traffic.specs, traffic.services, cfg,
+                          capacity_spans_per_s=500, tick_s=1.0,
+                          buckets=(256,), score=False, tracer=tracer,
+                          fuse=fuse)
+        eng.run(traffic, duration_s=10.0)
+        return {s["operationName"]
+                for s in tracer.to_jaeger()["data"][0]["spans"]}
+
+    fused = phases(True)
     assert {"serve.run", "serve.admit", "serve.drain",
-            "serve.score"} <= names
+            "serve.score_fused"} <= fused
+    assert "serve.score" not in fused
+    unfused = phases(False)
+    assert {"serve.run", "serve.admit", "serve.drain",
+            "serve.score"} <= unfused
+    assert "serve.score_fused" not in unfused
 
 
 # ---------------------------------------------------------------------------
@@ -481,6 +496,337 @@ def test_serve_env_knobs_registered_and_validated(monkeypatch):
     monkeypatch.delenv("ANOMOD_SERVE_MAX_BACKLOG")
     from anomod.serve.batcher import DEFAULT_BUCKETS
     assert Config().serve_buckets == DEFAULT_BUCKETS
+
+
+# ---------------------------------------------------------------------------
+# tenant-fused scoring: lane-stacked dispatch + coalescing (the PR-4 pins)
+# ---------------------------------------------------------------------------
+
+def _rand_spans(n, n_services, seed, t_lo_s=0.0, t_hi_s=60.0):
+    from anomod.schemas import SpanBatch
+    rng = np.random.default_rng(seed)
+    err = rng.random(n) < 0.05
+    return SpanBatch(
+        trace=rng.integers(0, 16, n).astype(np.int32),
+        parent=np.full(n, -1, np.int32),
+        service=rng.integers(0, n_services, n).astype(np.int32),
+        endpoint=np.zeros(n, np.int32),
+        start_us=np.sort(rng.integers(int(t_lo_s * 1e6), int(t_hi_s * 1e6),
+                                      n)).astype(np.int64),
+        duration_us=rng.integers(1, 1_000_000, n).astype(np.int64),
+        is_error=err.astype(np.bool_),
+        status=np.where(err, 500, 200).astype(np.int16),
+        kind=np.zeros(n, np.int8),
+        services=tuple(f"s{i}" for i in range(n_services)),
+        endpoints=("e",),
+        trace_ids=tuple(f"t{i:02d}" for i in range(16))).validate()
+
+
+def test_run_lanes_bit_identical_to_single_dispatch():
+    """The fused mechanism itself: lane-stacked dispatches (including a
+    dead-padded group) produce per-lane states bit-identical to
+    dispatching each lane's chunk alone."""
+    from anomod.replay import N_FEATS, ReplayState
+    cfg = ReplayConfig(n_services=6, n_windows=8, window_us=5_000_000,
+                       chunk_size=512)
+    runner = BucketRunner(cfg, (128, 512), lane_buckets=(1, 2, 4))
+    runner.warm()
+    rng = np.random.default_rng(0)
+
+    def rand_state():
+        return ReplayState(
+            agg=rng.lognormal(3, 2, (cfg.sw, N_FEATS)).astype(np.float32),
+            hist=rng.lognormal(1, 1,
+                               (cfg.sw, cfg.n_hist_buckets)).astype(
+                                   np.float32))
+
+    # five lanes of width-128 chunks: lane_plan -> a full 4-bucket group
+    # plus a dead-padded 1-bucket group
+    work = []
+    for i in range(5):
+        plan = runner.stage_plan(_rand_spans(100 + i, 6, seed=i), 0)
+        assert [w for w, _ in plan] == [128]
+        work.append((rand_state(), plan[0][1]))
+    seq = [runner.dispatch(st, cols, 128) for st, cols in work]
+    fused = runner.run_lanes(128, list(work))
+    for a, b in zip(seq, fused):
+        np.testing.assert_array_equal(np.asarray(a.agg), np.asarray(b.agg))
+        np.testing.assert_array_equal(np.asarray(a.hist),
+                                      np.asarray(b.hist))
+    assert runner.fused_dispatches == 2
+    assert runner.lanes_by_bucket == {4: 1, 1: 1}
+    assert runner.staged_lanes == 5 and runner.live_lanes == 5
+    assert runner.lane_pad_waste == 0.0
+
+
+def test_scatter_step_bit_identical_to_matmul_step():
+    """The CPU engine swap the fused path leans on: the segment-sum
+    (scatter) formulation of the chunk step produces the BIT-identical
+    f32 state of the one-hot matmul formulation, single-lane and
+    lane-stacked (delta + host add) alike."""
+    import jax
+
+    from anomod.replay import (N_FEATS, ReplayState, make_chunk_step,
+                               make_lane_delta, stage_columns)
+    cfg = ReplayConfig(n_services=6, n_windows=8, window_us=5_000_000,
+                       chunk_size=256)
+    mat = jax.jit(lambda st, ch: make_chunk_step(
+        cfg, engine="matmul")(st, ch)[0])
+    sca = jax.jit(lambda st, ch: make_chunk_step(
+        cfg, engine="scatter")(st, ch)[0])
+    lane = jax.jit(make_lane_delta(cfg, engine="scatter"))
+    rng = np.random.default_rng(3)
+    states, chunks = [], []
+    for i in range(4):
+        st = ReplayState(
+            agg=rng.lognormal(3, 2, (cfg.sw, N_FEATS)).astype(np.float32),
+            hist=rng.lognormal(
+                1, 1, (cfg.sw, cfg.n_hist_buckets)).astype(np.float32))
+        staged, _ = stage_columns(_rand_spans(100 + 30 * i, 6, seed=10 + i),
+                                  cfg, t0_us=0)
+        ch = {k: v[0] for k, v in staged.items()}
+        states.append(st)
+        chunks.append(ch)
+        a, b = mat(st, ch), sca(st, ch)
+        np.testing.assert_array_equal(np.asarray(a.agg), np.asarray(b.agg))
+        np.testing.assert_array_equal(np.asarray(a.hist),
+                                      np.asarray(b.hist))
+    dagg, dhist = lane({k: np.stack([c[k] for c in chunks])
+                        for k in chunks[0]})
+    dagg, dhist = np.asarray(dagg), np.asarray(dhist)
+    for i, (st, ch) in enumerate(zip(states, chunks)):
+        want = mat(st, ch)
+        np.testing.assert_array_equal(np.asarray(want.agg),
+                                      st.agg + dagg[i])
+        np.testing.assert_array_equal(np.asarray(want.hist),
+                                      st.hist + dhist[i])
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fused_scoring_bit_identical_to_sequential_with_coalescing(seed):
+    """THE fused parity pin: a fused engine run under overload — with
+    same-tenant micro-batches genuinely coalescing per tick — emits
+    per-tenant states AND alert streams bit-identical to a sequential
+    per-tenant StreamReplay/OnlineDetector fed the same per-tick
+    coalesced batches (CPU).  SLO parity is pinned separately against
+    the unfused engine (identical admission ⇒ identical latencies)."""
+    from anomod.schemas import concat_span_batches
+
+    def traffic():
+        return PowerLawTraffic(
+            n_tenants=6, total_rate_spans_per_s=1800, alpha=0.6, seed=seed,
+            n_services=4, batch_cap=64,
+            faults={0: TenantFault("latency", service=1, onset_s=30.0,
+                                   factor=12.0)})
+    cfg = ReplayConfig(n_services=4, n_windows=16, window_us=5_000_000,
+                       chunk_size=1024)
+    tr = traffic()
+    eng = ServeEngine(tr.specs, tr.services, cfg,
+                      capacity_spans_per_s=1200, tick_s=1.0,
+                      buckets=(128, 512), lane_buckets=(1, 2, 4, 8),
+                      max_backlog=2400, baseline_windows=4, fuse=True)
+    eng.runner.warm()
+    eng.runner.warm_lanes()
+    served_log = []
+    for k in range(50):
+        served_log.append(eng.tick(tr.arrivals(k * 1.0, (k + 1) * 1.0)))
+    for det in eng._tenant_det.values():
+        det.finish()
+    # the regrouping must actually be exercised: some tick coalesced >= 2
+    # micro-batches of one tenant, and some fused dispatch ran > 1 lane
+    assert any(
+        int(np.bincount([qb.tenant_id for qb in served]).max()) >= 2
+        for served in served_log if served)
+    assert any(b > 1 for b in eng.runner.lanes_by_bucket)
+    assert eng.report(traffic=tr).n_alerts > 0      # the fault alerted
+
+    for tid in sorted({qb.tenant_id for served in served_log
+                       for qb in served}):
+        solo = OnlineDetector(tr.services, cfg, 0,
+                              replay=StreamReplay(cfg, 0),
+                              baseline_windows=4)
+        for served in served_log:
+            mine = [qb.spans for qb in served if qb.tenant_id == tid]
+            if mine:
+                solo.push(mine[0] if len(mine) == 1
+                          else concat_span_batches(mine))
+        solo.finish()
+        assert [dataclasses.asdict(a) for a in eng.alerts_for(tid)] \
+            == [dataclasses.asdict(a) for a in solo.alerts]
+        rep = eng._tenant_replay[tid]
+        assert rep.window_offset == solo.replay.window_offset
+        assert rep.n_spans == solo.replay.n_spans
+        np.testing.assert_array_equal(np.asarray(rep.state.agg),
+                                      np.asarray(solo.replay.state.agg))
+        np.testing.assert_array_equal(np.asarray(rep.state.hist),
+                                      np.asarray(solo.replay.state.hist))
+
+
+def test_fused_and_unfused_slo_and_admission_identical():
+    """Fusion must not move a single admission/shed/SLO number: the
+    drained batches and their latency samples are identical, so the
+    report's counters and latency quantiles match exactly."""
+    def go(fuse):
+        _, rep = run_power_law(
+            n_tenants=8, n_services=4, capacity_spans_per_s=1000,
+            overload=2.0, duration_s=30, tick_s=1.0, seed=4,
+            window_s=5.0, baseline_windows=4, fault_tenants=0,
+            buckets=(128, 512), max_backlog=1500, fuse=fuse)
+        return rep
+    a, b = go(True), go(False)
+    assert a.fused and not b.fused
+    for f in ("offered_spans", "admitted_spans", "served_spans",
+              "shed_spans", "served_batches", "peak_backlog_spans",
+              "latency", "per_priority", "dispatches_by_width"):
+        assert getattr(a, f) == getattr(b, f), f
+
+
+def test_fused_compile_count_pin():
+    """Exactly ONE compile per (width, lane-bucket) shape over a long
+    fused run: the warm grid covers everything the tick loop can
+    dispatch, and nothing recompiles mid-serve (via the jit compile
+    counters the observability plane already keeps)."""
+    from anomod.obs.registry import Registry, set_registry
+    reg = Registry(enabled=True)
+    prev = set_registry(reg)
+    try:
+        eng, rep = run_power_law(
+            n_tenants=10, n_services=4, capacity_spans_per_s=1500,
+            overload=1.5, duration_s=60, tick_s=0.5, seed=6,
+            window_s=5.0, baseline_windows=4, fault_tenants=0,
+            buckets=(128, 512), lane_buckets=(1, 2, 4), fuse=True,
+            n_windows=16)
+        grid = {(w, l) for w in eng.runner.widths
+                for l in eng.runner.lane_buckets}
+        assert eng.runner.lane_shapes == grid
+        assert reg.counter(
+            "anomod_serve_fused_compile_total").value == len(grid)
+        assert rep.fused_dispatches > 0
+        # fused-path telemetry rides along: lanes histogram + pad gauges
+        assert reg.counter(
+            "anomod_serve_fused_dispatches_total").value \
+            == rep.fused_dispatches
+        assert reg.histogram("anomod_serve_fused_lanes").count \
+            == rep.fused_dispatches
+        assert 0.0 <= reg.gauge(
+            "anomod_serve_lane_pad_waste_fraction").value < 1.0
+    finally:
+        set_registry(prev)
+
+
+def test_fused_engine_smoke():
+    """Tier-1 fused smoke (<5s): a small fused run serves, sheds, fuses
+    dispatches and still detects the scripted fault."""
+    traffic = PowerLawTraffic(
+        n_tenants=6, total_rate_spans_per_s=1200, alpha=0.0, seed=3,
+        n_services=4, batch_cap=128,
+        faults={1: TenantFault("latency", service=1, onset_s=30.0,
+                               factor=12.0)})
+    cfg = ReplayConfig(n_services=4, n_windows=16, window_us=5_000_000,
+                       chunk_size=1024)
+    eng = ServeEngine(traffic.specs, traffic.services, cfg,
+                      capacity_spans_per_s=900, tick_s=1.0,
+                      buckets=(256,), lane_buckets=(1, 2, 4, 8),
+                      max_backlog=2000, baseline_windows=4, fuse=True)
+    rep = eng.run(traffic, duration_s=60.0)
+    assert rep.fused is True
+    assert rep.served_spans > 0 and rep.shed_spans > 0
+    assert rep.fused_dispatches > 0
+    assert rep.lanes_by_bucket and 0.0 <= rep.lane_pad_waste < 1.0
+    assert rep.fault_detection["n_detected"] == 1
+    d = rep.to_dict()
+    import json
+    json.dumps(d)
+    assert d["lane_buckets"] == [1, 2, 4, 8]
+    assert set(d["lanes_by_bucket"]) <= {"1", "2", "4", "8"}
+
+
+def test_credit_clamp_bounds_float_drift():
+    """The per-tick credit float is clamped to its physical envelope
+    (one tick's budget of carry either way, plus at most one batch's
+    overdraw), so accumulated sub-span rounding on a fractional tick
+    budget can never drift into phantom capacity or phantom debt."""
+    traffic = PowerLawTraffic(n_tenants=2, total_rate_spans_per_s=100,
+                              seed=0, n_services=4)
+    cfg = ReplayConfig(n_services=4, n_windows=16, window_us=5_000_000,
+                       chunk_size=512)
+    eng = ServeEngine(traffic.specs, traffic.services, cfg,
+                      capacity_spans_per_s=333.3, tick_s=0.3,
+                      buckets=(256,), score=False)
+    budget = 333.3 * 0.3
+    # phantom capacity: a corrupted/drifted positive credit is pulled
+    # back to at most one tick's budget
+    eng._credit = 1e9
+    eng.tick([])
+    assert eng._credit <= budget + 1e-9
+    # phantom debt: a drifted negative credit floors at one budget
+    eng._credit = -1e9
+    eng.tick([])
+    assert eng._credit >= -budget - 1e-9
+    # steady state with a non-representable tick budget stays bounded
+    # and dust-free forever
+    for k in range(300):
+        eng.tick(traffic.arrivals(k * 0.3, (k + 1) * 0.3))
+        assert -max(budget, 512) - 1e-9 <= eng._credit <= budget + 1e-9
+        assert eng._credit == 0.0 or abs(eng._credit) >= 1e-9
+
+
+def test_credit_clamp_does_not_forgive_multi_budget_overdraw():
+    """A batch wider than several tick budgets legitimately overdraws;
+    its debt is paid down across idle ticks and the clamp must NOT
+    forgive it mid-repayment (the floor remembers the widest served
+    batch, review finding)."""
+    specs = [TenantSpec(0, "t", priority=1)]
+    cfg = ReplayConfig(n_services=1, n_windows=8, window_us=5_000_000,
+                       chunk_size=512)
+    eng = ServeEngine(specs, ("s",), cfg, capacity_spans_per_s=100.0,
+                      tick_s=1.0, buckets=(512,), score=False,
+                      max_backlog=1000, max_tenant_backlog=1000)
+    served = eng.tick([(0, _spans(350))])      # overdraw: 100 - 350
+    assert [qb.n_spans for qb in served] == [350]
+    assert eng._credit == pytest.approx(-250.0)
+    eng.tick([])                               # repaying: -250 + 100
+    assert eng._credit == pytest.approx(-150.0)   # NOT clamped to -100
+    eng.tick([])
+    assert eng._credit == pytest.approx(-50.0)
+    eng.tick([])                               # debt paid; positive again
+    assert eng._credit == pytest.approx(50.0)
+
+
+def test_lane_env_knobs_registered_and_validated(monkeypatch):
+    from anomod.config import Config
+    monkeypatch.setenv("ANOMOD_SERVE_LANE_BUCKETS", "1, 4,16")
+    monkeypatch.setenv("ANOMOD_SERVE_FUSE", "0")
+    cfg = Config()
+    assert cfg.serve_lane_buckets == (1, 4, 16)
+    assert cfg.serve_fuse is False
+    monkeypatch.setenv("ANOMOD_SERVE_FUSE", "1")
+    assert Config().serve_fuse is True
+
+    monkeypatch.setenv("ANOMOD_SERVE_LANE_BUCKETS", "16,4")
+    with pytest.raises(ValueError, match="ANOMOD_SERVE_LANE_BUCKETS"):
+        Config()
+    monkeypatch.setenv("ANOMOD_SERVE_LANE_BUCKETS", "0,4")
+    with pytest.raises(ValueError, match="ANOMOD_SERVE_LANE_BUCKETS"):
+        Config()
+    monkeypatch.setenv("ANOMOD_SERVE_LANE_BUCKETS", "x")
+    with pytest.raises(ValueError, match="ANOMOD_SERVE_LANE_BUCKETS"):
+        Config()
+    monkeypatch.delenv("ANOMOD_SERVE_LANE_BUCKETS")
+    from anomod.config import DEFAULT_SERVE_LANE_BUCKETS
+    assert Config().serve_lane_buckets == DEFAULT_SERVE_LANE_BUCKETS
+    # the env-contract gate sees both knobs as Config-covered
+    import sys as _sys
+    from pathlib import Path as _Path
+    _sys.path.insert(0, str(_Path(__file__).parent.parent / "scripts"))
+    try:
+        import check_env_contract as cec
+        refs = cec.referenced_vars(_Path(cec.ROOT))
+        corpus = cec.covered_vars(_Path(cec.ROOT))
+        for knob in ("ANOMOD_SERVE_LANE_BUCKETS", "ANOMOD_SERVE_FUSE"):
+            assert knob in refs and knob in corpus
+    finally:
+        _sys.path.pop(0)
 
 
 def test_serve_cli_emits_report(capsys):
