@@ -345,6 +345,16 @@ def main(argv=None) -> int:
                          help="detector window width on the virtual clock")
     p_serve.add_argument("--baseline-windows", type=int, default=4)
     p_serve.add_argument("--threshold", type=float, default=4.0)
+    p_serve.add_argument("--shards", type=int, default=None,
+                         help="tenant-sharded engine workers (default: "
+                              "ANOMOD_SERVE_SHARDS, 1 = the single-"
+                              "threaded engine; N-shard output is "
+                              "identical to 1-shard on the same seed)")
+    p_serve.add_argument("--pipeline", type=int, default=None,
+                         help="in-flight fused dispatches per shard "
+                              "(default: ANOMOD_SERVE_PIPELINE; 1 = "
+                              "synchronous, >1 = async double-buffered "
+                              "staging — bit-identical at any depth)")
     p_serve.add_argument("--no-fuse", action="store_true",
                          help="disable tenant-fused (lane-stacked) "
                               "dispatch: one dispatch per tenant "
@@ -716,6 +726,10 @@ def main(argv=None) -> int:
             parser.error("--overload must be positive")
         if args.fault_tenants < 0:
             parser.error("--fault-tenants must be >= 0")
+        if args.shards is not None and args.shards < 1:
+            parser.error("--shards must be >= 1")
+        if args.pipeline is not None and args.pipeline < 1:
+            parser.error("--pipeline must be >= 1")
         _probe_backend(args)
         from anomod.serve.batcher import validate_buckets
         from anomod.serve.engine import run_power_law
@@ -755,7 +769,8 @@ def main(argv=None) -> int:
             fault_tenants=args.fault_tenants, score=not args.no_score,
             mesh=mesh, tracer=tracer,
             fuse=False if args.no_fuse else None,
-            lane_buckets=lane_buckets)
+            lane_buckets=lane_buckets, shards=args.shards,
+            pipeline=args.pipeline)
         if tracer is not None:
             from pathlib import Path as _P
             tracer.dump(_P(args.trace_out))

@@ -166,6 +166,69 @@ def _serve_fuse_env() -> bool:
         not in ("0", "false", "off", "no")
 
 
+def _serve_shards_env() -> int:
+    """ANOMOD_SERVE_SHARDS: serving-plane engine-worker shard count.
+
+    ``1`` (the default) is the single-threaded engine, output
+    bit-identical to the pre-sharding serving plane (its DISPATCH may
+    still pipeline per ``ANOMOD_SERVE_PIPELINE``; set that to 1 for the
+    exact synchronous code path).  ``N > 1`` partitions tenants across
+    N worker threads (anomod.serve.shard), each owning its tenants'
+    scoring plane end to end; admission/shedding stay on the
+    coordinator, so every decision is identical to the 1-shard engine on
+    the same seed.  Validated here so a typo fails loudly at config
+    construction instead of silently serving unsharded.
+    """
+    raw = _env("ANOMOD_SERVE_SHARDS", "1")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_SHARDS must be a positive integer, got {raw!r}")
+    if not 1 <= n <= 256:
+        raise ValueError(
+            f"ANOMOD_SERVE_SHARDS must be in [1, 256], got {n}")
+    return n
+
+
+def _serve_pipeline_env() -> int:
+    """ANOMOD_SERVE_PIPELINE: in-flight fused dispatches per runner
+    (the inline 1-shard engine and every shard worker alike).
+
+    Depth ``1`` is synchronous (each lane-stacked dispatch materializes
+    before the next stages); depth ``d > 1`` double-buffers — a shard
+    stages and dispatches batch t+1 while batch t's XLA dispatch is
+    still in flight, deferring readback/fold by up to ``d-1`` dispatches
+    (drained at tick end).  Per-slot pinned scratch keeps reuse safe:
+    a slot refills only after its dispatch's outputs materialized.
+    Bit-identical at any depth (folds apply in dispatch order).
+    """
+    raw = _env("ANOMOD_SERVE_PIPELINE", "2")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_PIPELINE must be a positive integer, got {raw!r}")
+    if not 1 <= n <= 64:
+        raise ValueError(
+            f"ANOMOD_SERVE_PIPELINE must be in [1, 64], got {n}")
+    return n
+
+
+def _jit_cache_env() -> bool:
+    """ANOMOD_JIT_CACHE: persistent XLA compilation cache switch.
+
+    When on AND ``ANOMOD_CACHE_DIR`` caching is enabled, the serve/bench
+    entry points point jax's persistent compilation cache at
+    ``<cache_dir>/jit`` (anomod.utils.platform.enable_jit_cache), so a
+    warm restart skips the (width x lane-bucket) compile wall — and the
+    2nd..Nth shard's identical-HLO grids compile once, not N times.
+    Default OFF: mutating global jax config is an operator opt-in.
+    """
+    return _env("ANOMOD_JIT_CACHE", "0").strip().lower() \
+        not in ("0", "false", "off", "no", "")
+
+
 def _serve_max_backlog_env() -> int:
     """ANOMOD_SERVE_MAX_BACKLOG: global admission backlog bound (spans) —
     the serving plane's backpressure/shed budget."""
@@ -249,6 +312,18 @@ class Config:
     # ANOMOD_SERVE_FUSE — serving-plane fused-dispatch switch
     # (anomod.serve.engine; off = one dispatch per tenant micro-batch).
     serve_fuse: bool = dataclasses.field(default_factory=_serve_fuse_env)
+    # ANOMOD_SERVE_SHARDS — serving-plane engine-worker shard count
+    # (anomod.serve.shard; 1 = the single-threaded engine, bit-identical
+    # to the pre-sharding plane).
+    serve_shards: int = dataclasses.field(default_factory=_serve_shards_env)
+    # ANOMOD_SERVE_PIPELINE — in-flight fused dispatches per shard worker
+    # (anomod.serve.batcher; 1 = synchronous, d > 1 = double-buffered
+    # staging under in-flight XLA dispatches, per-slot pinned scratch).
+    serve_pipeline: int = dataclasses.field(
+        default_factory=_serve_pipeline_env)
+    # ANOMOD_JIT_CACHE — persistent XLA compilation cache under
+    # ANOMOD_CACHE_DIR/jit (anomod.utils.platform.enable_jit_cache).
+    jit_cache: bool = dataclasses.field(default_factory=_jit_cache_env)
     # ANOMOD_SERVE_MAX_BACKLOG — global admission backlog bound in spans
     # (anomod.serve.queues; the backpressure/shed budget).
     serve_max_backlog: int = dataclasses.field(

@@ -233,36 +233,60 @@ class Histogram:
                 return None
             return float(tdigest_quantile(self._digest, q))
 
-    def _quantiles_cached(self) -> Optional[Tuple[float, float]]:
+    def _quantiles_cached_locked(self) -> Optional[Tuple[float, float]]:
         """(p50, p99) from the digest alone, recomputed only when the
         digest changed.  The scrape path's cheap read: pending buffer
         samples fold in early only once enough of them pile up (64), so
         scrape-time quantiles may lag the newest few observations — the
-        price of a per-tick scrape that costs microseconds."""
+        price of a per-tick scrape that costs microseconds.  Caller
+        holds ``self._lock``."""
+        if self._digest is None or len(self._buf) >= 64:
+            self._fold_locked()
+        if self._digest is None:
+            return None
+        cached = self._q_cache
+        if cached is not None and cached[0] == self._n_folds:
+            return cached[1], cached[2]
+        if float(self._digest.weight.sum()) <= 0:
+            return None
+        p50 = float(tdigest_quantile(self._digest, 0.5))
+        p99 = float(tdigest_quantile(self._digest, 0.99))
+        self._q_cache = (self._n_folds, p50, p99)
+        return p50, p99
+
+    def drain_digest(self) -> Optional[TDigest]:
+        """Fold pending samples, hand the digest out, and RESET this
+        histogram — the move-semantics half of :meth:`merge_digest`, so
+        a worker registry's histogram can fold into the process
+        registry repeatedly without double counting (Registry.fold_from
+        at ``final=True``).  Returns None when nothing was observed."""
         with self._lock:
-            if self._digest is None or len(self._buf) >= 64:
-                self._fold_locked()
-            if self._digest is None:
-                return None
-            cached = self._q_cache
-            if cached is not None and cached[0] == self._n_folds:
-                return cached[1], cached[2]
-            if float(self._digest.weight.sum()) <= 0:
-                return None
-            p50 = float(tdigest_quantile(self._digest, 0.5))
-            p99 = float(tdigest_quantile(self._digest, 0.99))
-            self._q_cache = (self._n_folds, p50, p99)
-            return p50, p99
+            self._fold_locked()
+            digest, self._digest = self._digest, None
+            self.count = 0
+            self.sum = 0.0
+            self._max = 0.0
+            self._n_folds += 1
+            self._q_cache = None
+            return digest
 
     def samples(self) -> List[Tuple[str, float]]:
-        out = [(f"{self.name}_count", float(self.count)),
-               (f"{self.name}_sum", self.sum)]
-        qs = self._quantiles_cached()
-        if qs is not None:
-            out.append((f"{self.name}_p50", qs[0]))
-            out.append((f"{self.name}_p99", qs[1]))
-            out.append((f"{self.name}_max", self._max))
-        return out
+        # ONE locked snapshot: count, sum, max and the quantiles must
+        # come from the same instant.  Reading count/sum outside the
+        # lock (the pre-shard behavior) let a scrape race a concurrent
+        # observe() between the two attribute reads and journal a count
+        # that disagrees with its sum — harmless for one process-wide
+        # tick loop, visibly torn once shard worker threads record while
+        # the coordinator scrapes (tests/test_obs.py hammer-pins this).
+        with self._lock:
+            out = [(f"{self.name}_count", float(self.count)),
+                   (f"{self.name}_sum", self.sum)]
+            qs = self._quantiles_cached_locked()
+            if qs is not None:
+                out.append((f"{self.name}_p50", qs[0]))
+                out.append((f"{self.name}_p99", qs[1]))
+                out.append((f"{self.name}_max", self._max))
+            return out
 
 
 #: one journal row: (t_s, sample_name, series_labels_rendered, value)
@@ -373,6 +397,52 @@ class Registry:
         with self._lock:
             self._metrics.clear()
             self._journal.clear()
+
+    # -- worker-registry fold (the sharded serving plane's seam) -----------
+
+    def fold_from(self, src: "Registry", state: Dict[Tuple[str, str],
+                                                     float],
+                  shard: Optional[str] = None, final: bool = False) -> None:
+        """Fold a worker-thread registry into this one.
+
+        Each serve shard records its runner's hot-path metrics into its
+        OWN registry (zero cross-thread contention per dispatch); the
+        coordinator folds the shards in at the tick barrier:
+
+        - **Counters** increment by the delta since the previous fold
+          (``state`` carries the per-metric high-water marks), so the
+          process-registry counter stays the summable fleet total.
+        - **Gauges** set a ``shard``-labeled twin (a gauge is a
+          per-shard fact — pad-waste on shard 2 is not a fleet sum).
+        - **Histograms** DRAIN at ``final=True`` (run end): the source
+          digest folds through :meth:`Histogram.merge_digest` — exactly
+          how the per-tenant SLO digests already join the registry —
+          and is then cleared on the source, so repeated final folds
+          (an engine run() twice) neither double-count nor drop data.
+
+        No-op when either side is disabled.  The caller owns the
+        quiescence contract: fold at a barrier, with the worker that
+        records into ``src`` idle.
+        """
+        if not (self.enabled and src.enabled):
+            return
+        for m in src.metrics():
+            key = (m.name, render_labels(m.labels))
+            if m.kind == "counter":
+                prev = state.get(key, 0.0)
+                cur = m.value
+                if cur > prev:
+                    self.counter(m.name, **m.labels).inc(cur - prev)
+                    state[key] = cur
+            elif m.kind == "gauge":
+                labels = dict(m.labels)
+                if shard is not None:
+                    labels["shard"] = shard
+                self.gauge(m.name, **labels).set(m.value)
+            elif m.kind == "histogram" and final:
+                digest = m.drain_digest()
+                if digest is not None:
+                    self.histogram(m.name, **m.labels).merge_digest(digest)
 
 
 _DEFAULT: Optional[Registry] = None

@@ -9,18 +9,23 @@ shared chunk step compiles once per bucket — and, fused
 (ANOMOD_SERVE_FUSE), lane-stacks same-width chunks across tenants into
 one dispatch per (width, lane-bucket) shape, pinned bit-identical to
 sequential scoring (batcher) — a deterministic virtual-clock serving
-engine with per-tenant SLO accounting (engine), and a seeded power-law
-traffic generator standing in for the tenant fleet (traffic).
+engine with per-tenant SLO accounting (engine), a seeded power-law
+traffic generator standing in for the tenant fleet (traffic), and —
+scale-out (ANOMOD_SERVE_SHARDS) — deterministic tenant sharding across
+engine worker threads with pipelined async dispatch (shard), pinned
+identical to the 1-shard engine on the same seed.
 """
 
 from anomod.serve.batcher import (BucketedStreamReplay, BucketRunner,
                                   split_plan)
 from anomod.serve.engine import ServeEngine, ServeReport, VirtualClock
 from anomod.serve.queues import AdmissionController, QueuedBatch, TenantSpec
+from anomod.serve.shard import ShardWorker, plan_shards, rendezvous_shard
 from anomod.serve.traffic import PowerLawTraffic, ScriptedTraffic
 
 __all__ = [
     "AdmissionController", "BucketRunner", "BucketedStreamReplay",
     "PowerLawTraffic", "QueuedBatch", "ScriptedTraffic", "ServeEngine",
-    "ServeReport", "TenantSpec", "VirtualClock", "split_plan",
+    "ServeReport", "ShardWorker", "TenantSpec", "VirtualClock",
+    "plan_shards", "rendezvous_shard", "split_plan",
 ]

@@ -51,6 +51,7 @@ step per (width, lane-bucket) shape instead of compiling per tenant.
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -104,14 +105,28 @@ class BucketRunner:
     def __init__(self, cfg: ReplayConfig,
                  buckets: Optional[Tuple[int, ...]] = None,
                  lane_buckets: Optional[Tuple[int, ...]] = None,
-                 engine: Optional[str] = None):
+                 engine: Optional[str] = None, registry=None,
+                 pipeline: int = 1):
         import jax
         from anomod.config import get_config
         if buckets is None:
             buckets = get_config().serve_buckets
         if lane_buckets is None:
             lane_buckets = get_config().serve_lane_buckets
+        if pipeline < 1:
+            raise ValueError("pipeline depth must be >= 1")
         self.cfg = cfg
+        #: metric sink: the sharded engine hands each shard's runner its
+        #: OWN registry (thread-isolated hot path; merged into the
+        #: process registry at the tick barrier) — default is the
+        #: process registry, exactly as before
+        self._reg = registry if registry is not None else obs.get_registry()
+        #: max in-flight fused dispatches is ``pipeline - 1`` (depth 1 =
+        #: fully synchronous, the pre-pipelining behavior); the submit/
+        #: drain path keeps ``pipeline`` pinned scratch slots per
+        #: (width, lane-bucket) shape so staging slot s+1 never touches
+        #: buffers an in-flight dispatch still reads
+        self.pipeline = int(pipeline)
         self.buckets = validate_buckets(buckets)
         self.lane_buckets = validate_lane_buckets(lane_buckets)
         #: chunk-step engine: scatter on XLA:CPU (bit-identical, ~10x),
@@ -121,6 +136,13 @@ class BucketRunner:
         step = make_chunk_step(cfg, with_hll=False, engine=self.engine)
         self._step = jax.jit(lambda st, ch: step(st, ch)[0])
         self._lane_fn = jax.jit(make_lane_delta(cfg, engine=self.engine))
+        #: AOT-compiled lane executables, one per (width, lane-bucket)
+        #: shape: calling the compiled object skips the pjit python
+        #: dispatch path (~5-10 ms per call on this class of host for
+        #: the 7-column chunk dict — a third of the whole dispatch wall)
+        #: and is bit-identical to calling the jit (same HLO, same
+        #: executable)
+        self._lane_exec: Dict[Tuple[int, int], object] = {}
         self.compile_s_by_width: Dict[int, float] = {}
         #: one compile wall per fused (width, lane-bucket) shape — the
         #: compile-count pin asserts this never grows past the warm grid
@@ -133,15 +155,24 @@ class BucketRunner:
         self.lanes_by_bucket: Dict[int, int] = {}
         self.staged_lanes = 0
         self.live_lanes = 0
-        # pinned host scratch, reused across ticks: one [lanes, width]
-        # buffer set per fused shape, so steady-state staging stops
-        # reallocating (and re-faulting) megabytes per tick — staged
-        # columns arrive UNPADDED (stage_columns_raw) and pad here.
-        # Reuse is safe ONLY because run_lanes materializes its outputs
-        # before every refill; the single-lane dispatch pads into fresh
-        # buffers instead (see dispatch()).
-        self._lane_scratch: Dict[Tuple[int, int],
+        # pinned host scratch, reused across ticks: ``pipeline``
+        # [lanes, width] buffer sets (SLOTS) per fused shape, so
+        # steady-state staging stops reallocating (and re-faulting)
+        # megabytes per tick — staged columns arrive UNPADDED
+        # (stage_columns_raw) and pad here.  Reuse is safe ONLY because
+        # a slot refills strictly after the dispatch that last read it
+        # materialized its outputs (run_lanes materializes immediately;
+        # the pipelined submit/drain path retires a slot's dispatch
+        # before cycling back to it); the single-lane dispatch pads into
+        # fresh buffers instead (see dispatch()).
+        self._lane_scratch: Dict[Tuple[int, int, int],
                                  Dict[str, np.ndarray]] = {}
+        self._slot_next: Dict[Tuple[int, int], int] = {}
+        #: FIFO of in-flight fused dispatches: (replays, dagg, dhist,
+        #: slot key).  Retiring materializes the deltas (the execute
+        #: barrier) and folds them through the get_state/set_state seam
+        #: in dispatch order — so any pipeline depth is bit-identical.
+        self._inflight: "collections.deque" = collections.deque()
         self._dead_cols: Dict[int, dict] = {}
         # registry mirrors (anomod.obs): staged-vs-live row counters make
         # the bucket-pad waste fraction derivable from any scrape
@@ -149,18 +180,19 @@ class BucketRunner:
         # fused dispatch are the serving hot path.  The lane twins
         # (staged/live LANES + lanes-per-dispatch histogram) price the
         # fused path's dead-lane padding the same way.
-        self._obs_dispatches = obs.counter("anomod_serve_dispatches_total")
-        self._obs_staged = obs.counter("anomod_serve_staged_rows_total")
-        self._obs_live = obs.counter("anomod_serve_live_rows_total")
-        self._obs_waste = obs.gauge("anomod_serve_pad_waste_fraction")
-        self._obs_fused = obs.counter(
+        reg = self._reg
+        self._obs_dispatches = reg.counter("anomod_serve_dispatches_total")
+        self._obs_staged = reg.counter("anomod_serve_staged_rows_total")
+        self._obs_live = reg.counter("anomod_serve_live_rows_total")
+        self._obs_waste = reg.gauge("anomod_serve_pad_waste_fraction")
+        self._obs_fused = reg.counter(
             "anomod_serve_fused_dispatches_total")
-        self._obs_lanes = obs.histogram("anomod_serve_fused_lanes")
-        self._obs_staged_lanes = obs.counter(
+        self._obs_lanes = reg.histogram("anomod_serve_fused_lanes")
+        self._obs_staged_lanes = reg.counter(
             "anomod_serve_staged_lanes_total")
-        self._obs_live_lanes = obs.counter(
+        self._obs_live_lanes = reg.counter(
             "anomod_serve_live_lanes_total")
-        self._obs_lane_waste = obs.gauge(
+        self._obs_lane_waste = reg.gauge(
             "anomod_serve_lane_pad_waste_fraction")
 
     @property
@@ -199,8 +231,8 @@ class BucketRunner:
             np.asarray(state.agg)               # compile + execute barrier
             self.compile_s_by_width[width] = time.perf_counter() - t0
             total += self.compile_s_by_width[width]
-            obs.counter("anomod_serve_compile_total").inc()
-            obs.counter("anomod_serve_compile_seconds_total").inc(
+            self._reg.counter("anomod_serve_compile_total").inc()
+            self._reg.counter("anomod_serve_compile_seconds_total").inc(
                 self.compile_s_by_width[width])
         return total
 
@@ -218,18 +250,32 @@ class BucketRunner:
                     continue
                 stacked = {k: np.broadcast_to(
                     v, (lanes, width)) for k, v in dead.items()}
-                t0 = time.perf_counter()
-                dagg, _ = self._lane_fn(stacked)
-                np.asarray(dagg)                # compile + execute barrier
-                self._record_lane_compile(key, time.perf_counter() - t0)
+                exe = self._lane_exec_for(key, stacked)
+                dagg, _ = exe(stacked)
+                np.asarray(dagg)                # execute barrier
                 total += self._lane_compile_s[key]
         return total
+
+    def _lane_exec_for(self, key: Tuple[int, int], args: dict):
+        """The AOT lane executable for one (width, lane-bucket) shape,
+        lowered+compiled on first need (``args`` supplies the concrete
+        shapes) — exactly one compile per shape per runner, recorded in
+        ``_lane_compile_s`` / the registry compile counters like every
+        other compile in this file."""
+        exe = self._lane_exec.get(key)
+        if exe is None:
+            t0 = time.perf_counter()
+            exe = self._lane_fn.lower(args).compile()
+            self._lane_exec[key] = exe
+            self._record_lane_compile(key, time.perf_counter() - t0)
+        return exe
 
     def _record_lane_compile(self, key: Tuple[int, int],
                              wall_s: float) -> None:
         self._lane_compile_s[key] = wall_s
-        obs.counter("anomod_serve_fused_compile_total").inc()
-        obs.counter("anomod_serve_fused_compile_seconds_total").inc(wall_s)
+        self._reg.counter("anomod_serve_fused_compile_total").inc()
+        self._reg.counter(
+            "anomod_serve_fused_compile_seconds_total").inc(wall_s)
 
     @property
     def compile_s(self) -> float:
@@ -330,11 +376,58 @@ class BucketRunner:
             out.append((n, next(b for b in self.lane_buckets if b >= n)))
         return out
 
+    def _fill_slot(self, width: int, lanes: int,
+                   group_cols: List[dict]) -> Tuple[dict, Tuple[int, int,
+                                                                int]]:
+        """Stage ``group_cols`` (one unpadded chunk per live lane) into
+        the next free pinned scratch slot for the (width, lanes) shape,
+        dead-padding the row tails and any dead lanes.  Cycles through
+        ``self.pipeline`` slots per shape; before reusing a slot, any
+        in-flight dispatch still reading it is retired (materialized) —
+        the PR-4 aliasing hazard (mutating host arrays under an async
+        dispatch) is structurally impossible here."""
+        shape = (width, lanes)
+        slot = self._slot_next.get(shape, 0)
+        self._slot_next[shape] = (slot + 1) % self.pipeline
+        key = (width, lanes, slot)
+        while any(e[3] == key for e in self._inflight):
+            self._retire_one()
+        scratch = self._lane_scratch.get(key)
+        if scratch is None:
+            scratch = {k: np.empty((lanes, width), v.dtype)
+                       for k, v in self._dead_cols_for(width).items()}
+            self._lane_scratch[key] = scratch
+        n_live = len(group_cols)
+        for k, buf in scratch.items():
+            fill = self._pad_fill(k)
+            for i, cols in enumerate(group_cols):
+                c = cols[k]
+                m = c.shape[0]
+                buf[i, :m] = c
+                if m < width:
+                    buf[i, m:] = fill
+            if n_live < lanes:
+                buf[n_live:] = fill
+        return scratch, key
+
+    def _account_group(self, n_live: int, lanes: int) -> None:
+        self.fused_dispatches += 1
+        self.lanes_by_bucket[lanes] = \
+            self.lanes_by_bucket.get(lanes, 0) + 1
+        self.staged_lanes += lanes
+        self.live_lanes += n_live
+        self._obs_fused.inc()
+        self._obs_lanes.observe(n_live)
+        self._obs_staged_lanes.inc(lanes)
+        self._obs_live_lanes.inc(n_live)
+        self._obs_lane_waste.set(1.0 - self.live_lanes / self.staged_lanes)
+
     def run_lanes(self, width: int,
                   work: List[Tuple[ReplayState, dict]]) -> List[ReplayState]:
         """Fold ``work[i]``'s staged chunk into ``work[i]``'s state via
         lane-bucketed fused dispatches; returns the updated states in
-        order.
+        order (synchronous: each dispatch materializes before the next
+        stages — the pipelined twin is :meth:`submit_lanes`).
 
         Per-lane results are BIT-identical to :meth:`dispatch` per lane:
         each lane reduces its own rows in the same order, dead pad lanes
@@ -343,53 +436,92 @@ class BucketRunner:
         with the same elementwise f32 add the in-step update performs.
         Staging rides pinned scratch buffers reused across ticks.
         """
+        self.drain_lanes()      # never interleave with pipelined folds
         out: List[ReplayState] = []
         pos = 0
         for n_live, lanes in self.lane_plan(len(work)):
             group = work[pos:pos + n_live]
             pos += n_live
-            key = (width, lanes)
-            scratch = self._lane_scratch.get(key)
-            if scratch is None:
-                scratch = {k: np.empty((lanes, width), v.dtype)
-                           for k, v in self._dead_cols_for(width).items()}
-                self._lane_scratch[key] = scratch
-            for k, buf in scratch.items():
-                fill = self._pad_fill(k)
-                for i, (_, cols) in enumerate(group):
-                    c = cols[k]
-                    m = c.shape[0]
-                    buf[i, :m] = c
-                    if m < width:
-                        buf[i, m:] = fill
-                if n_live < lanes:
-                    buf[n_live:] = fill
-            first = key not in self._lane_compile_s
-            t0 = time.perf_counter() if first else 0.0
-            dagg, dhist = self._lane_fn(scratch)
+            scratch, _ = self._fill_slot(width, lanes,
+                                         [cols for _, cols in group])
+            exe = self._lane_exec_for((width, lanes), scratch)
+            dagg, dhist = exe(scratch)
             # materialize before the scratch is reused: the host copy is
             # the execute barrier, and the scatter-back below reads it
             dagg = np.asarray(dagg)
             dhist = np.asarray(dhist)
-            if first:
-                self._record_lane_compile(key, time.perf_counter() - t0)
             for i, (st, _) in enumerate(group):
                 out.append(ReplayState(
                     agg=np.asarray(st.agg) + dagg[i],
                     hist=np.asarray(st.hist) + dhist[i]))
-            self.fused_dispatches += 1
-            self.lanes_by_bucket[lanes] = \
-                self.lanes_by_bucket.get(lanes, 0) + 1
-            self.staged_lanes += lanes
-            self.live_lanes += n_live
-            self._obs_fused.inc()
-            self._obs_lanes.observe(n_live)
-            self._obs_staged_lanes.inc(lanes)
-            self._obs_live_lanes.inc(n_live)
-        if self.staged_lanes:
-            self._obs_lane_waste.set(
-                1.0 - self.live_lanes / self.staged_lanes)
+            self._account_group(n_live, lanes)
         return out
+
+    # -- the pipelined (async double-buffered) path -----------------------
+
+    def submit_lanes(self, width: int, work: List[Tuple[object, dict]],
+                     ) -> None:
+        """Pipelined twin of :meth:`run_lanes`: ``work`` pairs each
+        REPLAY PLANE (anything with the ``get_state``/``set_state`` seam)
+        with its staged unpadded chunk.  Dispatches are issued
+        immediately; readback + state fold are DEFERRED until the
+        dispatch retires — at most ``pipeline - 1`` dispatches stay in
+        flight, so with depth d the shard stages dispatch t+1 while
+        dispatch t's XLA work is still running.  Folds always apply in
+        dispatch order through ``set_state`` (bit-identical to the
+        synchronous path at any depth); callers MUST :meth:`drain_lanes`
+        before reading the planes (the sharded engine drains at tick
+        end, before window scoring).
+        """
+        pos = 0
+        for n_live, lanes in self.lane_plan(len(work)):
+            group = work[pos:pos + n_live]
+            pos += n_live
+            scratch, key = self._fill_slot(width, lanes,
+                                           [cols for _, cols in group])
+            exe = self._lane_exec_for((width, lanes), scratch)
+            dagg, dhist = exe(scratch)
+            self._inflight.append(
+                ([replay for replay, _ in group], dagg, dhist, key))
+            self._account_group(n_live, lanes)
+            while len(self._inflight) > self.pipeline - 1:
+                self._retire_one()
+
+    def _retire_one(self) -> None:
+        """Materialize the OLDEST in-flight dispatch (the host copy is
+        the execute barrier — after it, the dispatch can no longer read
+        its scratch slot) and fold its per-lane deltas into the paired
+        replay planes through the get_state/set_state seam, with the
+        same elementwise f32 add the in-step update performs."""
+        replays, dagg, dhist, _ = self._inflight.popleft()
+        dagg = np.asarray(dagg)
+        dhist = np.asarray(dhist)
+        for i, replay in enumerate(replays):
+            st = replay.get_state()
+            replay.set_state(ReplayState(
+                agg=np.asarray(st.agg) + dagg[i],
+                hist=np.asarray(st.hist) + dhist[i]))
+
+    def drain_lanes(self) -> None:
+        """Retire every in-flight dispatch (tick-end barrier)."""
+        while self._inflight:
+            self._retire_one()
+
+    def abort_lanes(self) -> None:
+        """Failed-tick cleanup: discard every in-flight dispatch WITHOUT
+        folding.  Outputs are still materialized — the execute barrier;
+        a scratch slot must never be refilled under a dispatch that can
+        still read it — but the deltas are dropped, so the paired replay
+        planes keep their last-folded states instead of silently
+        absorbing an aborted tick's work on some later drain."""
+        while self._inflight:
+            _, dagg, dhist, _ = self._inflight.popleft()
+            np.asarray(dagg)
+            np.asarray(dhist)
+
+    @property
+    def inflight_dispatches(self) -> int:
+        return len(self._inflight)
 
     @property
     def lane_pad_waste(self) -> float:

@@ -18,6 +18,14 @@ reused wholesale).  Admission→scored latency per micro-batch folds into
 per-tenant t-digests (anomod.ops.tdigest — the repo's one sketch path),
 so the ServeReport's p50/p99 are sketch-backed, mergeable across tenants
 and priorities.
+
+Scale-out (``ANOMOD_SERVE_SHARDS``): the score plane fans out across
+tenant-sharded worker threads (anomod.serve.shard) and joins at a
+barrier each tick, while admission/drain/shed/SLO bookkeeping stays on
+the coordinator — so an N-shard run's states, alerts and decisions are
+IDENTICAL to the 1-shard engine on the same seed.  Within a shard the
+fused dispatch pipelines (``ANOMOD_SERVE_PIPELINE``): staging of batch
+t+1 overlaps batch t's in-flight XLA dispatch, bit-identically.
 """
 
 from __future__ import annotations
@@ -116,6 +124,20 @@ def _merged_quantiles(slos: Sequence[_TenantSLO],
             round(float(tdigest_quantile(merged, q)), 6) for q in qs}
 
 
+#: ServeReport fields that legitimately differ across shard counts /
+#: pipeline depths on the same seed: wall-clock measurements and lane
+#: GROUPING topology (which lanes share a fused stack depends on shard
+#: membership; the resulting per-lane bits do not).  The ONE definition
+#: of the shard-determinism contract's exclusion list — shared by the
+#: parity tests (tests/test_serve.py) and the pre-bench fan-out smoke
+#: (scripts/pre_bench_check.py), so the two pins cannot drift apart.
+SHARD_VARIANT_REPORT_FIELDS = (
+    "serve_wall_s", "sustained_spans_per_sec", "compile_s",
+    "lane_compile_s", "fused_dispatches", "lanes_by_bucket",
+    "lane_pad_waste", "shards", "pipeline", "shard_tenants",
+    "shard_spans", "shard_imbalance")
+
+
 @dataclasses.dataclass
 class ServeReport:
     """The serving run's quality/throughput document (JSON-able)."""
@@ -140,6 +162,11 @@ class ServeReport:
     lane_pad_waste: float                        # dead-lane fraction
     compile_s: float
     lane_compile_s: float
+    shards: int                                  # engine-worker shard count
+    pipeline: int                                # in-flight dispatch depth
+    shard_tenants: Dict[int, int]                # tenants owned per shard
+    shard_spans: Dict[int, int]                  # spans scored per shard
+    shard_imbalance: float                       # max shard load / mean
     latency: Dict[str, Optional[float]]          # aggregate p50/p99
     per_priority: Dict[int, dict]
     modality_events: Dict[str, int]              # multimodal sidecar volume
@@ -159,6 +186,10 @@ class ServeReport:
                                 in self.lanes_by_bucket.items()}
         d["per_priority"] = {str(k): v for k, v
                              in self.per_priority.items()}
+        d["shard_tenants"] = {str(k): v for k, v
+                              in self.shard_tenants.items()}
+        d["shard_spans"] = {str(k): v for k, v
+                            in self.shard_spans.items()}
         return d
 
 
@@ -185,7 +216,9 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   fault_tenants: int = 2, score: bool = True,
                   mesh=None, tracer=None, n_windows: int = 32,
                   fuse: Optional[bool] = None,
-                  lane_buckets: Optional[Tuple[int, ...]] = None
+                  lane_buckets: Optional[Tuple[int, ...]] = None,
+                  shards: Optional[int] = None,
+                  pipeline: Optional[int] = None
                   ) -> Tuple["ServeEngine", ServeReport]:
     """The canonical seeded serve run shared by ``anomod serve`` and
     ``bench.py --mode serve``: a power-law tenant fleet offering
@@ -212,7 +245,8 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          baseline_windows=baseline_windows,
                          z_threshold=z_threshold, mesh=mesh,
                          tracer=tracer, fuse=fuse,
-                         lane_buckets=lane_buckets)
+                         lane_buckets=lane_buckets, shards=shards,
+                         pipeline=pipeline)
     report = engine.run(traffic, duration_s=duration_s)
     return engine, report
 
@@ -231,11 +265,15 @@ class ServeEngine:
                  min_count: float = 5.0, mesh=None, tracer=None,
                  multimodal: bool = False, testbed: Optional[str] = None,
                  fuse: Optional[bool] = None,
-                 lane_buckets: Optional[Tuple[int, ...]] = None):
+                 lane_buckets: Optional[Tuple[int, ...]] = None,
+                 shards: Optional[int] = None,
+                 pipeline: Optional[int] = None):
         from anomod.config import get_config
+        from anomod.utils.platform import enable_jit_cache
         if capacity_spans_per_s <= 0:
             raise ValueError("capacity must be positive")
         app_cfg = get_config()
+        enable_jit_cache()           # no-op unless ANOMOD_JIT_CACHE is on
         self.specs = list(specs)
         self.services = tuple(services)
         self.cfg = cfg or ReplayConfig(n_services=len(self.services),
@@ -263,10 +301,58 @@ class ServeEngine:
         #: only applies to the bucket-runner plane.
         self.fuse = bool(app_cfg.serve_fuse if fuse is None else fuse)
         self._fused = self.fuse and mesh is None
-        self.runner = BucketRunner(
-            self.cfg,
-            buckets if buckets is not None else app_cfg.serve_buckets,
-            lane_buckets=lane_buckets)
+        #: tenant sharding (ANOMOD_SERVE_SHARDS): the score plane fans
+        #: out across worker threads by tenant ownership; admission/
+        #: drain/shed/SLO stay on the coordinator, so every decision is
+        #: identical to the 1-shard engine on the same seed.  shards=1
+        #: (the default) is the exact pre-sharding code path.
+        self.shards = int(app_cfg.serve_shards if shards is None
+                          else shards)
+        if self.shards < 1:
+            raise ValueError("shards must be >= 1")
+        #: in-flight fused dispatches per runner (ANOMOD_SERVE_PIPELINE):
+        #: depth d stages dispatch t+1 while dispatch t's XLA work is in
+        #: flight (per-slot pinned scratch; folds in dispatch order, so
+        #: any depth is bit-identical).  Applies to the inline 1-shard
+        #: fused path AND every shard worker — depth 1 is the exact
+        #: synchronous pre-pipelining code path.
+        self.pipeline = int(app_cfg.serve_pipeline if pipeline is None
+                            else pipeline)
+        if self.pipeline < 1:
+            raise ValueError("pipeline depth must be >= 1")
+        if mesh is not None and self.shards > 1:
+            raise ValueError(
+                "the mesh plane manages its own sharded dispatch; "
+                "run it with shards=1 (ANOMOD_SERVE_SHARDS=1)")
+        _buckets = (buckets if buckets is not None
+                    else app_cfg.serve_buckets)
+        self._proc_registry = obs.get_registry()
+        if self.shards > 1:
+            from anomod.serve.shard import plan_shards
+            self.shard_of = plan_shards(self.specs, self.shards,
+                                        self.capacity_spans_per_s)
+            # each shard owns a full scoring plane: its own runner (own
+            # jitted executables + pinned scratch slots) recording into
+            # its OWN registry — zero cross-thread contention on the
+            # dispatch hot path; the coordinator folds shard registries
+            # into the process registry at the tick barrier
+            # (obs.Registry.fold_from)
+            self._shard_regs = [
+                obs.Registry(enabled=self._proc_registry.enabled)
+                for _ in range(self.shards)]
+            self._runners = [
+                BucketRunner(self.cfg, _buckets, lane_buckets=lane_buckets,
+                             registry=reg, pipeline=self.pipeline)
+                for reg in self._shard_regs]
+            self._fold_state = [dict() for _ in range(self.shards)]
+            self.runner = self._runners[0]
+        else:
+            self.shard_of = {s.tenant_id: 0 for s in self.specs}
+            self.runner = BucketRunner(self.cfg, _buckets,
+                                       lane_buckets=lane_buckets,
+                                       pipeline=self.pipeline)
+            self._runners = [self.runner]
+        self._workers = None
         # tracing is ON by default, gated on the one telemetry switch
         # (ANOMOD_OBS_ENABLED) so "telemetry off" means off end to end;
         # pass an explicit Tracer to force it on regardless
@@ -328,8 +414,9 @@ class ServeEngine:
                 else:
                     got._fn = self._shared_sharded_fn
             else:
-                got = BucketedStreamReplay(self.cfg, self.t0_us,
-                                           self.runner)
+                got = BucketedStreamReplay(
+                    self.cfg, self.t0_us,
+                    self._runners[self.shard_of.get(tenant_id, 0)])
             self._tenant_replay[tenant_id] = got
         return got
 
@@ -436,7 +523,10 @@ class ServeEngine:
         if -1e-9 < self._credit < 1e-9:
             self._credit = 0.0
         if served:
-            if self._fused:
+            if self.shards > 1:
+                with self._span("serve.score_sharded"):
+                    self._score_sharded(served)
+            elif self._fused:
                 with self._span("serve.score_fused"):
                     self._score_fused(served)
             else:
@@ -484,6 +574,58 @@ class ServeEngine:
            (``note_pushed``) scores newly closed windows exactly as a
            sequential push of the coalesced batch would.
         """
+        pending = self._stage_pending(served)
+        self._dispatch_rounds(pending, self.runner)
+        self._commit_pending(pending)
+
+    def _dispatch_rounds(self, pending: list, runner) -> None:
+        """Phase 2 of fused scoring (STACK + DISPATCH), shared by the
+        inline and sharded paths: per chunk round, same-width staged
+        chunks lane-stack into fused dispatches.  With the runner's
+        pipeline depth > 1 the dispatches go through the ASYNC
+        submit/drain path — stage round r+1's scratch while round r's
+        XLA dispatch is still in flight, fold deltas in dispatch order
+        at retire (bit-identical at any depth), drain before window
+        scoring.  Depth 1 is the synchronous pre-pipelining path,
+        unchanged."""
+        pipelined = runner.pipeline > 1
+        try:
+            rnd = 0
+            while True:
+                groups: Dict[int, List[int]] = {}
+                for i, (_, _, _, _, plan) in enumerate(pending):
+                    if rnd < len(plan):
+                        groups.setdefault(plan[rnd][0], []).append(i)
+                if not groups:
+                    break
+                for width in sorted(groups):
+                    idxs = groups[width]
+                    if pipelined:
+                        runner.submit_lanes(
+                            width, [(pending[i][1], pending[i][4][rnd][1])
+                                    for i in idxs])
+                    else:
+                        work = [(pending[i][1].get_state(),
+                                 pending[i][4][rnd][1]) for i in idxs]
+                        for i, st in zip(idxs,
+                                         runner.run_lanes(width, work)):
+                            pending[i][1].set_state(st)
+                rnd += 1
+            if pipelined:
+                runner.drain_lanes()     # tick-end barrier: folds land
+        except BaseException:
+            # a failed tick must not park its issued dispatches in the
+            # runner: a LATER tick's drain would fold the aborted
+            # tick's stale deltas into tenant states with no error
+            if pipelined:
+                runner.abort_lanes()
+            raise
+
+    def _stage_pending(self, served: List[QueuedBatch]) -> list:
+        """Phase 1 of fused scoring (COALESCE + plan), shared by the
+        inline and sharded paths: same-tenant batches concatenate in
+        arrival order into one staging; returns the ordered
+        ``(det, replay, n_spans, w_ret, plan)`` work list."""
         per_tenant: Dict[int, List[QueuedBatch]] = {}
         for qb in served:
             per_tenant.setdefault(qb.tenant_id, []).append(qb)
@@ -503,35 +645,120 @@ class ServeEngine:
             if det is not None:
                 det.push_wall_s += time.perf_counter() - t0
             pending.append((det, replay, batch.n_spans, w_ret, plan))
-        rnd = 0
-        while True:
-            groups: Dict[int, List[int]] = {}
-            for i, (_, _, _, _, plan) in enumerate(pending):
-                if rnd < len(plan):
-                    groups.setdefault(plan[rnd][0], []).append(i)
-            if not groups:
-                break
-            for width in sorted(groups):
-                idxs = groups[width]
-                work = [(pending[i][1].get_state(), pending[i][4][rnd][1])
-                        for i in idxs]
-                for i, st in zip(idxs, self.runner.run_lanes(width, work)):
-                    pending[i][1].set_state(st)
-            rnd += 1
+        return pending
+
+    def _commit_pending(self, pending: list) -> None:
+        """Phase 3 of fused scoring (COMMIT), shared by the inline and
+        sharded paths: per tenant, the detector's post-replay half
+        scores newly closed windows exactly as a sequential push
+        would."""
         for det, replay, n_in, w_ret, plan in pending:
             if det is not None:
                 t0 = time.perf_counter()
                 det.note_pushed(n_in, w_ret)
                 det.push_wall_s += time.perf_counter() - t0
 
+    # -- the sharded (scale-out) score path -------------------------------
+
+    def _ensure_workers(self) -> None:
+        if self._workers is None or not all(w.alive
+                                            for w in self._workers):
+            from anomod.serve.shard import ShardWorker
+            if self._workers is not None:
+                for w in self._workers:   # no leaked threads on respawn
+                    w.close()
+            self._workers = [ShardWorker(s) for s in range(self.shards)]
+
+    def close(self) -> None:
+        """Stop the shard worker threads (idempotent; the engine remains
+        usable — the next sharded tick respawns them)."""
+        if self._workers is not None:
+            for w in self._workers:
+                w.close()
+            self._workers = None
+
+    def _score_sharded(self, served: List[QueuedBatch]) -> None:
+        """Fan one tick's drained batches out to the shard workers by
+        tenant ownership and join at the barrier.
+
+        Each worker scores only tenants it owns — detectors, replay
+        states, the shard's BucketRunner (pipelined: up to
+        ``pipeline - 1`` fused dispatches in flight while the next
+        stages) and its metrics registry are all shard-private, so the
+        score path takes no cross-shard lock.  Per-tenant results are
+        bit-identical to the 1-shard engine: the same coalesced batches
+        stage the same chunk plans, lane deltas are bit-equal to
+        single-lane dispatches regardless of which lanes share a stack,
+        and folds apply in round order per tenant.  After the barrier
+        the coordinator folds each shard registry into the process
+        registry (counter deltas + shard-labeled gauges)."""
+        parts: List[List[QueuedBatch]] = [[] for _ in range(self.shards)]
+        for qb in served:
+            parts[self.shard_of[qb.tenant_id]].append(qb)
+        self._ensure_workers()
+        from functools import partial
+        submitted = []
+        for s, worker in enumerate(self._workers):
+            if parts[s]:
+                worker.submit(partial(self._score_shard, s, parts[s]))
+                submitted.append(worker)
+        from anomod.serve.shard import join_all
+        try:
+            join_all(submitted)
+        finally:
+            # counters fold by delta, so folding what the shards did
+            # record is correct whether or not the tick succeeded
+            for s in range(self.shards):
+                self._proc_registry.fold_from(self._shard_regs[s],
+                                              self._fold_state[s],
+                                              shard=str(s))
+
+    def _score_shard(self, shard_id: int,
+                     served: List[QueuedBatch]) -> None:
+        """One shard's slice of the tick, on that shard's worker thread.
+
+        Fused: coalesce + plan (identical to the inline path), then
+        pipelined lane-stacked dispatches through the shard's runner
+        (``submit_lanes`` — readback and state folds defer behind the
+        in-flight window), drained before window scoring.  Unfused: one
+        detector/replay push per batch, in served order."""
+        runner = self._runners[shard_id]
+        if self._fused:
+            pending = self._stage_pending(served)
+            self._dispatch_rounds(pending, runner)
+            self._commit_pending(pending)
+        else:
+            for qb in served:
+                if self.score:
+                    self._detector_for(qb.tenant_id).push(qb.spans)
+                else:
+                    self._replay_for(qb.tenant_id).push(qb.spans)
+
     def run(self, traffic, duration_s: float,
             warm: bool = True) -> "ServeReport":
         """Drive the engine from a traffic source for ``duration_s``
         virtual seconds, then close every tenant's last window."""
         if warm and self.mesh is None:
-            self.runner.warm()                   # compiles outside the wall
-            if self._fused:
-                self.runner.warm_lanes()
+            if self.shards > 1:
+                # warm shard 0 FIRST, alone: with ANOMOD_JIT_CACHE on
+                # it populates the persistent cache, so the remaining
+                # shards' identical-HLO grids (warmed in parallel on
+                # their own workers next) are cache reads instead of N
+                # concurrent compilers thrashing the host — compiles
+                # stay outside the measured wall either way
+                from functools import partial
+
+                from anomod.serve.shard import join_all
+                self._ensure_workers()
+                self._workers[0].submit(partial(self._warm_shard, 0))
+                self._workers[0].join()
+                for s in range(1, self.shards):
+                    self._workers[s].submit(partial(self._warm_shard, s))
+                join_all(self._workers[1:])
+            else:
+                self.runner.warm()               # compiles outside the wall
+                if self._fused:
+                    self.runner.warm_lanes()
         n_ticks = max(int(round(duration_s / self.clock.tick_s)), 1)
         mod_src = getattr(traffic, "modality_arrivals", None) \
             if self.multimodal else None
@@ -546,7 +773,23 @@ class ServeEngine:
             for det in self._tenant_det.values():
                 det.finish()
         self.serve_wall_s += time.perf_counter() - t_wall
+        if self.shards > 1:
+            # run-end registry fold: shard histograms (lane counts
+            # etc.) DRAIN through the Histogram.merge_digest seam — the
+            # same way the per-tenant SLO digests already join; drain
+            # semantics make a re-run() engine fold its new data only
+            for s in range(self.shards):
+                self._proc_registry.fold_from(
+                    self._shard_regs[s], self._fold_state[s],
+                    shard=str(s), final=True)
+            self.close()
         return self.report(traffic=traffic)
+
+    def _warm_shard(self, shard_id: int) -> None:
+        runner = self._runners[shard_id]
+        runner.warm()
+        if self._fused:
+            runner.warm_lanes()
 
     # -- reporting --------------------------------------------------------
 
@@ -603,6 +846,36 @@ class ServeEngine:
             }
         n_alerts = sum(len(d.alerts) for d in self._tenant_det.values())
         n_alerted = sum(1 for d in self._tenant_det.values() if d.alerts)
+        # runner stats aggregate across the shard runners (the 1-shard
+        # list is just [self.runner]); counts are identical to the
+        # 1-shard engine's except lane GROUPING stats (fused_dispatches,
+        # lanes_by_bucket, pad waste), which legitimately depend on how
+        # many tenants share a shard's stack
+        disp_by_width: Dict[int, int] = {}
+        lanes_by_bucket: Dict[int, int] = {}
+        staged_lanes = live_lanes = fused_dispatches = 0
+        compile_s = lane_compile_s = 0.0
+        for r in self._runners:
+            for w, n in r.dispatches_by_width.items():
+                disp_by_width[w] = disp_by_width.get(w, 0) + n
+            for b, n in r.lanes_by_bucket.items():
+                lanes_by_bucket[b] = lanes_by_bucket.get(b, 0) + n
+            staged_lanes += r.staged_lanes
+            live_lanes += r.live_lanes
+            fused_dispatches += r.fused_dispatches
+            compile_s += r.compile_s
+            lane_compile_s += r.lane_compile_s
+        shard_tenants: Dict[int, int] = {s: 0 for s in range(self.shards)}
+        shard_spans: Dict[int, int] = {s: 0 for s in range(self.shards)}
+        for spec in self.specs:
+            sh = self.shard_of.get(spec.tenant_id, 0)
+            shard_tenants[sh] += 1
+            shard_spans[sh] += \
+                self.admission.counters[spec.tenant_id].served_spans
+        total_shard_spans = sum(shard_spans.values())
+        shard_imbalance = (max(shard_spans.values())
+                           / (total_shard_spans / self.shards)
+                           if total_shard_spans else 1.0)
         return ServeReport(
             n_tenants=len(self.specs),
             duration_s=round(self.clock.now_s, 6),
@@ -617,14 +890,20 @@ class ServeEngine:
             peak_backlog_spans=self.admission.peak_backlog_spans,
             max_backlog=self.admission.max_backlog,
             buckets=self.runner.buckets,
-            dispatches_by_width=dict(self.runner.dispatches_by_width),
+            dispatches_by_width=disp_by_width,
             fused=self._fused,
-            fused_dispatches=self.runner.fused_dispatches,
+            fused_dispatches=fused_dispatches,
             lane_buckets=self.runner.lane_buckets,
-            lanes_by_bucket=dict(self.runner.lanes_by_bucket),
-            lane_pad_waste=round(self.runner.lane_pad_waste, 6),
-            compile_s=round(self.runner.compile_s, 4),
-            lane_compile_s=round(self.runner.lane_compile_s, 4),
+            lanes_by_bucket=lanes_by_bucket,
+            lane_pad_waste=round(1.0 - live_lanes / staged_lanes
+                                 if staged_lanes else 0.0, 6),
+            compile_s=round(compile_s, 4),
+            lane_compile_s=round(lane_compile_s, 4),
+            shards=self.shards,
+            pipeline=self.pipeline,
+            shard_tenants=shard_tenants,
+            shard_spans=shard_spans,
+            shard_imbalance=round(shard_imbalance, 6),
             latency=_merged_quantiles(list(self._slo.values())),
             per_priority=per_pri,
             modality_events=dict(self.modality_events),

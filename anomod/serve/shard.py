@@ -1,0 +1,218 @@
+"""Tenant sharding for the serving plane: deterministic partition +
+engine worker threads.
+
+The scale-out model (``ANOMOD_SERVE_SHARDS``): the virtual-clock tick's
+CONTROL plane — admission, weighted-fair drain, shedding, SLO sample
+collection — stays on the coordinator thread (it is integer/float
+bookkeeping, microseconds per tick, and keeping it single-threaded is
+what makes every admission/shed decision identical to the 1-shard engine
+by construction).  The SCORE plane — staging, lane-stacked XLA
+dispatches, window scoring, per-tenant detector state — is where the
+tick wall actually goes, and it partitions cleanly by tenant: each shard
+worker owns its tenants' ``BucketedStreamReplay``/``OnlineDetector``
+states and its own :class:`~anomod.serve.batcher.BucketRunner` (own
+jitted executables, own pinned scratch, own per-shard metrics registry)
+END TO END, so the score path needs no cross-shard locking at all.  The
+tick fans served batches out by tenant ownership and joins at a barrier
+before SLO accounting — alerts, SLO digests and shed decisions are
+deterministic per seed and identical at every shard count.
+
+Partitioning is rendezvous hashing (highest-random-weight: tenant t goes
+to ``argmax_s crc32(f"{t}/{s}")``) — stable under shard-count changes
+for most tenants, independent of spec order — followed by a
+LOAD-BALANCE pass over the tenants' seeded offered rates: power-law
+fleets (PAPERS.md, *Sparse Allreduce*) concentrate most of the span
+volume in a few head tenants, and a pure hash regularly pins two of
+them to one shard.  The pass greedily moves the heaviest movable tenant
+from the most- to the least-loaded shard while that strictly shrinks
+the span-rate spread, so the head tenants end up spread across shards
+while the hash keeps the long tail stable.  Everything is derived from
+``(tenant_id, rate)`` alone — the same specs always produce the same
+plan.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import zlib
+from typing import Dict, List, Sequence
+
+from anomod.serve.queues import TenantSpec
+
+
+def rendezvous_shard(tenant_id: int, n_shards: int) -> int:
+    """Highest-random-weight shard for one tenant (crc32 — stable across
+    processes and Python hash seeds)."""
+    best, best_score = 0, -1
+    for s in range(n_shards):
+        score = zlib.crc32(f"{tenant_id}/{s}".encode())
+        if score > best_score:
+            best, best_score = s, score
+    return best
+
+
+def served_rate_model(specs: Sequence[TenantSpec],
+                      capacity_spans_per_s: float) -> Dict[int, float]:
+    """Expected SERVED spans/s per tenant under weighted-fair overload.
+
+    Offered rate is the wrong balance weight once the fleet overloads:
+    shedding is priority-ordered, so a bronze head tenant's spans mostly
+    shed while a gold tenant's mostly serve — and the shard barrier
+    waits on *scored* work, not offered work.  Under SFQ saturation each
+    backlogged tenant's served rate is proportional to its weight, so
+    the fleet splits as ``served_t = min(rate_t, w_t * K)`` with K set
+    by capacity: ``sum_t min(rate_t, w_t * K) = C`` (demand-limited
+    tenants serve their whole offer, the rest split the remainder by
+    weight).  K solves by bisection; with capacity >= offered load the
+    model degrades to the offered rates exactly.
+    """
+    rates = {s.tenant_id: max(float(s.rate_spans_per_s), 0.0)
+             for s in specs}
+    total = sum(rates.values())
+    if total <= 0 or capacity_spans_per_s >= total:
+        return rates
+    ws = {s.tenant_id: s.effective_weight() for s in specs}
+    lo, hi = 0.0, max(r / w for r, w in
+                      ((rates[t], ws[t]) for t in rates) if w > 0)
+    for _ in range(60):
+        k = 0.5 * (lo + hi)
+        if sum(min(rates[t], ws[t] * k) for t in rates) \
+                < capacity_spans_per_s:
+            lo = k
+        else:
+            hi = k
+    k = 0.5 * (lo + hi)
+    return {t: min(rates[t], ws[t] * k) for t in rates}
+
+
+def plan_shards(specs: Sequence[TenantSpec], n_shards: int,
+                capacity_spans_per_s: float = 0.0) -> Dict[int, int]:
+    """tenant_id -> shard for the whole fleet: rendezvous base + the
+    greedy rate-balance pass described in the module docstring.
+
+    ``capacity_spans_per_s`` (when positive and below the offered load)
+    switches the balance weights from offered to expected-served rates
+    (:func:`served_rate_model`) — the barrier waits on scored spans, so
+    that is the load to equalize.  Deterministic in the arguments alone;
+    every tenant is assigned; with ``n_shards == 1`` everything maps to
+    shard 0.
+    """
+    if n_shards < 1:
+        raise ValueError("n_shards must be >= 1")
+    assign = {s.tenant_id: rendezvous_shard(s.tenant_id, n_shards)
+              for s in specs}
+    if n_shards == 1 or len(specs) <= 1:
+        return assign
+    # expected-served weights (offered rates when capacity is ample or
+    # unknown); an all-zero fleet (scripted traffic with no rate hints)
+    # balances by tenant count instead
+    w = served_rate_model(specs, capacity_spans_per_s) \
+        if capacity_spans_per_s > 0 else \
+        {s.tenant_id: max(float(s.rate_spans_per_s), 0.0) for s in specs}
+    if sum(w.values()) <= 0:
+        w = {t: 1.0 for t in w}
+    loads = [0.0] * n_shards
+    members: List[List[int]] = [[] for _ in range(n_shards)]
+    for s in specs:
+        loads[assign[s.tenant_id]] += w[s.tenant_id]
+        members[assign[s.tenant_id]].append(s.tenant_id)
+    # every accepted move strictly decreases the load variance
+    # (condition below implies wt < loads[hi] - loads[lo]), so the loop
+    # terminates; the iteration cap is a belt for float dust.  Donors
+    # are tried in descending load order — a shard whose whole load is
+    # one indivisible head tenant is optimal already and must not stop
+    # the rest of the fleet from leveling.
+    for _ in range(8 * len(specs)):
+        lo = min(range(n_shards), key=lambda i: (loads[i], i))
+        moved = False
+        for hi in sorted(range(n_shards), key=lambda i: (-loads[i], i)):
+            if hi == lo or loads[hi] <= loads[lo]:
+                break
+            # heaviest first (ties broken by tenant id for
+            # determinism): moving a head tenant off the hot shard is
+            # the whole point
+            for tid in sorted(members[hi], key=lambda t: (-w[t], t)):
+                wt = w[tid]
+                if max(loads[hi] - wt, loads[lo] + wt) \
+                        < loads[hi] - 1e-12:
+                    members[hi].remove(tid)
+                    members[lo].append(tid)
+                    loads[hi] -= wt
+                    loads[lo] += wt
+                    assign[tid] = lo
+                    moved = True
+                    break
+            if moved:
+                break
+        if not moved:
+            break
+    return assign
+
+
+def join_all(workers) -> None:
+    """Barrier over submitted workers that COMPLETES before any error
+    propagates: raising at the first failed join would leave sibling
+    tasks running, and the next submit would desynchronize their
+    done-events (a later join could observe the old task's completion).
+    Re-raises the first collected error after every join returned."""
+    errs = []
+    for w in workers:
+        try:
+            w.join()
+        except BaseException as e:           # noqa: BLE001 — re-raised
+            errs.append(e)
+    if errs:
+        raise errs[0]
+
+
+class ShardWorker:
+    """One persistent engine worker thread.
+
+    The coordinator submits ONE closure per tick (the shard's slice of
+    the served batches) and joins at the barrier; the worker executes it
+    against state only this shard ever touches.  Exceptions propagate to
+    the coordinator at join() — a failed shard must fail the tick, not
+    silently drop its tenants' scoring.
+    """
+
+    def __init__(self, shard_id: int, name: str = "anomod-serve-shard"):
+        self.shard_id = shard_id
+        self._q: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self._exc: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{name}-{shard_id}", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            fn = self._q.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except BaseException as e:       # noqa: BLE001 — re-raised at join
+                self._exc = e
+            finally:
+                self._done.set()
+
+    def submit(self, fn) -> None:
+        """Queue one task; pair every submit with a :meth:`join`."""
+        self._done.clear()
+        self._q.put(fn)
+
+    def join(self) -> None:
+        """Barrier: wait for the submitted task; re-raise its error."""
+        self._done.wait()
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()

@@ -142,6 +142,40 @@ def ensure_live_backend(n_cpu_fallback: int = 1, attempts=None) -> str:
     return f"device backend unavailable ({diag}); pinned cpu"
 
 
+def enable_jit_cache():
+    """Point jax's persistent compilation cache at
+    ``<ANOMOD_CACHE_DIR>/jit`` when the validated ``ANOMOD_JIT_CACHE``
+    knob is on (anomod.config).
+
+    Returns the cache directory as a string, or None when disabled (knob
+    off, or ingest caching disabled entirely).  Idempotent and
+    best-effort: an unwritable cache dir must never fail a serve or a
+    capture — the process just compiles as it always did.  The cache is
+    keyed by HLO hash, so the serving plane's per-shard runners (whose
+    jitted grids lower to identical HLO) compile once per shape per
+    *install*, not once per shape per shard per process — the same
+    mechanism that lets a warm bench restart skip the
+    (width x lane-bucket) compile wall entirely.
+    """
+    from anomod.config import get_config
+    cfg = get_config()
+    if not cfg.jit_cache or cfg.cache_dir is None:
+        return None
+    try:
+        d = cfg.cache_dir / "jit"
+        d.mkdir(parents=True, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", str(d))
+        # serve-grid entries are individually tiny and fast to compile;
+        # without flooring these thresholds the cache would skip exactly
+        # the many-small-shapes workload it exists for here
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return str(d)
+    except (OSError, AttributeError):
+        return None
+
+
 def env_number(name: str, default, cast=int):
     """Parse a numeric env var, warning and falling back on garbage.
 

@@ -41,23 +41,77 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def _shard_fanout_smoke() -> dict:
+    """The 2-shard fan-out smoke (<5 s): a tiny seeded fused run on 2
+    engine workers must produce the EXACT decision output of the same
+    run on 1 shard — per-tenant alerts, replay states (bitwise), and
+    every report field that is not wall-clock or shard topology.  A
+    divergence here means the sharded score path broke determinism and
+    a shard-scaling capture would compare different computations."""
+    import dataclasses
+
+    import numpy as np
+
+    from anomod.serve.engine import (SHARD_VARIANT_REPORT_FIELDS,
+                                     run_power_law)
+
+    def go(n_shards):
+        return run_power_law(
+            n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+            overload=2.0, duration_s=20, tick_s=1.0, seed=5,
+            window_s=5.0, baseline_windows=4, fault_tenants=0,
+            buckets=(64, 256), lane_buckets=(1, 2, 4), max_backlog=1500,
+            n_windows=16, shards=n_shards, pipeline=2)
+
+    e1, r1 = go(1)
+    e2, r2 = go(2)
+    skip = SHARD_VARIANT_REPORT_FIELDS
+    a = {k: v for k, v in r1.to_dict().items() if k not in skip}
+    b = {k: v for k, v in r2.to_dict().items() if k not in skip}
+    if a != b:
+        diff = sorted(k for k in a if a[k] != b[k])
+        raise RuntimeError(f"shard fan-out smoke: 2-shard report "
+                           f"diverges from 1-shard on {diff}")
+    for tid in e1._tenant_det:
+        if [dataclasses.asdict(x) for x in e1.alerts_for(tid)] != \
+                [dataclasses.asdict(x) for x in e2.alerts_for(tid)]:
+            raise RuntimeError(f"shard fan-out smoke: tenant {tid} "
+                               "alert stream diverges")
+        s1 = e1._tenant_replay[tid].state
+        s2 = e2._tenant_replay[tid].state
+        if not (np.array_equal(np.asarray(s1.agg), np.asarray(s2.agg))
+                and np.array_equal(np.asarray(s1.hist),
+                                   np.asarray(s2.hist))):
+            raise RuntimeError(f"shard fan-out smoke: tenant {tid} "
+                               "replay state diverges")
+    return {"tenants": len(e1._tenant_det),
+            "served_spans": r1.served_spans}
+
+
 def check_serve() -> int:
     """Serve-bench preconditions: env contract parses, bucket set
-    compiles.  Runs on the pinned-CPU backend (the gate must never hang
-    on a dead device tunnel — compilability is backend-independent)."""
+    compiles, the shard fan-out reproduces the 1-shard output.  Runs on
+    the pinned-CPU backend (the gate must never hang on a dead device
+    tunnel — compilability is backend-independent)."""
     out = {"check": "pre_bench_serve", "mode": "serve"}
     try:
-        from anomod.utils.platform import pin_cpu
+        from anomod.utils.platform import enable_jit_cache, pin_cpu
         pin_cpu(1)
         from anomod.config import Config
         cfg = Config()                    # validates the serve env knobs
         out["buckets"] = list(cfg.serve_buckets)
         out["max_backlog"] = cfg.serve_max_backlog
+        out["shards"] = cfg.serve_shards
+        out["pipeline"] = cfg.serve_pipeline
+        out["jit_cache"] = enable_jit_cache()
         from anomod.serve.batcher import BucketRunner
         from anomod.serve.engine import serve_plane_cfg
         # the serve bench's plane shape (ONE definition with bench.py's
         # serve path): compile every bucket width once so the capture's
-        # compile_s is warm-path bookkeeping, not a mid-capture stall
+        # compile_s is warm-path bookkeeping, not a mid-capture stall.
+        # The bench's shard legs each compile this same grid per shard
+        # runner — with ANOMOD_JIT_CACHE on they read it back from the
+        # persistent cache this warm just populated.
         runner = BucketRunner(serve_plane_cfg(), cfg.serve_buckets,
                               lane_buckets=cfg.serve_lane_buckets)
         compile_s = runner.warm()
@@ -78,6 +132,8 @@ def check_serve() -> int:
                     "compile")
             out.update(lane_shapes=len(runner.lane_shapes),
                        lane_compile_s=round(lane_compile_s, 3))
+            # determinism gate for the bench's shard-scaling legs
+            out["shard_smoke"] = _shard_fanout_smoke()
         print(json.dumps(out))
         return 0
     except Exception as e:

@@ -127,6 +127,20 @@ def test_bench_serve_mode_contract(tmp_path):
     assert tel["journal_samples"] > 0
     assert out["obs_snapshot"]["anomod_serve_served_spans_total"][
         "value"] == out["served_spans"]
+    # shard-scaling legs (scale-out PR): 2/4 workers then a warm
+    # 1-shard reference, all on the same seed; shedding and p99 are
+    # shard-count-invariant by construction
+    scaling = out["shard_scaling"]
+    assert set(scaling) == {"1", "2", "4"}
+    assert scaling["1"]["speedup_vs_1_shard"] == 1.0
+    for leg in scaling.values():
+        assert leg["spans_per_sec"] > 0
+        assert leg["shed_fraction"] == out["shed_fraction"]
+        assert leg["p99_latency_s"] == \
+            out["p99_admission_to_scored_latency_s"]
+        assert leg["shard_imbalance"] >= 1.0
+    # jit-cache block present (disabled by default in this env)
+    assert out["jit_cache"]["enabled"] in (True, False)
     runs = list((tmp_path / "runs").glob("*.json"))
     assert len(runs) == 1
     rec = json.loads(runs[0].read_text())

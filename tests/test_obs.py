@@ -93,6 +93,105 @@ def test_counter_thread_safety(registry):
     assert c.value == 40_000
 
 
+def test_scrape_vs_record_hammer(registry):
+    """Scrape-vs-record race hammer (the sharded serving plane's
+    regime: worker threads record while the coordinator scrapes).
+
+    Every histogram observation is exactly 1.0, so any scraped
+    ``_count`` that disagrees with its ``_sum`` is a TORN read — the
+    pre-fix ``Histogram.samples`` read count and sum outside the lock
+    and could journal a count from after an observe with the sum from
+    before it.  Counters/gauges ride along to shake the registry's
+    handle table and journal under the same concurrency."""
+    h = registry.histogram("anomod_test_hammer_seconds")
+    c = registry.counter("anomod_test_hammer_total")
+    g = registry.gauge("anomod_test_hammer_depth")
+    N_THREADS, N_OBS = 4, 20_000
+    # aggressive GIL churn: make the torn-read window (count read,
+    # switch, observe, switch, sum read) actually reachable
+    prev_switch = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+
+    def record():
+        for k in range(N_OBS):
+            h.observe(1.0)
+            c.inc()
+            g.set(float(k))
+
+    threads = [threading.Thread(target=record)
+               for _ in range(N_THREADS)]
+    try:
+        for t in threads:
+            t.start()
+        scrapes = 0
+        while any(t.is_alive() for t in threads):
+            registry.scrape(now_s=float(scrapes))
+            scrapes += 1
+        for t in threads:
+            t.join()
+    finally:
+        sys.setswitchinterval(prev_switch)
+    registry.scrape(now_s=float(scrapes))
+    # final totals exact
+    assert h.count == N_THREADS * N_OBS
+    assert h.sum == pytest.approx(float(N_THREADS * N_OBS))
+    assert c.value == N_THREADS * N_OBS
+    # every scraped (count, sum) pair is internally consistent
+    rows = {}
+    for t_s, name, _, val in registry.journal():
+        rows.setdefault(t_s, {})[name] = val
+    checked = 0
+    for t_s, r in rows.items():
+        if "anomod_test_hammer_seconds_count" in r:
+            assert r["anomod_test_hammer_seconds_count"] == pytest.approx(
+                r["anomod_test_hammer_seconds_sum"]), \
+                f"torn histogram snapshot at scrape t={t_s}"
+            checked += 1
+    assert checked >= 2          # the hammer actually overlapped scrapes
+
+
+def test_registry_fold_from_shard_registries(registry):
+    """The sharded engine's merge seam: counters fold as deltas
+    (summable fleet totals across repeated folds), gauges land on
+    shard-labeled twins, histograms merge once at final through
+    merge_digest."""
+    shard = Registry(enabled=True, max_samples=1000)
+    state = {}
+    c = shard.counter("anomod_serve_fused_dispatches_total")
+    g = shard.gauge("anomod_serve_lane_pad_waste_fraction")
+    h = shard.histogram("anomod_serve_fused_lanes")
+    c.inc(3)
+    g.set(0.25)
+    for v in (1.0, 2.0, 4.0):
+        h.observe(v)
+    registry.fold_from(shard, state, shard="0")
+    assert registry.counter(
+        "anomod_serve_fused_dispatches_total").value == 3
+    c.inc(2)
+    registry.fold_from(shard, state, shard="0")   # delta, not re-total
+    assert registry.counter(
+        "anomod_serve_fused_dispatches_total").value == 5
+    assert registry.gauge("anomod_serve_lane_pad_waste_fraction",
+                          shard="0").value == 0.25
+    # histograms only at final=True, and they DRAIN: a second final
+    # fold (engine run() twice) adds only the new observations
+    assert registry.histogram("anomod_serve_fused_lanes").count == 0
+    registry.fold_from(shard, state, shard="0", final=True)
+    assert registry.histogram("anomod_serve_fused_lanes").count == 3
+    assert registry.histogram("anomod_serve_fused_lanes").sum == \
+        pytest.approx(7.0)
+    registry.fold_from(shard, state, shard="0", final=True)   # drained
+    assert registry.histogram("anomod_serve_fused_lanes").count == 3
+    h.observe(8.0)
+    registry.fold_from(shard, state, shard="0", final=True)
+    assert registry.histogram("anomod_serve_fused_lanes").count == 4
+    assert registry.histogram("anomod_serve_fused_lanes").sum == \
+        pytest.approx(15.0)
+    # disabled either side: no-op
+    registry.fold_from(Registry(enabled=False, max_samples=10), {},
+                       shard="1", final=True)
+
+
 def test_histogram_merge_digest(registry):
     """The serve plane's fold path: a pre-built t-digest joins the
     histogram weight-preserving, with count/sum bookkeeping."""

@@ -93,6 +93,40 @@ def test_ensure_live_backend_pins_on_dead_probe(monkeypatch):
     assert pins == [2]
 
 
+def test_enable_jit_cache_gated_and_idempotent(monkeypatch, tmp_path):
+    """ANOMOD_JIT_CACHE: off (default) -> no-op/None; on + a cache dir
+    -> jax's persistent compilation cache points at <dir>/jit; on with
+    caching disabled entirely -> None.  Restores the suite's own cache
+    config afterwards (conftest points it at .jax_test_cache)."""
+    import jax
+
+    import anomod.config as config
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        monkeypatch.setenv("ANOMOD_CACHE_DIR", str(tmp_path))
+        monkeypatch.delenv("ANOMOD_JIT_CACHE", raising=False)
+        config.set_config(config.Config())
+        assert platform.enable_jit_cache() is None        # default off
+        monkeypatch.setenv("ANOMOD_JIT_CACHE", "1")
+        config.set_config(config.Config())
+        got = platform.enable_jit_cache()
+        assert got == str(tmp_path / "jit")
+        assert (tmp_path / "jit").is_dir()
+        assert jax.config.jax_compilation_cache_dir == got
+        assert platform.enable_jit_cache() == got         # idempotent
+        monkeypatch.setenv("ANOMOD_CACHE_DIR", "off")     # caching off
+        config.set_config(config.Config())
+        assert platform.enable_jit_cache() is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)
+        monkeypatch.delenv("ANOMOD_CACHE_DIR", raising=False)
+        monkeypatch.delenv("ANOMOD_JIT_CACHE", raising=False)
+        config.set_config(config.Config())
+
+
 def test_checkpoint_mtime_distinguishes_fresh_from_stale(tmp_path):
     """The rca failover retry resumes only from a checkpoint whose publish
     time postdates the attempt start — checkpoint_mtime is that clock."""

@@ -829,6 +829,393 @@ def test_lane_env_knobs_registered_and_validated(monkeypatch):
         _sys.path.pop(0)
 
 
+# ---------------------------------------------------------------------------
+# tenant-sharded scale-out + pipelined dispatch (the PR-5 pins)
+# ---------------------------------------------------------------------------
+
+def _report_decision_fields(rep):
+    """Everything in the report that must be shard-count/pipeline-depth
+    invariant (the exclusion list is engine.py's ONE definition, shared
+    with the pre-bench fan-out smoke)."""
+    from anomod.serve.engine import SHARD_VARIANT_REPORT_FIELDS
+    return {k: v for k, v in rep.to_dict().items()
+            if k not in SHARD_VARIANT_REPORT_FIELDS}
+
+
+def test_shard_plan_deterministic_balanced_and_covering():
+    from anomod.serve.shard import plan_shards, rendezvous_shard
+    tr = PowerLawTraffic(n_tenants=200, total_rate_spans_per_s=50_000,
+                         alpha=1.2, seed=0, n_services=12)
+    for n in (2, 4, 8):
+        plan = plan_shards(tr.specs, n)
+        assert set(plan) == {s.tenant_id for s in tr.specs}   # covering
+        assert set(plan.values()) <= set(range(n))
+        assert plan == plan_shards(tr.specs, n)               # stable
+        # the load-balance pass spreads the Zipf head: offered-rate
+        # share per shard within 15% of perfect — except that a single
+        # tenant is indivisible, so the unavoidable floor is the head
+        # tenant's own rate (at 8 shards the ~26% head exceeds the
+        # 12.5% perfect share; the optimum parks it alone)
+        loads = [0.0] * n
+        for s in tr.specs:
+            loads[plan[s.tenant_id]] += s.rate_spans_per_s
+        head = max(s.rate_spans_per_s for s in tr.specs)
+        assert max(loads) <= max(1.15 * sum(loads) / n, head * 1.001)
+        # ...and an irreducible head shard must not stop the REST of
+        # the fleet from leveling
+        rest = sorted(loads)[:-1]
+        if rest:
+            assert max(rest) <= \
+                1.15 * max(sum(rest) / len(rest), head)
+    assert plan_shards(tr.specs, 1) == {s.tenant_id: 0 for s in tr.specs}
+    # rendezvous base is pure and process-stable
+    assert rendezvous_shard(17, 4) == rendezvous_shard(17, 4)
+    with pytest.raises(ValueError):
+        plan_shards(tr.specs, 0)
+
+
+def test_served_rate_model_under_overload():
+    """The balance weights under overload follow the WFQ share model:
+    demand-limited tenants keep their offer, the rest split by weight;
+    the total matches capacity."""
+    from anomod.serve.shard import served_rate_model
+    specs = [TenantSpec(0, "gold", priority=0, rate_spans_per_s=100.0),
+             TenantSpec(1, "bronze", priority=2, rate_spans_per_s=1000.0),
+             TenantSpec(2, "silver", priority=1, rate_spans_per_s=10.0)]
+    served = served_rate_model(specs, capacity_spans_per_s=500.0)
+    assert sum(served.values()) == pytest.approx(500.0, rel=1e-3)
+    # gold and silver offer less than their weighted fair share: both
+    # are demand-limited and keep their whole offer; bronze (the only
+    # backlogged tenant) gets exactly the remainder
+    assert served[0] == pytest.approx(100.0)
+    assert served[2] == pytest.approx(10.0)
+    assert served[1] == pytest.approx(390.0, rel=1e-3)
+    # two backlogged tenants split the remainder by weight (4:1)
+    specs2 = [TenantSpec(0, "g", priority=0, rate_spans_per_s=1000.0),
+              TenantSpec(1, "b", priority=2, rate_spans_per_s=1000.0)]
+    served2 = served_rate_model(specs2, capacity_spans_per_s=500.0)
+    assert served2[0] / served2[1] == pytest.approx(4.0, rel=1e-2)
+    # ample capacity: the offered rates verbatim
+    ample = served_rate_model(specs, capacity_spans_per_s=5000.0)
+    assert ample == {0: 100.0, 1: 1000.0, 2: 10.0}
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_sharded_engine_identical_to_single_shard(seed):
+    """THE scale-out parity pin: an N-shard engine (worker threads,
+    pipelined dispatch) emits per-tenant states, alert streams, SLO
+    quantiles and admission/shed decisions IDENTICAL to the 1-shard
+    synchronous engine on the same seed — with coalescing and
+    pipelining genuinely exercised."""
+    def go(shards, pipeline):
+        return run_power_law(
+            n_tenants=10, n_services=4, capacity_spans_per_s=1500,
+            overload=2.0, duration_s=40, tick_s=0.5, seed=seed,
+            window_s=5.0, baseline_windows=4, fault_tenants=1,
+            buckets=(64, 128, 512), lane_buckets=(1, 2, 4),
+            max_backlog=3000, n_windows=16, shards=shards,
+            pipeline=pipeline)
+
+    e1, r1 = go(1, 1)                     # the synchronous baseline
+    base = _report_decision_fields(r1)
+    assert r1.shed_spans > 0              # overload regime is real
+    for shards, pipeline in ((1, 2), (2, 2), (4, 3)):
+        en, rn = go(shards, pipeline)
+        assert _report_decision_fields(rn) == base, \
+            f"report diverged at shards={shards}"
+        assert rn.shards == shards and rn.pipeline == pipeline
+        for tid in e1._tenant_det:
+            assert [dataclasses.asdict(a) for a in e1.alerts_for(tid)] \
+                == [dataclasses.asdict(a) for a in en.alerts_for(tid)]
+            s1 = e1._tenant_replay[tid].state
+            s2 = en._tenant_replay[tid].state
+            np.testing.assert_array_equal(np.asarray(s1.agg),
+                                          np.asarray(s2.agg))
+            np.testing.assert_array_equal(np.asarray(s1.hist),
+                                          np.asarray(s2.hist))
+        if shards > 1:
+            # occupancy fields: every shard got tenants, spans add up
+            assert sum(rn.shard_tenants.values()) == 10
+            assert sum(rn.shard_spans.values()) == rn.served_spans
+            assert rn.shard_imbalance >= 1.0
+    # pipelining was actually exercised: a depth-2 run kept dispatches
+    # in flight (the runner drained them at tick end)
+    en, rn = go(2, 2)
+    assert all(r.pipeline == 2 for r in en._runners)
+    assert rn.fused_dispatches > 0
+
+
+def test_submit_lanes_pipelined_bit_identical_to_run_lanes():
+    """The pipelined submit/drain path (deferred readback, per-slot
+    scratch) folds the exact bits of the synchronous run_lanes path, at
+    several depths, including multi-round (multi-chunk) tenants whose
+    deltas are in flight simultaneously."""
+    cfg = ReplayConfig(n_services=6, n_windows=8, window_us=5_000_000,
+                       chunk_size=512)
+
+    def fresh_replays(runner, n):
+        out = []
+        for i in range(n):
+            r = BucketedStreamReplay(cfg, 0, runner)
+            out.append(r)
+        return out
+
+    batches = [_rand_spans(80 + 97 * i, 6, seed=100 + i) for i in range(5)]
+    # synchronous reference
+    ref_runner = BucketRunner(cfg, (128, 512), lane_buckets=(1, 2, 4))
+    ref_runner.warm()
+    refs = fresh_replays(ref_runner, 5)
+    for r, b in zip(refs, batches):
+        r.push(b)
+    for depth in (2, 3):
+        runner = BucketRunner(cfg, (128, 512), lane_buckets=(1, 2, 4),
+                              pipeline=depth)
+        runner.warm()
+        runner.warm_lanes()
+        replays = fresh_replays(runner, 5)
+        plans = [r.plan_push(b) for r, b in zip(replays, batches)]
+        rnd = 0
+        while True:
+            groups = {}
+            for i, (_, plan) in enumerate(plans):
+                if rnd < len(plan):
+                    groups.setdefault(plan[rnd][0], []).append(i)
+            if not groups:
+                break
+            for width in sorted(groups):
+                runner.submit_lanes(width,
+                                    [(replays[i], plans[i][1][rnd][1])
+                                     for i in groups[width]])
+            rnd += 1
+        assert runner.inflight_dispatches <= depth - 1
+        runner.drain_lanes()
+        assert runner.inflight_dispatches == 0
+        for ref, got in zip(refs, replays):
+            np.testing.assert_array_equal(np.asarray(ref.state.agg),
+                                          np.asarray(got.state.agg))
+            np.testing.assert_array_equal(np.asarray(ref.state.hist),
+                                          np.asarray(got.state.hist))
+
+
+def test_abort_lanes_discards_inflight_without_folding():
+    """Failed-tick cleanup: aborting in-flight dispatches materializes
+    them (scratch stays safe to refill) but folds NOTHING — the paired
+    replays keep their pre-submit states, and a later drain/run_lanes
+    cannot absorb the aborted work."""
+    cfg = ReplayConfig(n_services=4, n_windows=8, window_us=5_000_000,
+                       chunk_size=256)
+    runner = BucketRunner(cfg, (64, 256), lane_buckets=(1, 2),
+                          pipeline=3)
+    runner.warm()
+    runner.warm_lanes()
+    replays = [BucketedStreamReplay(cfg, 0, runner) for _ in range(2)]
+    plans = [r.plan_push(_rand_spans(60 + i, 4, seed=40 + i))
+             for i, r in enumerate(replays)]
+    before = [np.asarray(r.state.agg).copy() for r in replays]
+    runner.submit_lanes(64, [(r, p[1][0][1])
+                             for r, p in zip(replays, plans)])
+    assert runner.inflight_dispatches == 1
+    runner.abort_lanes()
+    assert runner.inflight_dispatches == 0
+    for r, b in zip(replays, before):
+        np.testing.assert_array_equal(np.asarray(r.state.agg), b)
+    # the runner keeps serving after an abort: a fresh push folds
+    replays[0].push(_rand_spans(50, 4, seed=99))
+    assert replays[0].n_spans > 0
+
+
+def test_per_shard_compile_count_pin():
+    """Exactly one compile per (width, lane-bucket) per SHARD: each
+    shard runner owns its executables and compiles its grid once; the
+    per-shard registries fold the compile counters into the process
+    registry, so the fleet total is shards x grid."""
+    from anomod.obs.registry import Registry, set_registry
+    reg = Registry(enabled=True)
+    prev = set_registry(reg)
+    try:
+        eng, rep = run_power_law(
+            n_tenants=10, n_services=4, capacity_spans_per_s=1500,
+            overload=1.5, duration_s=40, tick_s=0.5, seed=6,
+            window_s=5.0, baseline_windows=4, fault_tenants=0,
+            buckets=(128, 512), lane_buckets=(1, 2, 4), fuse=True,
+            n_windows=16, shards=2, pipeline=2)
+        grid = {(w, l) for w in eng.runner.widths
+                for l in eng.runner.lane_buckets}
+        for r in eng._runners:
+            assert r.lane_shapes == grid          # full grid, per shard
+        assert reg.counter(
+            "anomod_serve_fused_compile_total").value == 2 * len(grid)
+        assert rep.fused_dispatches > 0
+        # shard-labeled gauge twins landed in the process registry
+        assert reg.gauge("anomod_serve_lane_pad_waste_fraction",
+                         shard="0").value >= 0.0
+        # run-end histogram fold (merge_digest seam): lane counts from
+        # both shards are in the process histogram
+        assert reg.histogram("anomod_serve_fused_lanes").count == \
+            rep.fused_dispatches
+    finally:
+        set_registry(prev)
+
+
+def test_sharded_unfused_and_scoreless_paths():
+    """The escape hatches compose: shards>1 with fuse=0 (per-batch
+    pushes on the worker) and score=False (replay-plane only) both
+    reproduce the 1-shard output."""
+    def go(shards, fuse, score):
+        return run_power_law(
+            n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+            overload=1.5, duration_s=20, tick_s=1.0, seed=2,
+            window_s=5.0, baseline_windows=4, fault_tenants=0,
+            buckets=(128, 512), max_backlog=2000, n_windows=16,
+            shards=shards, fuse=fuse, score=score)
+    for fuse, score in ((False, True), (True, False)):
+        e1, r1 = go(1, fuse, score)
+        e2, r2 = go(2, fuse, score)
+        assert _report_decision_fields(r1) == _report_decision_fields(r2)
+        for tid, rep1 in e1._tenant_replay.items():
+            rep2 = e2._tenant_replay[tid]
+            np.testing.assert_array_equal(np.asarray(rep1.state.agg),
+                                          np.asarray(rep2.state.agg))
+
+
+def test_mesh_refuses_shards():
+    from anomod.parallel import make_mesh
+    traffic = PowerLawTraffic(n_tenants=2, total_rate_spans_per_s=100,
+                              seed=0, n_services=4)
+    cfg = ReplayConfig(n_services=4, n_windows=16, window_us=5_000_000,
+                       chunk_size=512)
+    with pytest.raises(ValueError, match="mesh"):
+        ServeEngine(traffic.specs, traffic.services, cfg,
+                    mesh=make_mesh(2), shards=2)
+
+
+def test_shard_worker_propagates_errors():
+    from anomod.serve.shard import ShardWorker
+    w = ShardWorker(0)
+    try:
+        def boom():
+            raise RuntimeError("shard exploded")
+        w.submit(boom)
+        with pytest.raises(RuntimeError, match="shard exploded"):
+            w.join()
+        # the worker survives and keeps serving
+        hit = []
+        w.submit(lambda: hit.append(1))
+        w.join()
+        assert hit == [1]
+    finally:
+        w.close()
+    assert not w.alive
+
+
+def test_shard_env_knobs_registered_and_validated(monkeypatch):
+    from anomod.config import Config
+    monkeypatch.setenv("ANOMOD_SERVE_SHARDS", "4")
+    monkeypatch.setenv("ANOMOD_SERVE_PIPELINE", "3")
+    monkeypatch.setenv("ANOMOD_JIT_CACHE", "1")
+    cfg = Config()
+    assert cfg.serve_shards == 4
+    assert cfg.serve_pipeline == 3
+    assert cfg.jit_cache is True
+
+    for var, bad in (("ANOMOD_SERVE_SHARDS", "0"),
+                     ("ANOMOD_SERVE_SHARDS", "many"),
+                     ("ANOMOD_SERVE_SHARDS", "999"),
+                     ("ANOMOD_SERVE_PIPELINE", "0"),
+                     ("ANOMOD_SERVE_PIPELINE", "deep")):
+        monkeypatch.setenv(var, bad)
+        with pytest.raises(ValueError, match=var):
+            Config()
+        monkeypatch.delenv(var)
+    monkeypatch.setenv("ANOMOD_JIT_CACHE", "off")
+    assert Config().jit_cache is False
+    monkeypatch.delenv("ANOMOD_JIT_CACHE")
+    cfg = Config()
+    assert cfg.serve_shards == 1          # default: the escape hatch
+    assert cfg.serve_pipeline == 2
+    assert cfg.jit_cache is False
+    # the env-contract gate sees all three knobs as Config-covered
+    import sys as _sys
+    from pathlib import Path as _Path
+    _sys.path.insert(0, str(_Path(__file__).parent.parent / "scripts"))
+    try:
+        import check_env_contract as cec
+        refs = cec.referenced_vars(_Path(cec.ROOT))
+        corpus = cec.covered_vars(_Path(cec.ROOT))
+        for knob in ("ANOMOD_SERVE_SHARDS", "ANOMOD_SERVE_PIPELINE",
+                     "ANOMOD_JIT_CACHE"):
+            assert knob in refs and knob in corpus
+    finally:
+        _sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# the state seams sharding leans on (get_state/set_state, raw staging)
+# ---------------------------------------------------------------------------
+
+def test_state_seam_roundtrip_under_interleaved_shard_order():
+    """StreamReplay.get_state/set_state round-trips: externally folding
+    each tenant's staged chunks through the seam — in ANY cross-tenant
+    interleaving — reproduces push() bit-exactly per tenant (per-tenant
+    chunk order is the only ordering that matters)."""
+    cfg = ReplayConfig(n_services=4, n_windows=8, window_us=5_000_000,
+                       chunk_size=256)
+    runner = BucketRunner(cfg, (64, 256), lane_buckets=(1, 2))
+    runner.warm()
+    batches = {t: _rand_spans(300 + 50 * t, 4, seed=t) for t in range(3)}
+
+    ref = {}
+    for t, b in batches.items():
+        r = BucketedStreamReplay(cfg, 0, runner)
+        r.push(b)
+        ref[t] = r.state
+
+    # two different shard-style interleavings of the same per-tenant
+    # chunk streams (round-robin and reversed-tenant order)
+    for order in ("round_robin", "reversed"):
+        replays = {t: BucketedStreamReplay(cfg, 0, runner)
+                   for t in batches}
+        plans = {t: replays[t].plan_push(b)[1]
+                 for t, b in batches.items()}
+        queue = []
+        max_rounds = max(len(p) for p in plans.values())
+        tenant_order = sorted(batches) if order == "round_robin" \
+            else sorted(batches, reverse=True)
+        for rnd in range(max_rounds):
+            for t in tenant_order:
+                if rnd < len(plans[t]):
+                    queue.append((t, plans[t][rnd]))
+        for t, (width, cols) in queue:
+            st = replays[t].get_state()
+            replays[t].set_state(runner.dispatch(st, cols, width))
+        for t in batches:
+            np.testing.assert_array_equal(np.asarray(ref[t].agg),
+                                          np.asarray(replays[t].state.agg))
+            np.testing.assert_array_equal(
+                np.asarray(ref[t].hist), np.asarray(replays[t].state.hist))
+
+
+def test_stage_columns_raw_roundtrip_matches_padded_staging():
+    """stage_columns_raw + the scratch-fill pad (dead-chunk fill values)
+    reproduces stage_columns' padded chunks byte-for-byte — the staging
+    seam the shard runners' pinned scratch relies on."""
+    from anomod.replay import dead_chunk, stage_columns, stage_columns_raw
+    cfg = ReplayConfig(n_services=4, n_windows=8, window_us=5_000_000,
+                       chunk_size=256)
+    batch = _rand_spans(500, 4, seed=9)
+    padded, n = stage_columns(batch, cfg, t0_us=0)
+    raw = stage_columns_raw(batch, cfg, t0_us=0)
+    assert n == batch.n_spans
+    dead = dead_chunk(cfg, cfg.chunk_size, xp=np)
+    for k, v in raw.items():
+        flat = padded[k].reshape(-1)
+        np.testing.assert_array_equal(flat[:n], v)        # live rows
+        fill = cfg.sw if k == "sid" else 0
+        assert (flat[n:] == fill).all()                   # pad rows
+        assert (np.asarray(dead[k]) == fill).all()        # one fill def
+        assert flat.dtype == v.dtype
+
+
 def test_serve_cli_emits_report(capsys):
     from anomod.cli import main
     rc = main(["serve", "--tenants", "4", "--services", "4",
