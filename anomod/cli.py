@@ -371,6 +371,12 @@ def main(argv=None) -> int:
     p_serve.add_argument("--fault-tenants", type=int, default=2,
                          help="tenants given a scripted latency fault at "
                               "mid-run (alert latency under load)")
+    p_serve.add_argument("--rca", action="store_true",
+                         help="online root-cause inference in the serve "
+                              "tick: a firing detector queues GNN culprit "
+                              "inference over the tenant's live service "
+                              "graph (anomod.serve.rca; default: "
+                              "ANOMOD_SERVE_RCA)")
     p_serve.add_argument("--no-score", action="store_true",
                          help="replay-plane only (skip per-tenant window "
                               "scoring) — isolates the serving overhead")
@@ -730,6 +736,9 @@ def main(argv=None) -> int:
             parser.error("--shards must be >= 1")
         if args.pipeline is not None and args.pipeline < 1:
             parser.error("--pipeline must be >= 1")
+        if args.rca and args.no_score:
+            parser.error("--rca consumes the detectors' alert stream; "
+                         "it cannot combine with --no-score")
         _probe_backend(args)
         from anomod.serve.batcher import validate_buckets
         from anomod.serve.engine import run_power_law
@@ -770,7 +779,11 @@ def main(argv=None) -> int:
             mesh=mesh, tracer=tracer,
             fuse=False if args.no_fuse else None,
             lane_buckets=lane_buckets, shards=args.shards,
-            pipeline=args.pipeline)
+            pipeline=args.pipeline,
+            # --no-score forces RCA off even when ANOMOD_SERVE_RCA=1
+            # (the explicit CLI ask wins over the env default; the
+            # --rca + --no-score combination already parser.error'd)
+            rca=True if args.rca else (False if args.no_score else None))
         if tracer is not None:
             from pathlib import Path as _P
             tracer.dump(_P(args.trace_out))

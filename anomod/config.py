@@ -215,6 +215,102 @@ def _serve_pipeline_env() -> int:
     return n
 
 
+def _serve_rca_env() -> bool:
+    """ANOMOD_SERVE_RCA: online root-cause inference in the serve tick.
+
+    Default OFF — RCA rides inside the serve SLO, so enabling it is an
+    operator decision.  When on (and scoring is on), a tenant's detector
+    firing queues incremental GNN culprit inference over that tenant's
+    live service graph (anomod.serve.rca); detector states, alerts,
+    admission and shedding are byte-identical either way (RCA is a pure
+    read-side consumer of the alert stream).
+    """
+    return _env("ANOMOD_SERVE_RCA", "0").strip().lower() \
+        not in ("0", "false", "off", "no", "")
+
+
+#: default online-RCA bucket grid: (nodes, sampled neighbors) shapes the
+#: culprit scorer compiles once each (anomod.serve.rca — the same fixed-
+#: shape discipline as the serve width/lane buckets).  A tenant's live
+#: graph pads into the smallest bucket whose node count holds its
+#: service table; neighbor lists sample down (seeded) / dead-pad up to
+#: the bucket's neighbor width.
+DEFAULT_SERVE_RCA_BUCKETS = ((16, 8), (64, 16))
+
+
+def validate_rca_buckets(buckets) -> tuple:
+    """The RCA bucket-grid contract: (nodes, neighbors) int pairs with
+    strictly ascending node counts, every dimension >= 1 — each pair is
+    one compiled executable, so the grid must be small and fixed."""
+    try:
+        out = tuple((int(n), int(k)) for n, k in buckets)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"RCA bucket grid must be (nodes, neighbors) integer pairs, "
+            f"got {buckets!r}")
+    if not out:
+        raise ValueError("RCA bucket grid must not be empty")
+    if any(n < 1 or k < 1 for n, k in out):
+        raise ValueError(f"RCA bucket dims must be >= 1, got {out}")
+    if any(a[0] >= b[0] for a, b in zip(out, out[1:])):
+        raise ValueError(
+            f"RCA bucket node counts must be strictly ascending: {out}")
+    return out
+
+
+def _serve_rca_buckets_env() -> tuple:
+    """ANOMOD_SERVE_RCA_BUCKETS: comma-separated ``NODESxNEIGHBORS``
+    pairs (e.g. ``16x8,64x16``) for the online-RCA scorer's fixed
+    compile grid.  Validated at config construction, same fail-loud
+    contract as ``ANOMOD_SERVE_BUCKETS``.
+    """
+    raw = _env("ANOMOD_SERVE_RCA_BUCKETS", "")
+    if not raw:
+        return DEFAULT_SERVE_RCA_BUCKETS
+    pairs = []
+    for part in (p.strip() for p in raw.split(",") if p.strip()):
+        dims = part.lower().split("x")
+        if len(dims) != 2:
+            raise ValueError(
+                f"ANOMOD_SERVE_RCA_BUCKETS entries must be NODESxNEIGHBORS "
+                f"pairs, got {part!r}")
+        pairs.append(dims)
+    try:
+        return validate_rca_buckets(pairs)
+    except ValueError as e:
+        raise ValueError(f"ANOMOD_SERVE_RCA_BUCKETS: {e}") from e
+
+
+def _serve_rca_int_env(name: str, default: str, lo: int, hi: int) -> int:
+    """Shared validator for the bounded integer RCA knobs."""
+    raw = _env(name, default)
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}")
+    if not lo <= n <= hi:
+        raise ValueError(f"{name} must be in [{lo}, {hi}], got {n}")
+    return n
+
+
+def _serve_rca_topk_env() -> int:
+    """ANOMOD_SERVE_RCA_TOPK: ranked culprit list length per verdict."""
+    return _serve_rca_int_env("ANOMOD_SERVE_RCA_TOPK", "5", 1, 64)
+
+
+def _serve_rca_budget_env() -> int:
+    """ANOMOD_SERVE_RCA_BUDGET: max RCA runs per serve tick — the
+    per-tick SLO budget; alerts past it queue to later ticks (the RCA
+    queue drains FIFO, so verdict order stays deterministic)."""
+    return _serve_rca_int_env("ANOMOD_SERVE_RCA_BUDGET", "4", 1, 4096)
+
+
+def _serve_rca_windows_env() -> int:
+    """ANOMOD_SERVE_RCA_WINDOWS: windowed-feature reach (windows) of the
+    online extractor — also bounds each tenant's RCA span buffer."""
+    return _serve_rca_int_env("ANOMOD_SERVE_RCA_WINDOWS", "8", 2, 128)
+
+
 def _jit_cache_env() -> bool:
     """ANOMOD_JIT_CACHE: persistent XLA compilation cache switch.
 
@@ -321,6 +417,25 @@ class Config:
     # staging under in-flight XLA dispatches, per-slot pinned scratch).
     serve_pipeline: int = dataclasses.field(
         default_factory=_serve_pipeline_env)
+    # ANOMOD_SERVE_RCA — online root-cause inference in the serve tick
+    # (anomod.serve.rca; off = the serving plane stops at alerts).
+    serve_rca: bool = dataclasses.field(default_factory=_serve_rca_env)
+    # ANOMOD_SERVE_RCA_BUCKETS — (nodes, neighbors) compile grid for the
+    # online-RCA culprit scorer (anomod.serve.rca; one XLA compile per
+    # pair, AOT like the serve lane grid).
+    serve_rca_buckets: tuple = dataclasses.field(
+        default_factory=_serve_rca_buckets_env)
+    # ANOMOD_SERVE_RCA_TOPK — ranked culprit list length per verdict.
+    serve_rca_topk: int = dataclasses.field(
+        default_factory=_serve_rca_topk_env)
+    # ANOMOD_SERVE_RCA_BUDGET — max RCA runs per serve tick (queued past
+    # it; the per-tick SLO budget).
+    serve_rca_budget: int = dataclasses.field(
+        default_factory=_serve_rca_budget_env)
+    # ANOMOD_SERVE_RCA_WINDOWS — windowed-feature reach of the online
+    # extractor (also bounds the per-tenant RCA span buffer).
+    serve_rca_windows: int = dataclasses.field(
+        default_factory=_serve_rca_windows_env)
     # ANOMOD_JIT_CACHE — persistent XLA compilation cache under
     # ANOMOD_CACHE_DIR/jit (anomod.utils.platform.enable_jit_cache).
     jit_cache: bool = dataclasses.field(default_factory=_jit_cache_env)

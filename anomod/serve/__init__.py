@@ -13,19 +13,25 @@ engine with per-tenant SLO accounting (engine), a seeded power-law
 traffic generator standing in for the tenant fleet (traffic), and —
 scale-out (ANOMOD_SERVE_SHARDS) — deterministic tenant sharding across
 engine worker threads with pipelined async dispatch (shard), pinned
-identical to the 1-shard engine on the same seed.
+identical to the 1-shard engine on the same seed.  Online RCA
+(ANOMOD_SERVE_RCA): a tenant's detector firing queues incremental GNN
+culprit inference over that tenant's live service graph in a fixed
+AOT-compiled (nodes, neighbors) bucket grid (rca), verdicts deterministic
+per seed and identical at every shard count.
 """
 
 from anomod.serve.batcher import (BucketedStreamReplay, BucketRunner,
                                   split_plan)
 from anomod.serve.engine import ServeEngine, ServeReport, VirtualClock
 from anomod.serve.queues import AdmissionController, QueuedBatch, TenantSpec
+from anomod.serve.rca import OnlineRCA, RCAVerdict, RcaRunner
 from anomod.serve.shard import ShardWorker, plan_shards, rendezvous_shard
 from anomod.serve.traffic import PowerLawTraffic, ScriptedTraffic
 
 __all__ = [
     "AdmissionController", "BucketRunner", "BucketedStreamReplay",
-    "PowerLawTraffic", "QueuedBatch", "ScriptedTraffic", "ServeEngine",
-    "ServeReport", "ShardWorker", "TenantSpec", "VirtualClock",
-    "plan_shards", "rendezvous_shard", "split_plan",
+    "OnlineRCA", "PowerLawTraffic", "QueuedBatch", "RCAVerdict",
+    "RcaRunner", "ScriptedTraffic", "ServeEngine", "ServeReport",
+    "ShardWorker", "TenantSpec", "VirtualClock", "plan_shards",
+    "rendezvous_shard", "split_plan",
 ]

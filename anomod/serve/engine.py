@@ -26,10 +26,18 @@ the coordinator — so an N-shard run's states, alerts and decisions are
 IDENTICAL to the 1-shard engine on the same seed.  Within a shard the
 fused dispatch pipelines (``ANOMOD_SERVE_PIPELINE``): staging of batch
 t+1 overlaps batch t's in-flight XLA dispatch, bit-identically.
+
+Online RCA (``ANOMOD_SERVE_RCA``): a tenant's detector firing queues
+incremental GNN culprit inference over that tenant's live service graph
+(anomod.serve.rca) — budgeted per tick, run on the shard that owns the
+tenant, verdicts folded at the barrier in enqueue order; a pure
+read-side consumer, so every decision above stays byte-identical with
+RCA on or off.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -78,13 +86,13 @@ class _TenantSLO:
     the fold of these private per-tenant digests, with no double counting
     and no second pass over raw samples."""
 
-    def __init__(self):
+    def __init__(self,
+                 hist_name: str = "anomod_serve_admit_to_scored_seconds"):
         self.digest: Optional[TDigest] = None
         self._buf: List[float] = []
         self.n_samples = 0
         self.max_latency_s = 0.0
-        self._obs_hist = obs.histogram(
-            "anomod_serve_admit_to_scored_seconds")
+        self._obs_hist = obs.histogram(hist_name)
 
     def record(self, latency_s: float) -> None:
         self._buf.append(float(latency_s))
@@ -131,11 +139,32 @@ def _merged_quantiles(slos: Sequence[_TenantSLO],
 #: of the shard-determinism contract's exclusion list — shared by the
 #: parity tests (tests/test_serve.py) and the pre-bench fan-out smoke
 #: (scripts/pre_bench_check.py), so the two pins cannot drift apart.
+#: ``rca_latency``/``rca_wall_s`` are wall measurements of the RCA runs;
+#: the verdict STREAM itself (and every other rca_* field) is pinned
+#: identical across shard counts.
 SHARD_VARIANT_REPORT_FIELDS = (
     "serve_wall_s", "sustained_spans_per_sec", "compile_s",
     "lane_compile_s", "fused_dispatches", "lanes_by_bucket",
     "lane_pad_waste", "shards", "pipeline", "shard_tenants",
-    "shard_spans", "shard_imbalance")
+    "shard_spans", "shard_imbalance", "rca_latency", "rca_wall_s")
+
+
+def onset_eligible(window: int, onset_window: int) -> bool:
+    """THE pre-onset-noise eligibility rule, in one place: an alert (or
+    an RCA verdict, via its triggering alert) at absolute window ``w``
+    is attributable to a fault whose onset falls in ``onset_window`` iff
+    ``w >= onset_window`` — the boundary window itself counts (it is the
+    earliest window the fault can influence), anything earlier is noise
+    and must not score as (negative-latency) detection or as an RCA hit.
+    Shared by the golden fault-detection metrics, :meth:`ServeEngine.
+    alerts_for` and the RCA hit accounting so the three paths can never
+    apply different rules."""
+    return window >= onset_window
+
+
+def onset_eligible_alerts(alerts, onset_window: int) -> list:
+    """The alerts that pass :func:`onset_eligible`."""
+    return [a for a in alerts if onset_eligible(a.window, onset_window)]
 
 
 @dataclasses.dataclass
@@ -173,6 +202,13 @@ class ServeReport:
     n_alerts: int
     n_tenants_alerted: int
     fault_detection: Optional[dict]
+    rca_enabled: bool                            # online RCA plane on?
+    n_rca_runs: int                              # alert→culprit inferences
+    rca_topk_hits: Dict[int, int]                # k -> fault tenants hit@k
+    rca_eligible: int                            # fault tenants w/ verdict
+    rca_latency: Dict[str, Optional[float]]      # wall p50/p99 per RCA run
+    rca_alert_to_culprit_s: Dict[str, Optional[float]]  # virtual queue delay
+    rca_wall_s: float                            # total RCA wall
     serve_wall_s: float
     sustained_spans_per_sec: float
 
@@ -190,6 +226,8 @@ class ServeReport:
                               in self.shard_tenants.items()}
         d["shard_spans"] = {str(k): v for k, v
                             in self.shard_spans.items()}
+        d["rca_topk_hits"] = {str(k): v for k, v
+                              in self.rca_topk_hits.items()}
         return d
 
 
@@ -218,7 +256,8 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   fuse: Optional[bool] = None,
                   lane_buckets: Optional[Tuple[int, ...]] = None,
                   shards: Optional[int] = None,
-                  pipeline: Optional[int] = None
+                  pipeline: Optional[int] = None,
+                  rca: Optional[bool] = None
                   ) -> Tuple["ServeEngine", ServeReport]:
     """The canonical seeded serve run shared by ``anomod serve`` and
     ``bench.py --mode serve``: a power-law tenant fleet offering
@@ -246,7 +285,7 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          z_threshold=z_threshold, mesh=mesh,
                          tracer=tracer, fuse=fuse,
                          lane_buckets=lane_buckets, shards=shards,
-                         pipeline=pipeline)
+                         pipeline=pipeline, rca=rca)
     report = engine.run(traffic, duration_s=duration_s)
     return engine, report
 
@@ -267,7 +306,12 @@ class ServeEngine:
                  fuse: Optional[bool] = None,
                  lane_buckets: Optional[Tuple[int, ...]] = None,
                  shards: Optional[int] = None,
-                 pipeline: Optional[int] = None):
+                 pipeline: Optional[int] = None,
+                 rca: Optional[bool] = None,
+                 rca_buckets: Optional[tuple] = None,
+                 rca_topk: Optional[int] = None,
+                 rca_budget: Optional[int] = None,
+                 rca_windows: Optional[int] = None):
         from anomod.config import get_config
         from anomod.utils.platform import enable_jit_cache
         if capacity_spans_per_s <= 0:
@@ -353,6 +397,52 @@ class ServeEngine:
                                        pipeline=self.pipeline)
             self._runners = [self.runner]
         self._workers = None
+        #: online RCA (ANOMOD_SERVE_RCA): when a tenant's detector fires
+        #: inside a tick, incremental GNN culprit inference runs over
+        #: that tenant's live service graph (anomod.serve.rca) on the
+        #: shard that OWNS the tenant, verdicts folding at the barrier
+        #: in enqueue order — a pure read-side consumer of the alert
+        #: stream, so detector states / alerts / admission / SLO / shed
+        #: are byte-identical with RCA on or off.
+        self.rca = bool(app_cfg.serve_rca if rca is None else rca)
+        if self.rca and not self.score:
+            raise ValueError("online RCA consumes the detectors' alert "
+                             "stream; it needs score=True")
+        self.rca_budget = int(app_cfg.serve_rca_budget
+                              if rca_budget is None else rca_budget)
+        if self.rca_budget < 1:
+            raise ValueError("rca_budget must be >= 1 run per tick")
+        self._rca_planes: list = []
+        self._rca_seen: Dict[int, int] = {}
+        self._rca_queue: "collections.deque" = collections.deque()
+        self._rca_seq = 0
+        self.rca_verdicts: list = []
+        self.rca_wall_s = 0.0
+        # metric handles only when the plane is live: an RCA-off run
+        # must not register permanently-zero RCA series in the scrape
+        # journal / exports
+        self._rca_slo = None
+        if self.rca:
+            self._rca_slo = _TenantSLO("anomod_serve_rca_seconds")
+            self._obs_rca_queued = obs.counter(
+                "anomod_serve_rca_queued_total")
+            from anomod.serve.rca import OnlineRCA, RcaRunner
+            _rca_buckets = (rca_buckets if rca_buckets is not None
+                            else app_cfg.serve_rca_buckets)
+            _topk = int(app_cfg.serve_rca_topk if rca_topk is None
+                        else rca_topk)
+            _windows = int(app_cfg.serve_rca_windows
+                           if rca_windows is None else rca_windows)
+            # one plane per shard (shard-private runner + registry, the
+            # BucketRunner discipline); the 1-shard plane records into
+            # the process registry directly
+            _regs = (self._shard_regs if self.shards > 1
+                     else [self._proc_registry])
+            self._rca_planes = [
+                OnlineRCA(self.services, self.cfg.window_us, self.t0_us,
+                          RcaRunner(_rca_buckets, registry=reg),
+                          topk=_topk, windows=_windows)
+                for reg in _regs]
         # tracing is ON by default, gated on the one telemetry switch
         # (ANOMOD_OBS_ENABLED) so "telemetry off" means off end to end;
         # pass an explicit Tracer to force it on regardless
@@ -543,6 +633,31 @@ class ServeEngine:
         for qb in served:
             self._slo[qb.tenant_id].record(now - qb.enqueued_s)
             self.n_spans_served += qb.n_spans
+        if self.rca:
+            # evidence buffering on the COORDINATOR (shard-count-
+            # invariant content), then the alert→culprit pass; both
+            # inside the measured tick wall — RCA rides the serve SLO.
+            # Pruning floors at each tenant's OLDEST queued alert
+            # window, so a budget-delayed run still finds its full
+            # evidence window in the buffer (the determinism contract's
+            # "delayed run scores the same evidence" clause).  THIS
+            # tick's new alerts enqueue BEFORE the floor is computed:
+            # an alert fired across a traffic gap longer than the
+            # evidence window would otherwise have its pre-gap evidence
+            # pruned by the same tick's buffering, before its run sees
+            # it (the enqueue is _rca_seen-guarded, so _rca_tick's own
+            # enqueue pass below stays a no-op for these).
+            self._rca_enqueue(now)
+            floor: Dict[int, int] = {}
+            for _, tid, w, _ in self._rca_queue:
+                floor[tid] = min(floor.get(tid, w), w)
+            for qb in served:
+                plane = self._rca_planes[
+                    self.shard_of.get(qb.tenant_id, 0)
+                    if len(self._rca_planes) > 1 else 0]
+                plane.buffer(qb.tenant_id, qb.spans,
+                             keep_window=floor.get(qb.tenant_id))
+            self._rca_tick(now)
         self.clock.advance()
         # telemetry work stays INSIDE the measured wall: the bench's
         # enabled-vs-off overhead number must price the scrape, not
@@ -734,6 +849,85 @@ class ServeEngine:
                 else:
                     self._replay_for(qb.tenant_id).push(qb.spans)
 
+    # -- the online alert→culprit pass (anomod.serve.rca) -----------------
+
+    def _rca_enqueue(self, now: float) -> None:
+        """Queue one RCA item per (tenant, batch of new alerts) — the
+        ``_rca_seen`` high-water mark makes repeated calls within a
+        tick no-ops, so the tick path may enqueue early (ahead of
+        evidence-buffer pruning) without double-queuing."""
+        for tid in sorted(self._tenant_det):
+            det = self._tenant_det[tid]
+            n = len(det.alerts)
+            seen = self._rca_seen.get(tid, 0)
+            if n > seen:
+                w = max(a.window for a in det.alerts[seen:])
+                self._rca_queue.append((self._rca_seq, tid, w, now))
+                self._rca_seq += 1
+                self._obs_rca_queued.inc()
+                self._rca_seen[tid] = n
+
+    def _rca_tick(self, now: float, budget: Optional[int] = None) -> None:
+        """Enqueue one item per (tenant, tick with new alerts), keyed by
+        the NEWEST new alert window — the verdict's evidence lookback
+        reaches BACK from its anchor, so anchoring at the newest window
+        covers every alert of the batch (a min anchor would exclude a
+        same-batch later-window alert from the evidence, and a pre-onset
+        noise alert sharing the batch with the first real fault alert
+        would mis-anchor the verdict before the onset).  Then drain up
+        to ``budget`` items (default: the per-tick ``rca_budget``) —
+        inline on the 1-shard engine, on the owning shard workers
+        otherwise, verdicts folding at the barrier in enqueue order
+        either way.  A tenant that keeps alerting while earlier items
+        still queue gets a NEW item per tick-batch of alerts (never
+        absorbed into a stale one), so the item set — and therefore the
+        verdict stream — is identical at any budget; the budget moves
+        only ``scored_s``."""
+        self._rca_enqueue(now)
+        if not self._rca_queue:
+            return
+        burst = min(budget if budget is not None else self.rca_budget,
+                    len(self._rca_queue))
+        items = [self._rca_queue.popleft() for _ in range(burst)]
+        with self._span("serve.rca"):
+            if self.shards > 1:
+                from anomod.serve.shard import fold_verdicts, join_all
+                parts: List[list] = [[] for _ in range(self.shards)]
+                for it in items:
+                    parts[self.shard_of[it[1]]].append(it)
+                self._ensure_workers()
+                from functools import partial
+                results: List[list] = [[] for _ in range(self.shards)]
+                submitted = []
+                for s, worker in enumerate(self._workers):
+                    if parts[s]:
+                        worker.submit(partial(self._rca_shard, s, parts[s],
+                                              results[s], now))
+                        submitted.append(worker)
+                join_all(submitted)
+                folded = fold_verdicts(results)
+            else:
+                folded = []
+                self._rca_run_items(self._rca_planes[0], items, folded,
+                                    now)
+        for _, verdict, wall in folded:
+            self.rca_verdicts.append(verdict)
+            self._rca_slo.record(wall)
+            self.rca_wall_s += wall
+
+    def _rca_run_items(self, plane, items: list, out: list,
+                       now: float) -> None:
+        for seq, tid, w, enq in items:
+            det = self._tenant_det.get(tid)
+            alerts = det.alerts if det is not None else []
+            verdict, wall = plane.run(tid, w, alerts, enqueued_s=enq,
+                                      scored_s=now)
+            out.append((seq, verdict, wall))
+
+    def _rca_shard(self, shard_id: int, items: list, out: list,
+                   now: float) -> None:
+        self._rca_run_items(self._rca_planes[shard_id], items, out, now)
+
     def run(self, traffic, duration_s: float,
             warm: bool = True) -> "ServeReport":
         """Drive the engine from a traffic source for ``duration_s``
@@ -759,6 +953,8 @@ class ServeEngine:
                 self.runner.warm()               # compiles outside the wall
                 if self._fused:
                     self.runner.warm_lanes()
+                if self.rca:
+                    self._rca_planes[0].runner.warm()
         n_ticks = max(int(round(duration_s / self.clock.tick_s)), 1)
         mod_src = getattr(traffic, "modality_arrivals", None) \
             if self.multimodal else None
@@ -772,6 +968,16 @@ class ServeEngine:
         if self.score:
             for det in self._tenant_det.values():
                 det.finish()
+        if self.rca:
+            # end-of-run settlement: alerts raised by finish() (the last
+            # window closing) still get culprits, and anything the
+            # per-tick budget deferred drains now — every alert of the
+            # run is answered before the report
+            self._rca_tick(self.clock.now_s, budget=len(self._tenant_det)
+                           + len(self._rca_queue) + 1)
+            while self._rca_queue:
+                self._rca_tick(self.clock.now_s,
+                               budget=len(self._rca_queue))
         self.serve_wall_s += time.perf_counter() - t_wall
         if self.shards > 1:
             # run-end registry fold: shard histograms (lane counts
@@ -790,12 +996,22 @@ class ServeEngine:
         runner.warm()
         if self._fused:
             runner.warm_lanes()
+        if self.rca:
+            self._rca_planes[shard_id].runner.warm()
 
     # -- reporting --------------------------------------------------------
 
-    def alerts_for(self, tenant_id: int):
+    def alerts_for(self, tenant_id: int,
+                   onset_window: Optional[int] = None):
+        """A tenant's alert stream; ``onset_window`` filters it through
+        the ONE pre-onset-noise eligibility rule (:func:`onset_eligible`
+        — shared with the golden fault-detection metrics and the RCA hit
+        accounting, so report consumers cannot apply a different rule)."""
         det = self._tenant_det.get(tenant_id)
-        return list(det.alerts) if det is not None else []
+        alerts = list(det.alerts) if det is not None else []
+        if onset_window is not None:
+            alerts = onset_eligible_alerts(alerts, onset_window)
+        return alerts
 
     def _fault_detection(self, traffic) -> Optional[dict]:
         faults = getattr(traffic, "faults", None)
@@ -809,12 +1025,13 @@ class ServeEngine:
             onset_w = int(fault.onset_s // win_s)
             fw = None
             if det is not None:
-                # only alerts AT or AFTER the onset can be the fault — a
+                # only alerts AT or AFTER the onset can be the fault
+                # (onset_eligible — the shared pre-onset-noise rule): a
                 # pre-onset noise alert on the culprit service must not
                 # count as (negative-latency) detection
-                ws = [a.window for a in det.alerts
-                      if a.service_name == self.services[fault.service]
-                      and a.window >= onset_w]
+                ws = [a.window
+                      for a in onset_eligible_alerts(det.alerts, onset_w)
+                      if a.service_name == self.services[fault.service]]
                 fw = min(ws) if ws else None
             if fw is not None:
                 hits += 1
@@ -825,6 +1042,39 @@ class ServeEngine:
             "median_alert_latency_windows":
                 (float(np.median(lat)) if lat else None),
         }
+
+    def _rca_hits(self, traffic) -> Tuple[Dict[int, int], int]:
+        """Top-k hit counts against the traffic script's injected-fault
+        ground truth: per fault tenant, its FIRST onset-eligible verdict
+        (triggering alert at/after the onset window — the same
+        :func:`onset_eligible` rule the golden fault-detection metrics
+        apply) is checked for the culprit in its top-1/3/5.  With
+        ``serve_rca_topk`` (or the service table) below 5 the ranking is
+        shorter than k and hit@k degrades to hit@len — a conservative
+        UNDERSTATEMENT, never an overstatement."""
+        faults = getattr(traffic, "faults", None) \
+            if traffic is not None else None
+        hits = {1: 0, 3: 0, 5: 0}
+        eligible = 0
+        if not (self.rca and faults):
+            return hits, eligible
+        win_s = self.cfg.window_us / 1e6
+        by_tenant: Dict[int, list] = {}
+        for v in self.rca_verdicts:
+            by_tenant.setdefault(v.tenant_id, []).append(v)
+        for tid, fault in sorted(faults.items()):
+            onset_w = int(fault.onset_s // win_s)
+            vs = [v for v in by_tenant.get(tid, ())
+                  if onset_eligible(v.alert_window, onset_w)]
+            if not vs:
+                continue
+            eligible += 1
+            first = min(vs, key=lambda v: (v.alert_window, v.scored_s))
+            culprit = self.services[fault.service]
+            for k in hits:
+                if culprit in first.services[:k]:
+                    hits[k] += 1
+        return hits, eligible
 
     def report(self, traffic=None) -> ServeReport:
         tot = self.admission.totals()
@@ -876,6 +1126,17 @@ class ServeEngine:
         shard_imbalance = (max(shard_spans.values())
                            / (total_shard_spans / self.shards)
                            if total_shard_spans else 1.0)
+        rca_hits, rca_eligible = self._rca_hits(traffic)
+        delays = [v.scored_s - v.enqueued_s for v in self.rca_verdicts]
+        rca_delay = {
+            q: (round(float(np.quantile(delays, p)), 6) if delays
+                else None)
+            for q, p in (("p50_s", 0.5), ("p99_s", 0.99))}
+        rca_lat = {}
+        for q, p in (("p50_s", 0.5), ("p99_s", 0.99)):
+            got = self._rca_slo.quantile(p) \
+                if self._rca_slo is not None else None
+            rca_lat[q] = round(got, 6) if got is not None else None
         return ServeReport(
             n_tenants=len(self.specs),
             duration_s=round(self.clock.now_s, 6),
@@ -910,6 +1171,13 @@ class ServeEngine:
             n_alerts=n_alerts,
             n_tenants_alerted=n_alerted,
             fault_detection=self._fault_detection(traffic),
+            rca_enabled=self.rca,
+            n_rca_runs=len(self.rca_verdicts),
+            rca_topk_hits=rca_hits,
+            rca_eligible=rca_eligible,
+            rca_latency=rca_lat,
+            rca_alert_to_culprit_s=rca_delay,
+            rca_wall_s=round(self.rca_wall_s, 4),
             serve_wall_s=round(self.serve_wall_s, 4),
             sustained_spans_per_sec=round(
                 self.n_spans_served / max(self.serve_wall_s, 1e-9), 1),

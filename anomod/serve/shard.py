@@ -150,6 +150,18 @@ def plan_shards(specs: Sequence[TenantSpec], n_shards: int,
     return assign
 
 
+def fold_verdicts(parts: Sequence[Sequence[tuple]]) -> List[tuple]:
+    """Barrier fold of per-shard RCA results: each shard worker appends
+    ``(seq, verdict, wall_s)`` tuples for the tenants it owns; merging
+    on ``seq`` (the coordinator's enqueue order) makes the folded stream
+    IDENTICAL to the 1-shard engine's — the RCA half of the shard
+    determinism contract (wall_s legitimately varies; the verdicts carry
+    no wall fields, so byte-comparison holds)."""
+    out = [item for part in parts for item in part]
+    out.sort(key=lambda item: item[0])
+    return out
+
+
 def join_all(workers) -> None:
     """Barrier over submitted workers that COMPLETES before any error
     propagates: raising at the first failed join would leave sibling

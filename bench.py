@@ -146,11 +146,17 @@ def serve_main(probe_fresh=False) -> int:
     sustained rates and the enabled-telemetry overhead fraction
     (acceptance bar: <= 5%; the off leg runs second so it inherits the
     one-time process warmup and the fraction is an upper bound) — and
-    finally with the tenant-FUSED dispatch forced off (telemetry on,
+    then with the tenant-FUSED dispatch forced off (telemetry on,
     its own registry): the ``fused_dispatch`` block reports fused vs
     unfused sustained spans/sec, p99 and shed fraction on the same seed
-    (the unfused leg runs LAST so the speedup is never flattered by
-    warmup order).  The enabled run's scrape journal is exported as a
+    (the unfused leg runs after both headline legs so the speedup is
+    never flattered by warmup order).  After the shard-scaling legs,
+    two ONLINE-RCA legs (1-shard and 2-shard, ``rca=True``, same seed)
+    fill the ``rca`` block: top-k hit-rate (k=1,3,5) against the
+    injected-fault ground truth, alert→culprit latency quantiles, and
+    the determinism pins (RCA-on leaves alerts/states/p99/shed
+    byte-identical; 2-shard verdicts equal 1-shard).  The enabled
+    run's scrape journal is exported as a
     TT-CSV self-scrape capture next to the provenance record and scored
     through the framework's own detector stack (``self_scrape``
     block)."""
@@ -191,7 +197,7 @@ def serve_main(probe_fresh=False) -> int:
         # capture, and it doubles as leg 1 of the shard-scaling table
         reg = Registry(enabled=True)
         prev_reg = set_registry(reg)
-        _, rep = run_power_law(shards=1, **run_kw)
+        eng_head, rep = run_power_law(shards=1, **run_kw)
         set_registry(Registry(enabled=False))
         try:
             _, rep_off = run_power_law(shards=1, **run_kw)
@@ -217,6 +223,15 @@ def serve_main(probe_fresh=False) -> int:
                 set_registry(Registry(enabled=True))
                 _, shard_reps[n_shards] = run_power_law(
                     shards=n_shards, **run_kw)
+            # online-RCA legs (same seed, run LAST so the headline legs
+            # never inherit their warmup): shards=1 with RCA on for the
+            # alert→culprit product numbers, then a 2-shard RCA leg
+            # whose verdict stream must be byte-identical — the capture
+            # records the determinism checks it ran, not just numbers
+            set_registry(Registry(enabled=True))
+            eng_rca, rep_rca = run_power_law(shards=1, rca=True, **run_kw)
+            set_registry(Registry(enabled=True))
+            eng_rca2, _ = run_power_law(shards=2, rca=True, **run_kw)
         finally:
             set_registry(prev_reg)
         set_registry(reg)
@@ -297,6 +312,66 @@ def serve_main(probe_fresh=False) -> int:
                 max(0.0, cold_est * n - (r.compile_s + r.lane_compile_s))
                 for n, r in shard_reps.items()), 4)
             if jit_cache_dir is not None else 0.0,
+        }
+        # online RCA on the same seed: top-k hit-rate against the
+        # traffic script's injected-fault ground truth, alert→culprit
+        # latency (RCA runs in the same wall tick its alert fires, so
+        # the per-run wall IS the alert→culprit wall), and the
+        # determinism pins — RCA-on must leave every detector decision
+        # byte-identical to the RCA-off headline leg, and the 2-shard
+        # verdict stream must equal the 1-shard one
+        import numpy as _np
+        _tids = sorted(set(eng_head._tenant_det) | set(eng_rca._tenant_det))
+        alerts_same = all(eng_head.alerts_for(t) == eng_rca.alerts_for(t)
+                          for t in _tids)
+        states_same = all(
+            t in eng_head._tenant_replay and t in eng_rca._tenant_replay
+            and _np.array_equal(
+                _np.asarray(eng_head._tenant_replay[t].state.agg),
+                _np.asarray(eng_rca._tenant_replay[t].state.agg))
+            and _np.array_equal(
+                _np.asarray(eng_head._tenant_replay[t].state.hist),
+                _np.asarray(eng_rca._tenant_replay[t].state.hist))
+            for t in _tids)
+        n_fault = (rep_rca.fault_detection or {}).get("n_fault_tenants", 0)
+        out["rca"] = {
+            "enabled": True,
+            "n_rca_runs": rep_rca.n_rca_runs,
+            "topk_hits": {str(k): v for k, v
+                          in sorted(rep_rca.rca_topk_hits.items())},
+            "topk_hit_rate": {
+                str(k): (round(v / n_fault, 4) if n_fault else None)
+                for k, v in sorted(rep_rca.rca_topk_hits.items())},
+            # conditional on the detector having fired for the fault
+            # tenant at all — separates RCA ranking quality from the
+            # detection recall ceiling it inherits (a fault tenant whose
+            # spans mostly shed may never alert; that miss belongs to
+            # the detection/shedding story, not to culprit ranking)
+            "topk_hit_rate_given_detected": {
+                str(k): (round(v / rep_rca.rca_eligible, 4)
+                         if rep_rca.rca_eligible else None)
+                for k, v in sorted(rep_rca.rca_topk_hits.items())},
+            "eligible_fault_tenants": rep_rca.rca_eligible,
+            "n_fault_tenants": n_fault,
+            "alert_to_culprit_latency_s": rep_rca.rca_latency,
+            "queue_delay_virtual_s": rep_rca.rca_alert_to_culprit_s,
+            "rca_wall_s": rep_rca.rca_wall_s,
+            "spans_per_sec_rca_on": rep_rca.sustained_spans_per_sec,
+            "rca_overhead_fraction": round(max(
+                0.0, 1.0 - rep_rca.sustained_spans_per_sec
+                / max(rep.sustained_spans_per_sec, 1e-9)), 4),
+            "parity": {
+                "alerts_identical_to_rca_off": alerts_same,
+                "states_identical_to_rca_off": states_same,
+                "p99_identical_to_rca_off":
+                    rep_rca.latency.get("p99_latency_s")
+                    == rep.latency.get("p99_latency_s"),
+                "shed_identical_to_rca_off":
+                    rep_rca.shed_fraction == rep.shed_fraction,
+                "verdicts_identical_1_vs_2_shards":
+                    [v.to_dict() for v in eng_rca.rca_verdicts]
+                    == [v.to_dict() for v in eng_rca2.rca_verdicts],
+            },
         }
         # enabled-vs-off telemetry overhead on the same seed (acceptance
         # bar: <= 5% sustained spans/sec); both rates are steady-state

@@ -15,8 +15,10 @@ Serve mode validates the serve bench's preconditions instead: the
 ``ANOMOD_SERVE_BUCKETS`` / ``ANOMOD_SERVE_MAX_BACKLOG`` env contract must
 parse, and the bucket set must COMPILE (every bucket width traced through
 the shared chunk step on the pinned-CPU backend — a bucket set that can't
-compile would burn the capture window mid-serve).  Exit 3 = serve
-preconditions failed.
+compile would burn the capture window mid-serve).  The online-RCA
+``ANOMOD_SERVE_RCA_BUCKETS`` (nodes, neighbors) grid gets the same
+treatment: every bucket AOT-compiles or the gate fails on the shape
+miss.  Exit 3 = serve preconditions failed.
 
 Both modes FIRST run the env-contract gate
 (``scripts/check_env_contract.py``): every ``ANOMOD_*`` env var read in
@@ -134,6 +136,16 @@ def check_serve() -> int:
                        lane_compile_s=round(lane_compile_s, 3))
             # determinism gate for the bench's shard-scaling legs
             out["shard_smoke"] = _shard_fanout_smoke()
+        # the online-RCA bucket grid (the bench's --rca legs): every
+        # (nodes, neighbors) bucket must AOT-compile — a shape miss here
+        # would stall the capture's alert→culprit path mid-serve
+        from anomod.serve.rca import RcaRunner
+        rca_runner = RcaRunner(cfg.serve_rca_buckets)
+        # warm() compiles every bucket or raises — a shape that cannot
+        # compile fails the gate here, never mid-capture
+        rca_compile_s = rca_runner.warm()
+        out.update(rca_buckets=[list(b) for b in rca_runner.buckets],
+                   rca_compile_s=round(rca_compile_s, 3))
         print(json.dumps(out))
         return 0
     except Exception as e:

@@ -165,6 +165,31 @@ def test_bench_serve_mode_contract(tmp_path):
     assert fd["fused_dispatches"] > 0
     assert fd["lane_buckets"]
     assert 0.0 <= fd["lane_pad_waste"] < 1.0
+    # online-RCA block (ISSUE-6): alert→culprit numbers on the same
+    # seed plus the determinism pins the capture must carry
+    rca = out["rca"]
+    assert rca["enabled"] is True
+    assert rca["n_rca_runs"] > 0
+    assert set(rca["topk_hits"]) == set(rca["topk_hit_rate"]) \
+        == set(rca["topk_hit_rate_given_detected"]) == {"1", "3", "5"}
+    assert rca["n_fault_tenants"] == 2
+    assert 0 <= rca["eligible_fault_tenants"] <= rca["n_fault_tenants"]
+    for k in ("1", "3", "5"):
+        rate = rca["topk_hit_rate"][k]
+        assert rate is not None and 0.0 <= rate <= 1.0
+    # hit-rate is monotone in k by construction
+    assert rca["topk_hit_rate"]["1"] <= rca["topk_hit_rate"]["3"] \
+        <= rca["topk_hit_rate"]["5"]
+    assert rca["alert_to_culprit_latency_s"]["p99_s"] is not None
+    assert rca["queue_delay_virtual_s"]["p50_s"] is not None
+    assert rca["rca_wall_s"] > 0
+    assert rca["spans_per_sec_rca_on"] > 0
+    par = rca["parity"]
+    assert par["alerts_identical_to_rca_off"] is True
+    assert par["states_identical_to_rca_off"] is True
+    assert par["p99_identical_to_rca_off"] is True
+    assert par["shed_identical_to_rca_off"] is True
+    assert par["verdicts_identical_1_vs_2_shards"] is True
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,116 @@
+"""anomod.rca_features: the ONE windowed-feature definition shared by the
+offline RCA harness (anomod.rca) and the online serve-tick RCA plane
+(anomod.serve.rca) — offline batch extraction and online single-graph
+extraction must be bit-exact on the same spans, forever."""
+
+import numpy as np
+
+from anomod.graph import build_service_graph
+from anomod.replay import ReplayConfig
+from anomod.schemas import SpanBatch
+
+
+def _spans_with_calls(n, n_services, seed, t_span_s=60.0):
+    """A batch with real parent links (cross-service calls), so the edge
+    feature paths and the live service graph are non-trivial."""
+    rng = np.random.default_rng(seed)
+    svc = rng.integers(0, n_services, n).astype(np.int32)
+    parent = np.full(n, -1, np.int32)
+    # every second span is a child of the previous span (cross-service
+    # where the services differ)
+    parent[1::2] = np.arange(0, n - 1, 2, dtype=np.int32)
+    err = rng.random(n) < 0.05
+    return SpanBatch(
+        trace=rng.integers(0, 16, n).astype(np.int32),
+        parent=parent,
+        service=svc,
+        endpoint=np.zeros(n, np.int32),
+        start_us=np.sort(rng.integers(0, int(t_span_s * 1e6),
+                                      n)).astype(np.int64),
+        duration_us=rng.integers(1, 1_000_000, n).astype(np.int64),
+        is_error=err.astype(np.bool_),
+        status=np.where(err, 500, 200).astype(np.int16),
+        kind=np.zeros(n, np.int8),
+        services=tuple(f"s{i}" for i in range(n_services)),
+        endpoints=("e",),
+        trace_ids=tuple(f"t{i:02d}" for i in range(16))).validate()
+
+
+def test_offline_and_online_paths_share_one_definition():
+    """The offline harness's underscore names must BE the shared module's
+    functions (import-level identity, not copies that could drift)."""
+    from anomod import rca, rca_features
+    assert rca._windowed_features is rca_features.windowed_features
+    assert rca._edge_feature_block is rca_features.edge_feature_block
+
+
+def test_windowed_features_offline_vs_online_bit_exact():
+    """The online extractor (anomod.serve.rca.online_node_features) rides
+    windowed_features; its windowed block must be byte-identical to what
+    the offline batch path computes on the same spans."""
+    from anomod.rca import _windowed_features
+    from anomod.rca_features import windowed_features
+    services = tuple(f"s{i}" for i in range(5))
+    cfg = ReplayConfig(n_services=5, n_windows=8, window_us=5_000_000,
+                       chunk_size=1024)
+    batch = _spans_with_calls(600, 5, seed=11)
+    off = _windowed_features(batch, services, cfg)
+    on = windowed_features(batch, services, cfg)
+    assert off.dtype == np.float32 and off.shape == (5, 8, 4)
+    assert off.tobytes() == on.tobytes()
+    # the edge-feature variant too (the link-fault evidence channel)
+    off8 = _windowed_features(batch, services, cfg, edge_features=True)
+    on8 = windowed_features(batch, services, cfg, edge_features=True)
+    assert off8.shape == (5, 8, 8)
+    assert off8.tobytes() == on8.tobytes()
+
+
+def test_edge_feature_block_offline_vs_online_bit_exact():
+    from anomod.rca import _edge_feature_block
+    from anomod.rca_features import edge_feature_block
+    services = tuple(f"s{i}" for i in range(5))
+    cfg = ReplayConfig(n_services=5, n_windows=8, window_us=5_000_000,
+                       chunk_size=1024)
+    batch = _spans_with_calls(600, 5, seed=13)
+    g = build_service_graph(batch, services=services)
+    assert g.n_edges > 0
+    off = _edge_feature_block(batch, services, g, cfg)
+    on = edge_feature_block(batch, services, g, cfg)
+    assert off.shape == (g.n_edges, 8, 4)
+    assert off.tobytes() == on.tobytes()
+
+
+def test_online_node_features_reduce_windowed_block():
+    """The serve-tick feature vector is a pure reduction of the shared
+    windowed block: per-window means + recent-vs-early trends."""
+    from anomod.rca_features import windowed_features
+    from anomod.serve.rca import online_node_features
+    services = tuple(f"s{i}" for i in range(5))
+    cfg = ReplayConfig(n_services=5, n_windows=8, window_us=5_000_000,
+                       chunk_size=1024)
+    batch = _spans_with_calls(600, 5, seed=17)
+    x = online_node_features(batch, services, cfg)
+    wf = windowed_features(batch, services, cfg)
+    q = cfg.n_windows // 4
+    want = np.concatenate(
+        [wf.mean(axis=1), wf[:, -q:].mean(axis=1) - wf[:, :q].mean(axis=1)],
+        axis=-1).astype(np.float32)
+    assert x.tobytes() == want.tobytes()
+    # no spans = a well-shaped zero block, never a crash
+    z = online_node_features(None, services, cfg)
+    assert z.shape == (5, 8) and not z.any()
+
+
+def test_pad_edge_arrays_contract():
+    from anomod.rca_features import pad_edge_arrays
+    import pytest
+    services = tuple(f"s{i}" for i in range(5))
+    batch = _spans_with_calls(600, 5, seed=19)
+    g = build_service_graph(batch, services=services)
+    src, dst, mask = pad_edge_arrays(g, g.n_edges + 3)
+    assert src.shape == (g.n_edges + 3,) and mask.sum() == g.n_edges
+    assert np.array_equal(src[:g.n_edges], g.edge_src)
+    assert np.array_equal(dst[:g.n_edges], g.edge_dst)
+    assert not mask[g.n_edges:].any()
+    with pytest.raises(ValueError, match="edges"):
+        pad_edge_arrays(g, g.n_edges - 1)

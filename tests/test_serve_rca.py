@@ -1,0 +1,318 @@
+"""Online RCA in the serve tick (anomod.serve.rca): determinism pins.
+
+The contract the ISSUE-6 subsystem ships under:
+
+- VERDICTS: byte-identical across reruns of the same seed and across
+  1-shard vs 2-shard runs (the sampler is seeded by (tenant, alert
+  window) alone and evidence is anchored to the triggering alert
+  window).
+- NON-INTERFERENCE: RCA on vs off leaves detector states, alerts, SLO
+  quantiles and shed decisions byte-identical (RCA is a pure read-side
+  consumer of the alert stream).
+- COMPILE: exactly one XLA compile per (nodes, neighbors) RCA bucket
+  over a sustained run, pinned via the registry compile counters.
+- ONSET RULE: golden metrics, ``alerts_for`` and RCA hit accounting all
+  apply the ONE ``onset_eligible`` rule — an alert exactly AT the onset
+  boundary window counts.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from anomod.serve.engine import (SHARD_VARIANT_REPORT_FIELDS, ServeEngine,
+                                 onset_eligible, onset_eligible_alerts,
+                                 run_power_law)
+
+#: the fields the RCA plane adds that legitimately differ between an
+#: RCA-on and an RCA-off run of the same seed (everything else in the
+#: report must be byte-identical between the two)
+_RCA_ONLY_FIELDS = ("rca_enabled", "n_rca_runs", "rca_topk_hits",
+                    "rca_eligible", "rca_latency",
+                    "rca_alert_to_culprit_s", "rca_wall_s")
+
+_RUN_KW = dict(n_tenants=8, n_services=6, capacity_spans_per_s=2000,
+               overload=2.0, duration_s=60, tick_s=1.0, seed=3,
+               window_s=5.0, baseline_windows=4, fault_tenants=2,
+               buckets=(64, 256), lane_buckets=(1, 2, 4),
+               max_backlog=3000, n_windows=16)
+
+
+def _verdict_dicts(engine):
+    return [v.to_dict() for v in engine.rca_verdicts]
+
+
+def test_rca_emits_verdicts_and_hits_injected_culprit():
+    """The product smoke: under the seeded overload run with scripted
+    latency faults, every fault tenant gets an onset-eligible verdict
+    and the injected culprit ranks top-1."""
+    from anomod.utils.tracing import Tracer
+    tracer = Tracer("anomod-serve")
+    eng, rep = run_power_law(shards=1, rca=True, tracer=tracer, **_RUN_KW)
+    assert rep.rca_enabled is True
+    assert "serve.rca" in {s["operationName"]
+                           for s in tracer.to_jaeger()["data"][0]["spans"]}
+    assert rep.n_rca_runs == len(eng.rca_verdicts) > 0
+    assert rep.rca_eligible == rep.fault_detection["n_fault_tenants"] == 2
+    assert rep.rca_topk_hits[1] == 2          # culprit ranks first
+    assert rep.rca_topk_hits[3] == rep.rca_topk_hits[5] == 2
+    assert rep.rca_latency["p99_s"] is not None
+    assert rep.rca_alert_to_culprit_s["p50_s"] is not None
+    assert rep.rca_wall_s > 0
+    for v in eng.rca_verdicts:
+        assert len(v.services) == len(v.scores) <= 5
+        assert v.scored_s >= v.enqueued_s
+        assert v.bucket[0] >= 6
+    d = rep.to_dict()
+    import json
+    json.dumps(d)
+    assert set(d["rca_topk_hits"]) == {"1", "3", "5"}
+
+
+def test_rca_verdicts_byte_identical_across_reruns():
+    eng_a, _ = run_power_law(shards=1, rca=True, **_RUN_KW)
+    eng_b, _ = run_power_law(shards=1, rca=True, **_RUN_KW)
+    assert _verdict_dicts(eng_a) == _verdict_dicts(eng_b)
+
+
+def test_rca_verdicts_byte_identical_1_vs_2_shards():
+    """RCA runs on the shard that owns the tenant; the barrier fold in
+    enqueue order makes the N-shard verdict stream identical to the
+    1-shard engine's — and the rest of the report stays pinned too."""
+    eng1, rep1 = run_power_law(shards=1, rca=True, **_RUN_KW)
+    eng2, rep2 = run_power_law(shards=2, rca=True, **_RUN_KW)
+    assert _verdict_dicts(eng1) == _verdict_dicts(eng2)
+    skip = set(SHARD_VARIANT_REPORT_FIELDS)
+    a = {k: v for k, v in rep1.to_dict().items() if k not in skip}
+    b = {k: v for k, v in rep2.to_dict().items() if k not in skip}
+    assert a == b, sorted(k for k in a if a[k] != b[k])
+
+
+def test_rca_on_off_leaves_decisions_byte_identical():
+    """RCA is a read-side consumer: detector states, alert streams,
+    SLO quantiles, admission and shed are untouched by enabling it."""
+    eng_off, rep_off = run_power_law(shards=1, rca=False, **_RUN_KW)
+    eng_on, rep_on = run_power_law(shards=1, rca=True, **_RUN_KW)
+    assert rep_off.rca_enabled is False and rep_off.n_rca_runs == 0
+    for tid in sorted(set(eng_off._tenant_det) | set(eng_on._tenant_det)):
+        assert [dataclasses.asdict(a) for a in eng_off.alerts_for(tid)] \
+            == [dataclasses.asdict(a) for a in eng_on.alerts_for(tid)]
+        s0 = eng_off._tenant_replay[tid].state
+        s1 = eng_on._tenant_replay[tid].state
+        assert np.array_equal(np.asarray(s0.agg), np.asarray(s1.agg))
+        assert np.array_equal(np.asarray(s0.hist), np.asarray(s1.hist))
+    skip = set(SHARD_VARIANT_REPORT_FIELDS) | set(_RCA_ONLY_FIELDS)
+    a = {k: v for k, v in rep_off.to_dict().items() if k not in skip}
+    b = {k: v for k, v in rep_on.to_dict().items() if k not in skip}
+    assert a == b, sorted(k for k in a if a[k] != b[k])
+    # the headline decision numbers, spelled out
+    assert rep_off.shed_fraction == rep_on.shed_fraction
+    assert rep_off.latency == rep_on.latency
+
+
+def test_rca_budget_queues_and_settles_deterministically():
+    """A 1-run-per-tick budget defers inference without changing any
+    verdict: evidence anchors to the triggering alert window, so the
+    delayed stream carries the same rankings with later scored_s."""
+    # squeeze the budget via the engine ctor (run_power_law has no
+    # budget knob — drive the engine directly)
+    from anomod.serve.traffic import PowerLawTraffic, TenantFault
+    from anomod.serve.engine import serve_plane_cfg
+    onset_s = (4 + 2) * 5.0
+    faults = {t: TenantFault("latency", service=1, onset_s=onset_s,
+                             factor=10.0) for t in range(2)}
+    def go(budget):
+        traffic = PowerLawTraffic(
+            n_tenants=8, total_rate_spans_per_s=4000, alpha=1.2, seed=3,
+            n_services=6, faults=faults)
+        eng = ServeEngine(traffic.specs, traffic.services,
+                          serve_plane_cfg(6, 5.0, 16),
+                          capacity_spans_per_s=2000, tick_s=1.0,
+                          buckets=(64, 256), lane_buckets=(1, 2, 4),
+                          max_backlog=3000, baseline_windows=4,
+                          rca=True, rca_budget=budget)
+        return eng, eng.run(traffic, duration_s=60.0)
+    wide, rep_wide = go(budget=64)
+    tight, rep_tight = go(budget=1)
+    strip = lambda vs: [{k: v for k, v in d.items() if k != "scored_s"}
+                        for d in vs]
+    # the ITEM SET is budget-invariant: alerts firing while earlier
+    # items still queue get their OWN item (never absorbed into a stale
+    # one), so only scored_s moves — hit accounting included
+    assert strip(_verdict_dicts(wide)) == strip(_verdict_dicts(tight))
+    assert rep_wide.rca_topk_hits == rep_tight.rca_topk_hits
+    assert rep_wide.rca_eligible == rep_tight.rca_eligible
+    # the tight budget genuinely deferred at least one run
+    assert max(v.scored_s - v.enqueued_s for v in tight.rca_verdicts) \
+        >= max(v.scored_s - v.enqueued_s for v in wide.rca_verdicts)
+
+
+def test_rca_alert_across_traffic_gap_keeps_pregap_evidence():
+    """An alert that fires across a tenant traffic gap longer than the
+    evidence window must still score its pre-gap evidence.  A faulted
+    window left OPEN when the tenant's feed pauses closes at resume —
+    anchored at the pre-gap window while the buffer's high-water mark
+    jumps past the gap.  This tick's alerts enqueue BEFORE the evidence
+    buffer prunes, so the pruning floor covers the new alert's reach
+    (regression: the floor was computed from the queue before enqueue,
+    and the resume tick's buffering dropped every pre-gap span first —
+    the verdict then scored on an empty evidence window, n_spans=0)."""
+    from anomod.serve.engine import serve_plane_cfg
+    from anomod.serve.traffic import PowerLawTraffic, TenantFault
+
+    gap_lo_s, gap_hi_s = 27.0, 55.0     # 28 s >> (windows+1) * 5 s
+
+    class GapTraffic:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def arrivals(self, lo, hi):
+            return [(tid, b) for tid, b in self.inner.arrivals(lo, hi)
+                    if not (tid == 0 and gap_lo_s <= lo < gap_hi_s)]
+
+    faults = {0: TenantFault("latency", service=1, onset_s=25.0,
+                             factor=10.0)}
+    traffic = GapTraffic(PowerLawTraffic(
+        n_tenants=2, total_rate_spans_per_s=800, alpha=0.0, seed=3,
+        n_services=6, faults=faults))
+    eng = ServeEngine(traffic.inner.specs, traffic.inner.services,
+                      serve_plane_cfg(6, 5.0, 16),
+                      capacity_spans_per_s=2000, tick_s=1.0,
+                      buckets=(64, 256), lane_buckets=(1, 2),
+                      max_backlog=5000, baseline_windows=4,
+                      rca=True, rca_windows=3)
+    eng.run(traffic, duration_s=65.0)
+    # the faulted window (5 = [25, 30) s) closed at resume: its alert
+    # trails the newest buffered span by the whole gap
+    pregap = [v for v in eng.rca_verdicts
+              if v.tenant_id == 0 and v.alert_window == 5]
+    assert len(pregap) == 1
+    assert pregap[0].enqueued_s >= gap_hi_s          # fired at resume
+    assert pregap[0].n_spans > 0                     # evidence survived
+    assert pregap[0].services[0] == "svc01"          # and localizes
+
+
+def test_rca_compile_count_pin():
+    """Exactly one XLA compile per (nodes, neighbors) RCA bucket over a
+    sustained run, via the registry compile counters — and only the
+    bucket the service table lands in ever executes."""
+    from anomod.obs.registry import Registry, set_registry
+    reg = Registry(enabled=True)
+    prev = set_registry(reg)
+    try:
+        eng, rep = run_power_law(shards=1, rca=True, **_RUN_KW)
+        runner = eng._rca_planes[0].runner
+        assert runner.bucket_shapes == set(runner.buckets)
+        assert reg.counter("anomod_serve_rca_compile_total").value \
+            == len(runner.buckets)
+        assert reg.counter("anomod_serve_rca_runs_total").value \
+            == rep.n_rca_runs > 0
+        # every run used the one bucket that holds the 6-service table
+        assert set(runner.runs_by_bucket) == {runner.bucket_for(6)}
+        assert reg.histogram("anomod_serve_rca_seconds").count \
+            == rep.n_rca_runs
+    finally:
+        set_registry(prev)
+
+
+def test_rca_env_knobs_registered_and_validated(monkeypatch):
+    from anomod.config import Config
+    monkeypatch.setenv("ANOMOD_SERVE_RCA", "1")
+    monkeypatch.setenv("ANOMOD_SERVE_RCA_BUCKETS", "8x4, 32x8")
+    monkeypatch.setenv("ANOMOD_SERVE_RCA_TOPK", "3")
+    monkeypatch.setenv("ANOMOD_SERVE_RCA_BUDGET", "2")
+    monkeypatch.setenv("ANOMOD_SERVE_RCA_WINDOWS", "6")
+    cfg = Config()
+    assert cfg.serve_rca is True
+    assert cfg.serve_rca_buckets == ((8, 4), (32, 8))
+    assert cfg.serve_rca_topk == 3
+    assert cfg.serve_rca_budget == 2
+    assert cfg.serve_rca_windows == 6
+    for var, bad in (("ANOMOD_SERVE_RCA_BUCKETS", "32x8,8x4"),
+                     ("ANOMOD_SERVE_RCA_BUCKETS", "banana"),
+                     ("ANOMOD_SERVE_RCA_BUCKETS", "8x0"),
+                     ("ANOMOD_SERVE_RCA_TOPK", "0"),
+                     ("ANOMOD_SERVE_RCA_BUDGET", "none"),
+                     ("ANOMOD_SERVE_RCA_WINDOWS", "1")):
+        monkeypatch.setenv(var, bad)
+        with pytest.raises(ValueError, match=var):
+            Config()
+        monkeypatch.delenv(var)
+    assert Config().serve_rca is True     # the enable flag survived
+    monkeypatch.delenv("ANOMOD_SERVE_RCA")
+    from anomod.config import DEFAULT_SERVE_RCA_BUCKETS
+    cfg = Config()
+    assert cfg.serve_rca is False
+    assert cfg.serve_rca_buckets == DEFAULT_SERVE_RCA_BUCKETS
+
+
+def test_rca_requires_scoring_and_bucket_capacity():
+    from anomod.serve.queues import TenantSpec
+    from anomod.replay import ReplayConfig
+    specs = [TenantSpec(tenant_id=0, name="t0", priority=0,
+                        rate_spans_per_s=10.0)]
+    services = tuple(f"s{i}" for i in range(4))
+    cfg = ReplayConfig(n_services=4, n_windows=16, window_us=5_000_000,
+                       chunk_size=1024)
+    with pytest.raises(ValueError, match="score"):
+        ServeEngine(specs, services, cfg, score=False, rca=True)
+    with pytest.raises(ValueError, match="bucket"):
+        ServeEngine(specs, services, cfg, rca=True,
+                    rca_buckets=((2, 2),))
+
+
+# ---------------------------------------------------------------------------
+# the ONE onset-eligibility rule (golden metrics / alerts_for / RCA hits)
+# ---------------------------------------------------------------------------
+
+def test_onset_boundary_alert_counts_everywhere():
+    """An alert exactly AT the onset window is eligible (>=, not >) —
+    in the helper, in alerts_for's filter, in the golden fault-detection
+    metrics, and in the RCA hit accounting."""
+    from anomod.stream import Alert
+    assert onset_eligible(7, 7) is True
+    assert onset_eligible(6, 7) is False
+    mk = lambda w: Alert(window=w, service=1, service_name="svc01",
+                         score=5.0, z_latency=5.0, z_error=0.0,
+                         z_drop=0.0)
+    alerts = [mk(6), mk(7), mk(9)]
+    assert [a.window for a in onset_eligible_alerts(alerts, 7)] == [7, 9]
+
+    class _Traffic:
+        pass
+
+    from anomod.serve.traffic import TenantFault
+    eng, rep = run_power_law(shards=1, rca=True, **_RUN_KW)
+    # the scripted fault's onset window for this run
+    fault = TenantFault("latency", service=1,
+                        onset_s=(4 + 2) * 5.0, factor=10.0)
+    onset_w = int(fault.onset_s // 5.0)
+    det = eng._tenant_det[0]
+    # plant a pre-onset noise alert AND a boundary alert on the culprit
+    planted = [mk(onset_w - 1), mk(onset_w)]
+    det.alerts[:0] = planted
+    try:
+        # alerts_for honors the same rule
+        got = eng.alerts_for(0, onset_window=onset_w)
+        assert planted[0] not in got and planted[1] in got
+        tr = _Traffic()
+        tr.faults = {0: fault}
+        fd = eng._fault_detection(tr)
+        # the boundary alert is the detection: latency 0 windows, never
+        # the pre-onset one (which would read -1)
+        assert fd["n_detected"] == 1
+        assert fd["median_alert_latency_windows"] == 0.0
+        # RCA hit accounting applies the identical rule to the verdict's
+        # triggering alert window
+        eng.rca_verdicts = [dataclasses.replace(
+            v, alert_window=onset_w - 1) for v in eng.rca_verdicts
+            if v.tenant_id == 0][:1]
+        hits, eligible = eng._rca_hits(tr)
+        assert eligible == 0 and hits == {1: 0, 3: 0, 5: 0}
+        eng.rca_verdicts = [dataclasses.replace(
+            v, alert_window=onset_w) for v in eng.rca_verdicts]
+        hits, eligible = eng._rca_hits(tr)
+        assert eligible == 1
+    finally:
+        del det.alerts[:2]
