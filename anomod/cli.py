@@ -377,6 +377,10 @@ def main(argv=None) -> int:
                               "inference over the tenant's live service "
                               "graph (anomod.serve.rca; default: "
                               "ANOMOD_SERVE_RCA)")
+    p_serve.add_argument("--no-native", action="store_true",
+                         help="disable the GIL-free C++ lane staging for "
+                              "this run: the interpreter fill, as before "
+                              "ANOMOD_NATIVE (byte-identical output)")
     p_serve.add_argument("--no-score", action="store_true",
                          help="replay-plane only (skip per-tenant window "
                               "scoring) — isolates the serving overhead")
@@ -780,6 +784,7 @@ def main(argv=None) -> int:
             fuse=False if args.no_fuse else None,
             lane_buckets=lane_buckets, shards=args.shards,
             pipeline=args.pipeline,
+            native=False if args.no_native else None,
             # --no-score forces RCA off even when ANOMOD_SERVE_RCA=1
             # (the explicit CLI ask wins over the env default; the
             # --rca + --no-score combination already parser.error'd)
@@ -1022,8 +1027,14 @@ def main(argv=None) -> int:
                 cache_stats = ingest_cache.stats().to_dict()
             except Exception:
                 cache_stats = ingest_cache.CacheStats().to_dict()
-        print(json.dumps(corpus_summary(
-            args.testbed, reports, cache_stats=cache_stats), indent=2))
+        summary = corpus_summary(args.testbed, reports,
+                                 cache_stats=cache_stats)
+        # native-runtime health rides the validation document: the knob
+        # value, availability, and — the part a silent fallback hides —
+        # the recorded build-failure reason when the .so is unusable
+        from anomod.io import native as native_io
+        summary["native"] = native_io.status()
+        print(json.dumps(summary, indent=2))
         return 0
 
     if args.cmd == "campaign":
@@ -1153,7 +1164,7 @@ def main(argv=None) -> int:
         print(json.dumps({
             "dir": str(root), "n_files": len(paths),
             "n_lfs_stubs": len(candidates) - len(paths),
-            "native": native.available(),
+            "native": native.enabled(),
             "totals": {
                 "lines": sum(s.n_lines for s in summaries),
                 "errors": sum(s.n_error for s in summaries),

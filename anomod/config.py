@@ -215,6 +215,34 @@ def _serve_pipeline_env() -> int:
     return n
 
 
+def _serve_lane_engine_env() -> str:
+    """ANOMOD_SERVE_LANE_ENGINE: the serving plane's fused lane-dispatch
+    formulation (anomod.replay.make_lane_delta).
+
+    ``auto`` (the default) follows :func:`anomod.replay.
+    default_step_engine` — scatter on XLA:CPU, the one-hot matmul on
+    accelerators — so the fused path stays BIT-identical to the
+    single-chunk step on every backend and the serving plane's
+    fused==sequential parity pins hold unconditionally.  ``pallas`` is
+    the deliberate TPU opt-in: the whole per-lane score chain as ONE
+    Mosaic kernel launch per fused shape (ops.pallas_replay.
+    make_pallas_lane_delta_fn) — alert/histogram planes exact vs the
+    other engines, latency moments within the bf16 hi/lo envelope (the
+    compiled-replay tolerance contract), which is exactly why it is NOT
+    the hands-off default.  ``matmul``/``scatter`` pin one exact
+    formulation explicitly.  Validated here so a typo fails loudly at
+    config construction instead of silently serving the wrong kernel.
+    """
+    raw = _env("ANOMOD_SERVE_LANE_ENGINE", "auto").strip().lower()
+    if raw in ("auto", ""):
+        return "auto"
+    if raw in ("matmul", "scatter", "pallas"):
+        return raw
+    raise ValueError(
+        "ANOMOD_SERVE_LANE_ENGINE must be auto, matmul, scatter or "
+        f"pallas, got {raw!r}")
+
+
 def _serve_rca_env() -> bool:
     """ANOMOD_SERVE_RCA: online root-cause inference in the serve tick.
 
@@ -309,6 +337,31 @@ def _serve_rca_windows_env() -> int:
     """ANOMOD_SERVE_RCA_WINDOWS: windowed-feature reach (windows) of the
     online extractor — also bounds each tenant's RCA span buffer."""
     return _serve_rca_int_env("ANOMOD_SERVE_RCA_WINDOWS", "8", 2, 128)
+
+
+def _native_env() -> str:
+    """ANOMOD_NATIVE: the C++ native runtime switch (anomod.io.native) —
+    ingest scanning AND the serving plane's GIL-free lane staging.
+
+    ``auto`` (the default) uses the native .so when it loads (building it
+    on first use if a toolchain is present) and degrades to the pure-
+    Python paths otherwise; ``on`` (``1``) REQUIRES it — the first native
+    consumer raises with the recorded build-failure reason instead of
+    silently serving the slow path, and ``anomod validate`` /
+    ``scripts/pre_bench_check.py --mode serve`` surface the same reason
+    (exit 5 on a requested-but-unusable runtime); ``off`` (``0``) forces
+    the pure-Python paths even when the .so is fine.  Validated here so a
+    typo fails loudly at config construction.
+    """
+    raw = _env("ANOMOD_NATIVE", "auto").strip().lower()
+    if raw in ("auto", ""):
+        return "auto"
+    if raw in ("1", "on", "true", "yes"):
+        return "on"
+    if raw in ("0", "off", "false", "no"):
+        return "off"
+    raise ValueError(
+        f"ANOMOD_NATIVE must be auto, on/1 or off/0, got {raw!r}")
 
 
 def _jit_cache_env() -> bool:
@@ -417,6 +470,11 @@ class Config:
     # staging under in-flight XLA dispatches, per-slot pinned scratch).
     serve_pipeline: int = dataclasses.field(
         default_factory=_serve_pipeline_env)
+    # ANOMOD_SERVE_LANE_ENGINE — fused lane-dispatch formulation: auto
+    # (= the step engine, bit-parity backend-stable), pallas (single
+    # Mosaic kernel, TPU opt-in), matmul/scatter (explicit pin).
+    serve_lane_engine: str = dataclasses.field(
+        default_factory=_serve_lane_engine_env)
     # ANOMOD_SERVE_RCA — online root-cause inference in the serve tick
     # (anomod.serve.rca; off = the serving plane stops at alerts).
     serve_rca: bool = dataclasses.field(default_factory=_serve_rca_env)
@@ -436,6 +494,10 @@ class Config:
     # extractor (also bounds the per-tenant RCA span buffer).
     serve_rca_windows: int = dataclasses.field(
         default_factory=_serve_rca_windows_env)
+    # ANOMOD_NATIVE — C++ native runtime switch: auto (use when the .so
+    # loads), on (required, fail loud with the build reason), off
+    # (pure-Python paths; anomod.io.native).
+    native: str = dataclasses.field(default_factory=_native_env)
     # ANOMOD_JIT_CACHE — persistent XLA compilation cache under
     # ANOMOD_CACHE_DIR/jit (anomod.utils.platform.enable_jit_cache).
     jit_cache: bool = dataclasses.field(default_factory=_jit_cache_env)

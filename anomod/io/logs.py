@@ -81,7 +81,7 @@ def parse_log_lines(text: str, service_idx: int,
     Dispatches to the C++ scanner (anomod.io.native) when built; the Python
     path below is the behavioral oracle."""
     from anomod.io import native
-    if native.available():
+    if native.enabled():
         res = native.scan_log(text.encode("utf-8", errors="replace"))
         if res is not None:
             lvl, t = res
@@ -118,7 +118,7 @@ def summarize_log_files(paths: List[Path],
     """
     from anomod.io import native
     paths = [Path(p) for p in paths]
-    if native.available():
+    if native.enabled():
         res = native.summarize_log_files(paths)
         if res is not None:
             counts, _ts = res

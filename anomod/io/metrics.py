@@ -121,7 +121,7 @@ def load_tt_metric_csv(path: Path) -> Optional[MetricBatch]:
     header = raw.split(b"\n", 1)[0].decode(errors="replace").strip().split(",")
     if header[:4] == ["metric_name", "timestamp", "datetime", "value"]:
         from anomod.io import native
-        if native.available():
+        if native.enabled():
             num = native.scan_csv_columns(raw, [1, 3])
     # Validate the fast path before trusting it: the C++ scanner is
     # line-based, so quoted fields with embedded newlines (or whitespace-only

@@ -68,12 +68,18 @@ def _build_rhs_t(planes, block, n_hist):
 
 
 def _recombine_moments(acc, n_segments):
-    """Shared epilogue: recombine hi+lo moment rows, drop the dead lane,
-    transpose back to [SW, F+H]."""
+    """Shared epilogue: recombine hi+lo moment rows, drop the dead-pad
+    segment, transpose the row axis back behind the segment axis —
+    ``[ROWS, SW+1] -> [SW, F+H]``, or batched ``[L, ROWS, SW+1] ->
+    [L, SW, F+H]`` for the lane-stacked kernel.  The bf16 hi/lo split
+    layout (3 exact + 3 hi + 3 lo + H histogram rows) is encoded HERE
+    and in the kernels' rhs staging only."""
     import jax.numpy as jnp
 
-    agg_t = jnp.concatenate([acc[0:3], acc[3:6] + acc[6:9], acc[9:]], axis=0)
-    return agg_t.T[:n_segments]
+    agg_t = jnp.concatenate(
+        [acc[..., 0:3, :], acc[..., 3:6, :] + acc[..., 6:9, :],
+         acc[..., 9:, :]], axis=-2)
+    return jnp.swapaxes(agg_t, -1, -2)[..., :n_segments, :]
 
 
 def make_pallas_replay_fn(n_segments: int, n_hist: int = 16,
@@ -132,6 +138,77 @@ def make_pallas_replay_fn(n_segments: int, n_hist: int = 16,
             ],
             out_specs=pl.BlockSpec((ROWS, SW1), lambda r, i: (0, 0)),
             out_shape=jax.ShapeDtypeStruct((ROWS, SW1), jnp.float32),
+            compiler_params=_compiler_params(
+                dimension_semantics=("arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(sid, planes)
+        return _recombine_moments(acc, n_segments)
+
+    return run
+
+
+def make_pallas_lane_delta_fn(n_segments: int, n_hist: int = 16,
+                              block: int = 0, interpret: bool = False):
+    """The serving plane's fused LANE-STACKED score kernel:
+    ``fn(sid[L, W] int32, planes[L, 6, W] f32) -> [L, SW, 6+H]`` per-lane
+    aggregation deltas — anomod.replay.make_lane_delta's TPU formulation
+    as ONE Mosaic kernel instead of a vmap of the one-hot chunk step.
+
+    Each grid step processes one ``block``-wide slice of one lane through
+    the same fused pipeline as :func:`make_pallas_replay_fn` (bf16 hi/lo
+    moment split, in-kernel histogram bucketing, single bf16 MXU matmul
+    with f32 accumulation), accumulating into that lane's VMEM-resident
+    ``[ROWS, SW+1]`` block — the per-lane roll/split/edge/score chain the
+    interpreter used to drive as separate dispatches runs as one kernel
+    launch per fused (lanes, width) shape.  Dead pad lanes carry all-pad
+    rows (sid = SW, valid = 0) and produce exact-zero deltas, exactly as
+    the scatter twin's dead segments.  ``block=0`` picks ``min(W, 4096)``
+    (the VMEM-tuned replay default); W must be a block multiple — serve
+    widths are powers of two, so the default always divides.
+
+    Parity contract: identical 0/1 and histogram planes to the scatter/
+    matmul engines (exact bf16 values, f32 accumulation); latency moments
+    within the bf16 hi/lo split's error envelope — the same tolerance
+    the compiled replay-kernel pins use.  Interpret mode keeps the
+    kernel exercised in tier-1 on CPU (tests/test_replay.py); the
+    Mosaic-compiled pin lives in tpu_tests/test_mosaic_parity.py.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    SW1 = n_segments + 1          # + dead lane
+    ROWS = 3 + 6 + n_hist         # exact + (hi, lo) moments + histogram
+
+    def run(sid, planes):
+        L, W = sid.shape
+        assert planes.shape == (L, N_PLANES, W), \
+            "planes must be lane-major [L, 6, W]"
+        blk = block or min(W, 4096)
+        assert W % blk == 0, f"width {W} must be a multiple of {blk}"
+
+        def kernel(sid_ref, planes_ref, out_ref):
+            @pl.when(pl.program_id(1) == 0)
+            def _init():
+                out_ref[:] = jnp.zeros_like(out_ref)
+
+            s = sid_ref[0]                        # [B] int32, this lane
+            rhs_t = _build_rhs_t(planes_ref[0], blk, n_hist)
+            seg_iota = jax.lax.broadcasted_iota(jnp.int32, (blk, SW1), 1)
+            onehot = (seg_iota == s[:, None]).astype(jnp.bfloat16)
+            out_ref[0] += jax.lax.dot_general(
+                rhs_t, onehot, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        acc = pl.pallas_call(
+            kernel,
+            grid=(L, W // blk),
+            in_specs=[
+                pl.BlockSpec((1, blk), lambda l, i: (l, i)),
+                pl.BlockSpec((1, N_PLANES, blk), lambda l, i: (l, 0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, ROWS, SW1), lambda l, i: (l, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((L, ROWS, SW1), jnp.float32),
             compiler_params=_compiler_params(
                 dimension_semantics=("arbitrary", "arbitrary")),
             interpret=interpret,

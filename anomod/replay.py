@@ -73,23 +73,50 @@ def segment_ids(batch: SpanBatch, cfg: ReplayConfig,
     return batch.service.astype(np.int32) * cfg.n_windows + window
 
 
+#: the chunk column schema's row order in the staged matrix — the ONE
+#: ordering shared by :func:`stage_columns_fused`, :func:`dead_chunk` and
+#: the native packer's matrix fast path (anomod.io.native.StagePlan): a
+#: reorder here without a matching ``mat_keys`` change would break the
+#: byte-parity pin in tests/test_native.py, never silently stage garbage.
+STAGE_KEYS = ("sid", "dur", "dur_raw", "err", "s5", "valid", "tid")
+
+
+def stage_columns_fused(batch: SpanBatch, cfg: ReplayConfig,
+                        t0_us: Optional[int] = None):
+    """UNPADDED per-span chunk columns staged as ONE C-contiguous
+    ``[7, n]`` float32 matrix (every chunk column is a 4-byte dtype;
+    ``sid``/``tid`` live as int32 row views) — ``(mat, columns)`` where
+    ``columns`` maps the :data:`STAGE_KEYS` schema to row views of
+    ``mat``.  The serving batcher stages through this and pads at
+    scratch-fill time into pinned reused buffers (pad value per column =
+    the :func:`dead_chunk` fill), so the hot tick loop stops allocating —
+    and the single backing matrix is what lets the native GIL-free packer
+    (anomod.io.native.stage_lanes) describe a whole lane with ONE base
+    pointer + row stride instead of seven per-column pointer
+    extractions (each of which costs as much as a small numpy copy)."""
+    n = batch.n_spans
+    mat = np.empty((len(STAGE_KEYS), n), np.float32)
+    sid = mat[0].view(np.int32)
+    sid[:] = segment_ids(batch, cfg, t0_us)
+    dur_raw = mat[2]
+    np.copyto(dur_raw, batch.duration_us, casting="unsafe")
+    np.log1p(dur_raw, out=mat[1])
+    np.copyto(mat[3], batch.is_error, casting="unsafe")
+    np.copyto(mat[4], batch.status >= 500, casting="unsafe")
+    mat[5].fill(1.0)
+    tid = mat[6].view(np.int32)                 # for distinct-trace HLL
+    np.copyto(tid, batch.trace, casting="unsafe")
+    return mat, dict(sid=sid, dur=mat[1], dur_raw=dur_raw, err=mat[3],
+                     s5=mat[4], valid=mat[5], tid=tid)
+
+
 def stage_columns_raw(batch: SpanBatch, cfg: ReplayConfig,
                       t0_us: Optional[int] = None) -> dict:
     """UNPADDED per-span chunk columns — the :func:`stage_columns`
-    transforms without the pad.  The serving batcher stages through this
-    and pads at scratch-fill time into pinned reused buffers (pad value
-    per column = the :func:`dead_chunk` fill, same bits as the
-    ``np.pad`` below), so the hot tick loop stops allocating."""
-    dur_raw = batch.duration_us.astype(np.float32)
-    return dict(
-        sid=segment_ids(batch, cfg, t0_us),
-        dur=np.log1p(dur_raw),
-        dur_raw=dur_raw,
-        err=batch.is_error.astype(np.float32),
-        s5=(batch.status >= 500).astype(np.float32),
-        valid=np.ones(batch.n_spans, np.float32),
-        tid=batch.trace.astype(np.int32),   # for distinct-trace HLL
-    )
+    transforms without the pad (:func:`stage_columns_fused`'s column
+    dict; the values are row views of one staged matrix, byte-identical
+    to independently computed columns)."""
+    return stage_columns_fused(batch, cfg, t0_us)[1]
 
 
 def stage_columns(batch: SpanBatch, cfg: ReplayConfig, t0_us: Optional[int] = None):
@@ -273,6 +300,29 @@ def make_chunk_step(cfg: ReplayConfig, with_hll: bool = False,
     return chunk_step
 
 
+def default_lane_engine() -> str:
+    """The FUSED lane-dispatch engine: the validated
+    ``ANOMOD_SERVE_LANE_ENGINE`` knob when set, else
+    :func:`default_step_engine`'s choice ("scatter" on XLA:CPU, the
+    one-hot matmul on accelerators).
+
+    The hands-off default deliberately FOLLOWS the single-chunk step
+    engine on every backend — including TPU — so the fused lane path
+    stays BIT-identical to sequential per-chunk dispatch and every
+    serving parity guarantee (fused==sequential, N-shard==1-shard,
+    pipeline depth-invariant) is backend-stable.  The single Mosaic
+    kernel ("pallas", anomod.ops.pallas_replay.make_pallas_lane_delta_fn
+    — the whole per-lane score chain as one kernel launch per fused
+    shape instead of a vmap of one-hot matmuls) is a deployment OPT-IN
+    via ``ANOMOD_SERVE_LANE_ENGINE=pallas``: its alert/histogram planes
+    are exact vs the other engines but its latency moments carry the
+    bf16 hi/lo envelope of the compiled-replay tolerance contract, so
+    defaulting it on would silently soften the serve bit-parity pins."""
+    from anomod.config import get_config
+    knob = get_config().serve_lane_engine
+    return default_step_engine() if knob == "auto" else knob
+
+
 def make_lane_delta(cfg: ReplayConfig, engine: str = "scatter"):
     """The FUSED (lane-stacked) dispatch surface of the chunk step.
 
@@ -293,15 +343,36 @@ def make_lane_delta(cfg: ReplayConfig, engine: str = "scatter"):
     row order, so per-lane bits match the single-lane scatter step — the
     "many small irregular work items, one wide regular kernel" shape);
     ``engine="matmul"`` is ``jax.vmap`` of the one-hot step for
-    accelerator backends.
+    accelerator backends; ``engine="pallas"`` is the single fused Mosaic
+    kernel (interpret mode off-TPU, so the kernel logic stays testable in
+    tier-1) — 0/1 and histogram planes exact vs the other engines,
+    latency moments within the bf16 hi/lo envelope (the compiled-replay
+    tolerance contract; see make_pallas_lane_delta_fn).
     """
     import jax
     import jax.numpy as jnp
 
     SW, H = cfg.sw, cfg.n_hist_buckets
-    if engine not in ("matmul", "scatter"):
+    if engine not in ("matmul", "scatter", "pallas"):
         raise ValueError(f"unknown chunk-step engine {engine!r} "
-                         "(matmul|scatter)")
+                         "(matmul|scatter|pallas)")
+
+    if engine == "pallas":
+        from anomod.ops.pallas_replay import make_pallas_lane_delta_fn
+        pfn = make_pallas_lane_delta_fn(
+            SW, H, interpret=jax.default_backend() != "tpu")
+
+        def pallas_lane_delta(chunks):
+            dur = chunks["dur"]
+            # lane-major [L, 6, W] plane stack in the kernel's PLANES
+            # order (stage_pallas_planes' row order, per lane)
+            planes = jnp.stack(
+                [chunks["valid"], chunks["err"], chunks["s5"],
+                 chunks["dur_raw"], dur, dur * dur], axis=1)
+            out = pfn(chunks["sid"], planes)       # [L, SW, 6+H]
+            return out[..., :N_FEATS], out[..., N_FEATS:]
+
+        return pallas_lane_delta
 
     if engine == "matmul":
         step = make_chunk_step(cfg, with_hll=False, engine="matmul")

@@ -40,7 +40,23 @@ Replay parity is exact by construction, at every level:
   is folded into the tenant's state with the same elementwise f32 add
   the in-step update performs — so a fused tick's states (and therefore
   the alert stream) are BIT-IDENTICAL to dispatching every tenant's
-  chunks one by one (tests/test_serve.py pins this too).
+  chunks one by one (tests/test_serve.py pins this too).  The fused
+  surface follows the step engine on every backend unless
+  ``ANOMOD_SERVE_LANE_ENGINE=pallas`` opts into the single Mosaic
+  kernel, whose latency moments carry the bf16 hi/lo envelope instead
+  of matching bit-for-bit (anomod.replay.default_lane_engine).
+
+STAGING is interpreter-free end to end (``ANOMOD_NATIVE``): the pinned
+``[lanes, width]`` scratch slots are 64-byte-aligned host buffers the
+AOT executables may alias zero-copy on XLA:CPU, and the packing of
+drained micro-batches into them (live rows + dead-chunk fills) runs
+through the C++ ``stage_lanes`` entry (anomod.io.native) with the GIL
+RELEASED — byte-identical to the interpreter fill (pinned), but staging
+for scratch slot k+1 overlaps the in-flight dispatch on slot k, and
+shard workers stage concurrently instead of convoying on the GIL.  The
+per-dispatch stage/dispatch/fold walls are accounted separately (the
+bench ``staging`` block / ``anomod_serve_{stage,dispatch,fold}_seconds_
+total``), so the serving-overhead decomposition is measured, not prose.
 
 :class:`BucketedStreamReplay` duck-types :class:`anomod.stream.StreamReplay`
 (it subclasses it and overrides only the dispatch), so
@@ -61,9 +77,11 @@ from anomod import obs
 from anomod.config import DEFAULT_SERVE_BUCKETS as DEFAULT_BUCKETS
 from anomod.config import validate_lane_buckets
 from anomod.config import validate_serve_buckets as validate_buckets
-from anomod.replay import (N_FEATS, ReplayConfig, ReplayState, dead_chunk,
+from anomod.io import native as native_io
+from anomod.replay import (N_FEATS, STAGE_KEYS, ReplayConfig, ReplayState,
+                           dead_chunk, default_lane_engine,
                            default_step_engine, make_chunk_step,
-                           make_lane_delta, stage_columns_raw)
+                           make_lane_delta, stage_columns_fused)
 from anomod.schemas import SpanBatch
 from anomod.stream import StreamReplay
 
@@ -106,7 +124,9 @@ class BucketRunner:
                  buckets: Optional[Tuple[int, ...]] = None,
                  lane_buckets: Optional[Tuple[int, ...]] = None,
                  engine: Optional[str] = None, registry=None,
-                 pipeline: int = 1):
+                 pipeline: int = 1,
+                 native_stage: Optional[bool] = None,
+                 lane_engine: Optional[str] = None):
         import jax
         from anomod.config import get_config
         if buckets is None:
@@ -116,6 +136,11 @@ class BucketRunner:
         if pipeline < 1:
             raise ValueError("pipeline depth must be >= 1")
         self.cfg = cfg
+        #: GIL-free native scratch packing (anomod.io.native.stage_lanes):
+        #: resolved from the validated ANOMOD_NATIVE knob (auto/on/off)
+        #: unless the caller overrides — the bench's python-staging
+        #: reference leg passes False; byte-identical either way
+        self.native_stage = native_io.staging_enabled(native_stage)
         #: metric sink: the sharded engine hands each shard's runner its
         #: OWN registry (thread-isolated hot path; merged into the
         #: process registry at the tick barrier) — default is the
@@ -133,9 +158,19 @@ class BucketRunner:
         #: the one-hot bf16 matmul on accelerators (the MXU shape)
         self.engine = engine if engine is not None else \
             default_step_engine()
+        #: fused lane-dispatch engine: an explicit ``engine=`` pins both
+        #: surfaces to one formulation (the parity tests rely on that);
+        #: otherwise default_lane_engine — the ANOMOD_SERVE_LANE_ENGINE
+        #: knob when set (``pallas`` = the single fused Mosaic kernel,
+        #: a deliberate TPU opt-in whose latency moments carry the bf16
+        #: hi/lo envelope), else the step engine itself so fused and
+        #: single-chunk dispatch stay BIT-identical on every backend
+        self.lane_engine = lane_engine if lane_engine is not None else \
+            (engine if engine is not None else default_lane_engine())
         step = make_chunk_step(cfg, with_hll=False, engine=self.engine)
         self._step = jax.jit(lambda st, ch: step(st, ch)[0])
-        self._lane_fn = jax.jit(make_lane_delta(cfg, engine=self.engine))
+        self._lane_fn = jax.jit(make_lane_delta(cfg,
+                                                engine=self.lane_engine))
         #: AOT-compiled lane executables, one per (width, lane-bucket)
         #: shape: calling the compiled object skips the pjit python
         #: dispatch path (~5-10 ms per call on this class of host for
@@ -150,6 +185,18 @@ class BucketRunner:
         self.dispatches_by_width: Dict[int, int] = {}
         self.n_dispatches = 0
         self.fused_dispatches = 0
+        #: the serve tick's wall decomposition (the numbers behind the
+        #: bench ``staging`` block): host packing (stage_plan + scratch
+        #: fill), dispatch issue (the executable call — an ENQUEUE wall
+        #: on async backends), and fold (output materialization — the
+        #: execute barrier — plus the per-lane state adds).  What the
+        #: serve wall spends OUTSIDE these three is admission/detector/
+        #: bookkeeping time.
+        self.stage_wall_s = 0.0
+        self.dispatch_wall_s = 0.0
+        self.fold_wall_s = 0.0
+        #: fused dispatches whose scratch was packed natively (GIL-free)
+        self.native_staged = 0
         #: fused dispatches per lane-bucket (the lanes histogram's
         #: deterministic report twin)
         self.lanes_by_bucket: Dict[int, int] = {}
@@ -167,6 +214,11 @@ class BucketRunner:
         # fresh buffers instead (see dispatch()).
         self._lane_scratch: Dict[Tuple[int, int, int],
                                  Dict[str, np.ndarray]] = {}
+        #: per-slot native marshalling plans (anomod.io.native.StagePlan):
+        #: the pinned slots outlive every dispatch, so dst pointers /
+        #: fill patterns / ctypes arrays marshal once per slot, not per
+        #: call — None caches a slot the runtime refused
+        self._stage_plans: Dict[Tuple[int, int, int], object] = {}
         self._slot_next: Dict[Tuple[int, int], int] = {}
         #: FIFO of in-flight fused dispatches: (replays, dagg, dhist,
         #: slot key).  Retiring materializes the deltas (the execute
@@ -194,6 +246,16 @@ class BucketRunner:
             "anomod_serve_live_lanes_total")
         self._obs_lane_waste = reg.gauge(
             "anomod_serve_lane_pad_waste_fraction")
+        # tick-wall decomposition mirrors: seconds counters per phase so
+        # any scrape can attribute the serve wall (stage vs dispatch vs
+        # fold) instead of guessing, + the native-staging counters
+        self._obs_stage_s = reg.counter("anomod_serve_stage_seconds_total")
+        self._obs_dispatch_s = reg.counter(
+            "anomod_serve_dispatch_seconds_total")
+        self._obs_fold_s = reg.counter("anomod_serve_fold_seconds_total")
+        self._obs_native = reg.counter("anomod_serve_native_staged_total")
+        reg.gauge("anomod_serve_native_staging").set(
+            1.0 if self.native_stage else 0.0)
 
     @property
     def widths(self) -> Tuple[int, ...]:
@@ -312,16 +374,32 @@ class BucketRunner:
         under either execution strategy).
         """
         cfg = self.cfg
-        raw = stage_columns_raw(batch, cfg, t0_us)
+        t0 = time.perf_counter()
+        mat, raw = stage_columns_fused(batch, cfg, t0_us)
+        # the staged matrix's pointer, extracted ONCE per batch: every
+        # chunk below carries its slice as ptr/stride/m ints, so the
+        # native packer marshals a lane without touching ndarray
+        # internals on the per-dispatch path (anomod.io.native.StagedChunk)
+        mat_ptr = mat.ctypes.data
+        stride = mat.shape[1]
         out: List[Tuple[int, dict]] = []
         staged_rows = 0
         for lo, hi, width in split_plan(batch.n_spans, cfg.chunk_size,
                                         self.buckets):
-            out.append((width, {k: v[lo:hi] for k, v in raw.items()}))
+            cols = native_io.StagedChunk(
+                (k, v[lo:hi]) for k, v in raw.items())
+            cols.mat = mat
+            cols.ptr = mat_ptr + 4 * lo
+            cols.stride = stride
+            cols.m = hi - lo
+            out.append((width, cols))
             self.n_dispatches += 1
             self.dispatches_by_width[width] = \
                 self.dispatches_by_width.get(width, 0) + 1
             staged_rows += width
+        dt = time.perf_counter() - t0
+        self.stage_wall_s += dt
+        self._obs_stage_s.inc(dt)
         if out:
             self._obs_dispatches.inc(len(out))
             self._obs_staged.inc(staged_rows)
@@ -351,15 +429,28 @@ class BucketRunner:
         reads — before every refill).
         """
         n = cols["sid"].shape[0]
-        if n == width:
-            return self._step(state, cols)
-        padded = {}
-        for k, c in cols.items():
-            buf = np.empty(width, c.dtype)
-            buf[:n] = c
-            buf[n:] = self._pad_fill(k)
-            padded[k] = buf
-        return self._step(state, padded)
+        if n != width:
+            t0 = time.perf_counter()
+            padded = {}
+            for k, c in cols.items():
+                buf = np.empty(width, c.dtype)
+                buf[:n] = c
+                buf[n:] = self._pad_fill(k)
+                padded[k] = buf
+            dt = time.perf_counter() - t0
+            self.stage_wall_s += dt
+            self._obs_stage_s.inc(dt)
+            cols = padded
+        elif type(cols) is not dict:
+            # StagedChunk is a dict subclass jax's pytree registry won't
+            # flatten — hand the jitted step a plain dict view
+            cols = dict(cols)
+        t0 = time.perf_counter()
+        out = self._step(state, cols)
+        dt = time.perf_counter() - t0
+        self.dispatch_wall_s += dt
+        self._obs_dispatch_s.inc(dt)
+        return out
 
     # -- the fused (lane-stacked) path ------------------------------------
 
@@ -385,18 +476,47 @@ class BucketRunner:
         ``self.pipeline`` slots per shape; before reusing a slot, any
         in-flight dispatch still reading it is retired (materialized) —
         the PR-4 aliasing hazard (mutating host arrays under an async
-        dispatch) is structurally impossible here."""
+        dispatch) is structurally impossible here.
+
+        With ``native_stage`` the packing runs through the C++
+        ``stage_lanes`` entry (anomod.io.native): byte-identical to the
+        interpreter fill below (pinned in tests/test_native.py /
+        test_serve.py), but GIL-FREE — staging slot k+1 makes progress
+        under the in-flight dispatch on slot k, and shard workers stage
+        concurrently.  Slots are 64-byte-aligned (aligned_empty) so
+        XLA:CPU's zero-copy host aliasing applies to the very buffers
+        the packer writes — the scratch ring is end-to-end zero-copy."""
         shape = (width, lanes)
         slot = self._slot_next.get(shape, 0)
         self._slot_next[shape] = (slot + 1) % self.pipeline
         key = (width, lanes, slot)
         while any(e[3] == key for e in self._inflight):
             self._retire_one()
+        t0 = time.perf_counter()
         scratch = self._lane_scratch.get(key)
         if scratch is None:
-            scratch = {k: np.empty((lanes, width), v.dtype)
+            scratch = {k: native_io.aligned_empty((lanes, width), v.dtype)
                        for k, v in self._dead_cols_for(width).items()}
             self._lane_scratch[key] = scratch
+            if self.native_stage:
+                self._stage_plans[key] = native_io.make_stage_plan(
+                    scratch, self._pad_fill, mat_keys=STAGE_KEYS)
+        plan = self._stage_plans.get(key)
+        if plan is not None and plan.stage(group_cols):
+            self.native_staged += 1
+            self._obs_native.inc()
+        else:
+            self._fill_slot_py(scratch, group_cols, width, lanes)
+        dt = time.perf_counter() - t0
+        self.stage_wall_s += dt
+        self._obs_stage_s.inc(dt)
+        return scratch, key
+
+    def _fill_slot_py(self, scratch: dict, group_cols: List[dict],
+                      width: int, lanes: int) -> None:
+        """The interpreter fill — the behavioral oracle the native packer
+        is pinned byte-identical to, and the fallback when the .so is
+        unavailable (or a column breaks its 4-byte contract)."""
         n_live = len(group_cols)
         for k, buf in scratch.items():
             fill = self._pad_fill(k)
@@ -408,7 +528,6 @@ class BucketRunner:
                     buf[i, m:] = fill
             if n_live < lanes:
                 buf[n_live:] = fill
-        return scratch, key
 
     def _account_group(self, n_live: int, lanes: int) -> None:
         self.fused_dispatches += 1
@@ -445,7 +564,9 @@ class BucketRunner:
             scratch, _ = self._fill_slot(width, lanes,
                                          [cols for _, cols in group])
             exe = self._lane_exec_for((width, lanes), scratch)
+            t0 = time.perf_counter()
             dagg, dhist = exe(scratch)
+            t1 = time.perf_counter()
             # materialize before the scratch is reused: the host copy is
             # the execute barrier, and the scatter-back below reads it
             dagg = np.asarray(dagg)
@@ -454,6 +575,11 @@ class BucketRunner:
                 out.append(ReplayState(
                     agg=np.asarray(st.agg) + dagg[i],
                     hist=np.asarray(st.hist) + dhist[i]))
+            t2 = time.perf_counter()
+            self.dispatch_wall_s += t1 - t0
+            self._obs_dispatch_s.inc(t1 - t0)
+            self.fold_wall_s += t2 - t1
+            self._obs_fold_s.inc(t2 - t1)
             self._account_group(n_live, lanes)
         return out
 
@@ -480,7 +606,11 @@ class BucketRunner:
             scratch, key = self._fill_slot(width, lanes,
                                            [cols for _, cols in group])
             exe = self._lane_exec_for((width, lanes), scratch)
+            t0 = time.perf_counter()
             dagg, dhist = exe(scratch)
+            dt = time.perf_counter() - t0
+            self.dispatch_wall_s += dt
+            self._obs_dispatch_s.inc(dt)
             self._inflight.append(
                 ([replay for replay, _ in group], dagg, dhist, key))
             self._account_group(n_live, lanes)
@@ -494,6 +624,7 @@ class BucketRunner:
         replay planes through the get_state/set_state seam, with the
         same elementwise f32 add the in-step update performs."""
         replays, dagg, dhist, _ = self._inflight.popleft()
+        t0 = time.perf_counter()
         dagg = np.asarray(dagg)
         dhist = np.asarray(dhist)
         for i, replay in enumerate(replays):
@@ -501,6 +632,9 @@ class BucketRunner:
             replay.set_state(ReplayState(
                 agg=np.asarray(st.agg) + dagg[i],
                 hist=np.asarray(st.hist) + dhist[i]))
+        dt = time.perf_counter() - t0
+        self.fold_wall_s += dt
+        self._obs_fold_s.inc(dt)
 
     def drain_lanes(self) -> None:
         """Retire every in-flight dispatch (tick-end barrier)."""

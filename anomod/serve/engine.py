@@ -146,7 +146,11 @@ SHARD_VARIANT_REPORT_FIELDS = (
     "serve_wall_s", "sustained_spans_per_sec", "compile_s",
     "lane_compile_s", "fused_dispatches", "lanes_by_bucket",
     "lane_pad_waste", "shards", "pipeline", "shard_tenants",
-    "shard_spans", "shard_imbalance", "rca_latency", "rca_wall_s")
+    "shard_spans", "shard_imbalance", "rca_latency", "rca_wall_s",
+    # tick-wall decomposition: wall measurements, and the native-staged
+    # dispatch count follows the fused-dispatch grouping topology
+    "stage_wall_s", "dispatch_wall_s", "fold_wall_s",
+    "native_staged_dispatches")
 
 
 def onset_eligible(window: int, onset_window: int) -> bool:
@@ -191,6 +195,11 @@ class ServeReport:
     lane_pad_waste: float                        # dead-lane fraction
     compile_s: float
     lane_compile_s: float
+    native_staging: bool                         # GIL-free C++ scratch pack?
+    native_staged_dispatches: int                # fused dispatches so packed
+    stage_wall_s: float                          # host packing wall
+    dispatch_wall_s: float                       # executable-issue wall
+    fold_wall_s: float                           # materialize+state-add wall
     shards: int                                  # engine-worker shard count
     pipeline: int                                # in-flight dispatch depth
     shard_tenants: Dict[int, int]                # tenants owned per shard
@@ -257,7 +266,8 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   lane_buckets: Optional[Tuple[int, ...]] = None,
                   shards: Optional[int] = None,
                   pipeline: Optional[int] = None,
-                  rca: Optional[bool] = None
+                  rca: Optional[bool] = None,
+                  native: Optional[bool] = None
                   ) -> Tuple["ServeEngine", ServeReport]:
     """The canonical seeded serve run shared by ``anomod serve`` and
     ``bench.py --mode serve``: a power-law tenant fleet offering
@@ -285,7 +295,7 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          z_threshold=z_threshold, mesh=mesh,
                          tracer=tracer, fuse=fuse,
                          lane_buckets=lane_buckets, shards=shards,
-                         pipeline=pipeline, rca=rca)
+                         pipeline=pipeline, rca=rca, native=native)
     report = engine.run(traffic, duration_s=duration_s)
     return engine, report
 
@@ -311,7 +321,8 @@ class ServeEngine:
                  rca_buckets: Optional[tuple] = None,
                  rca_topk: Optional[int] = None,
                  rca_budget: Optional[int] = None,
-                 rca_windows: Optional[int] = None):
+                 rca_windows: Optional[int] = None,
+                 native: Optional[bool] = None):
         from anomod.config import get_config
         from anomod.utils.platform import enable_jit_cache
         if capacity_spans_per_s <= 0:
@@ -386,7 +397,8 @@ class ServeEngine:
                 for _ in range(self.shards)]
             self._runners = [
                 BucketRunner(self.cfg, _buckets, lane_buckets=lane_buckets,
-                             registry=reg, pipeline=self.pipeline)
+                             registry=reg, pipeline=self.pipeline,
+                             native_stage=native)
                 for reg in self._shard_regs]
             self._fold_state = [dict() for _ in range(self.shards)]
             self.runner = self._runners[0]
@@ -394,7 +406,8 @@ class ServeEngine:
             self.shard_of = {s.tenant_id: 0 for s in self.specs}
             self.runner = BucketRunner(self.cfg, _buckets,
                                        lane_buckets=lane_buckets,
-                                       pipeline=self.pipeline)
+                                       pipeline=self.pipeline,
+                                       native_stage=native)
             self._runners = [self.runner]
         self._workers = None
         #: online RCA (ANOMOD_SERVE_RCA): when a tenant's detector fires
@@ -1105,6 +1118,8 @@ class ServeEngine:
         lanes_by_bucket: Dict[int, int] = {}
         staged_lanes = live_lanes = fused_dispatches = 0
         compile_s = lane_compile_s = 0.0
+        native_staged = 0
+        stage_wall = dispatch_wall = fold_wall = 0.0
         for r in self._runners:
             for w, n in r.dispatches_by_width.items():
                 disp_by_width[w] = disp_by_width.get(w, 0) + n
@@ -1115,6 +1130,10 @@ class ServeEngine:
             fused_dispatches += r.fused_dispatches
             compile_s += r.compile_s
             lane_compile_s += r.lane_compile_s
+            native_staged += r.native_staged
+            stage_wall += r.stage_wall_s
+            dispatch_wall += r.dispatch_wall_s
+            fold_wall += r.fold_wall_s
         shard_tenants: Dict[int, int] = {s: 0 for s in range(self.shards)}
         shard_spans: Dict[int, int] = {s: 0 for s in range(self.shards)}
         for spec in self.specs:
@@ -1160,6 +1179,11 @@ class ServeEngine:
                                  if staged_lanes else 0.0, 6),
             compile_s=round(compile_s, 4),
             lane_compile_s=round(lane_compile_s, 4),
+            native_staging=any(r.native_stage for r in self._runners),
+            native_staged_dispatches=native_staged,
+            stage_wall_s=round(stage_wall, 4),
+            dispatch_wall_s=round(dispatch_wall, 4),
+            fold_wall_s=round(fold_wall, 4),
             shards=self.shards,
             pipeline=self.pipeline,
             shard_tenants=shard_tenants,

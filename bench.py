@@ -150,7 +150,13 @@ def serve_main(probe_fresh=False) -> int:
     its own registry): the ``fused_dispatch`` block reports fused vs
     unfused sustained spans/sec, p99 and shed fraction on the same seed
     (the unfused leg runs after both headline legs so the speedup is
-    never flattered by warmup order).  After the shard-scaling legs,
+    never flattered by warmup order).  A PYTHON-STAGING leg (same seed,
+    ``native=False``) then isolates the C++ GIL-free lane packing: the
+    ``staging`` block decomposes the serve wall into stage / dispatch /
+    fold / other for both legs — the serving-overhead gap attributed
+    with numbers — plus the byte-parity bits (native staging is pinned
+    byte-identical, so every decision metric must match exactly).
+    After the shard-scaling legs,
     two ONLINE-RCA legs (1-shard and 2-shard, ``rca=True``, same seed)
     fill the ``rca`` block: top-k hit-rate (k=1,3,5) against the
     injected-fault ground truth, alert→culprit latency quantiles, and
@@ -210,6 +216,16 @@ def serve_main(probe_fresh=False) -> int:
             # flattered by run order.
             set_registry(Registry(enabled=True))
             _, rep_unfused = run_power_law(fuse=False, shards=1, **run_kw)
+            # the python-staging reference leg: same seed, the C++
+            # GIL-free lane packing forced OFF (interpreter fill), own
+            # registry, run after the headline legs so the native
+            # speedup is never flattered by warmup order.  Output is
+            # byte-identical by construction — the leg isolates the
+            # STAGE wall, and its parity bits are recorded in the
+            # capture itself.
+            set_registry(Registry(enabled=True))
+            eng_pystage, rep_pystage = run_power_law(
+                native=False, shards=1, **run_kw)
             # the shard-scaling legs (2 and 4 engine workers, same
             # seed), then a FRESH 1-shard reference leg LAST: the
             # reference inherits the most process warmup of the whole
@@ -278,6 +294,65 @@ def serve_main(probe_fresh=False) -> int:
             "lane_pad_waste": rep.lane_pad_waste,
             "lane_compile_s": rep.lane_compile_s,
         }
+        # the serve-tick wall DECOMPOSITION (the serving-overhead gap,
+        # attributed with numbers): host packing (stage) vs executable
+        # issue (dispatch) vs output materialization + state folds
+        # (fold), native vs interpreter staging legs on the same seed —
+        # `other` is what the serve wall spends in admission/detector/
+        # bookkeeping Python, the remaining interpreter tax
+        import numpy as _np
+        from anomod.io import native as _native
+        _nat_status = _native.status()
+
+        def _decomp(r):
+            walls = {"stage": r.stage_wall_s, "dispatch": r.dispatch_wall_s,
+                     "fold": r.fold_wall_s}
+            walls["other"] = round(
+                max(0.0, r.serve_wall_s - sum(walls.values())), 4)
+            walls["serve"] = r.serve_wall_s
+            return walls
+
+        def _engines_identical(eng_a, eng_b):
+            """(alerts_same, states_same) over the union of the two
+            engines' tenants — the one definition every parity bit in
+            this capture reads (staging and RCA legs alike)."""
+            tids = sorted(set(eng_a._tenant_det) | set(eng_b._tenant_det))
+            alerts = all(eng_a.alerts_for(t) == eng_b.alerts_for(t)
+                         for t in tids)
+            states = all(
+                t in eng_a._tenant_replay and t in eng_b._tenant_replay
+                and _np.array_equal(
+                    _np.asarray(eng_a._tenant_replay[t].state.agg),
+                    _np.asarray(eng_b._tenant_replay[t].state.agg))
+                and _np.array_equal(
+                    _np.asarray(eng_a._tenant_replay[t].state.hist),
+                    _np.asarray(eng_b._tenant_replay[t].state.hist))
+                for t in tids)
+            return alerts, states
+
+        _stage_alerts_same, _stage_states_same = _engines_identical(
+            eng_head, eng_pystage)
+        out["staging"] = {
+            "native_mode": _nat_status["mode"],
+            "native_available": _nat_status["available"],
+            "build_error": _nat_status["build_error"],
+            "native_staging_headline": rep.native_staging,
+            "native_staged_dispatches": rep.native_staged_dispatches,
+            "wall_s_native": _decomp(rep),
+            "wall_s_python": _decomp(rep_pystage),
+            "spans_per_sec_native": rep.sustained_spans_per_sec,
+            "spans_per_sec_python": rep_pystage.sustained_spans_per_sec,
+            "stage_wall_speedup": round(
+                rep_pystage.stage_wall_s / max(rep.stage_wall_s, 1e-9), 2),
+            "parity": {
+                "alerts_identical": _stage_alerts_same,
+                "states_identical": _stage_states_same,
+                "p99_identical": rep_pystage.latency.get("p99_latency_s")
+                == rep.latency.get("p99_latency_s"),
+                "shed_identical":
+                    rep_pystage.shed_fraction == rep.shed_fraction,
+            },
+        }
         # shard scaling on the same seed (1 / 2 / 4 engine workers; the
         # 1-shard row is the dedicated warm REFERENCE leg, run last).
         # Decision parity across legs is pinned by tests; the table
@@ -320,19 +395,7 @@ def serve_main(probe_fresh=False) -> int:
         # determinism pins — RCA-on must leave every detector decision
         # byte-identical to the RCA-off headline leg, and the 2-shard
         # verdict stream must equal the 1-shard one
-        import numpy as _np
-        _tids = sorted(set(eng_head._tenant_det) | set(eng_rca._tenant_det))
-        alerts_same = all(eng_head.alerts_for(t) == eng_rca.alerts_for(t)
-                          for t in _tids)
-        states_same = all(
-            t in eng_head._tenant_replay and t in eng_rca._tenant_replay
-            and _np.array_equal(
-                _np.asarray(eng_head._tenant_replay[t].state.agg),
-                _np.asarray(eng_rca._tenant_replay[t].state.agg))
-            and _np.array_equal(
-                _np.asarray(eng_head._tenant_replay[t].state.hist),
-                _np.asarray(eng_rca._tenant_replay[t].state.hist))
-            for t in _tids)
+        alerts_same, states_same = _engines_identical(eng_head, eng_rca)
         n_fault = (rep_rca.fault_detection or {}).get("n_fault_tenants", 0)
         out["rca"] = {
             "enabled": True,

@@ -338,6 +338,162 @@ int64_t anomod_scan_log(const char* text, int64_t len,
     return count;
 }
 
+// ---- serving-plane lane staging -------------------------------------------
+//
+// Pack one fused dispatch's lane-stacked scratch: for each 4-byte column
+// buffer dst[c] (row-major [lanes, width]), copy each live lane's rows from
+// its source slice and fill the row tail — plus every dead lane — with the
+// column's 4-byte fill pattern (the dead-chunk fill: sid = SW, everything
+// else 0).  This is the serve hot loop's host-side packing, moved off the
+// Python interpreter: the ctypes call releases the GIL, so staging slot k+1
+// overlaps the in-flight XLA dispatch on slot k and shard workers stage
+// concurrently instead of convoying on the interpreter lock.
+//
+// Every chunk column is 4 bytes wide (int32 sid/tid, float32 the rest), so
+// the copy is dtype-blind: memcpy the live rows, store the fill pattern in
+// the tail.  Byte-identity with the Python fill (buf[i, :m] = c;
+// buf[i, m:] = fill) is therefore structural.
+
+namespace {
+
+// Per-call completion latch: a staging call waits only for ITS OWN column
+// tasks.  The pool's wait_all() is a global quiesce — two shard workers
+// staging concurrently (or a stage racing an ingest scan on the shared
+// default runtime) would convoy on each other's queues through it, which
+// is exactly the serialization the GIL-free path exists to remove.
+class Latch {
+ public:
+    explicit Latch(int n) : remaining_(n) {}
+    void count_down() {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (--remaining_ == 0) cv_.notify_all();
+    }
+    void wait() {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return remaining_ == 0; });
+    }
+
+ private:
+    std::mutex mu_;
+    std::condition_variable cv_;
+    int remaining_;
+};
+
+inline void stage_one_column(uint32_t* d, const void* const* src_col,
+                             const int64_t* n_rows, uint32_t fill,
+                             int64_t n_live, int64_t lanes, int64_t width) {
+    for (int64_t i = 0; i < n_live; ++i) {
+        const int64_t m = n_rows[i];
+        uint32_t* row = d + i * width;
+        if (m > 0) std::memcpy(row, src_col[i], (size_t)m * 4);
+        for (int64_t j = m; j < width; ++j) row[j] = fill;
+    }
+    for (int64_t i = n_live; i < lanes; ++i) {
+        uint32_t* row = d + i * width;
+        for (int64_t j = 0; j < width; ++j) row[j] = fill;
+    }
+}
+
+}  // namespace
+
+// Stage n_cols column buffers for one fused dispatch.  ``src`` is
+// column-major: src[c * n_live + i] is live lane i's slice of column c,
+// n_rows[i] elements long (identical across columns of a lane).  ``rt_ptr``
+// may be a Runtime* to fan the per-column fills across the pool (worth it
+// only for big slots; small ones stay on the calling thread), or NULL.
+// Returns the number of 4-byte words staged, or -1 on malformed arguments —
+// the Python caller treats -1 as "fall back to the interpreter fill".
+int64_t anomod_stage_lanes(void* rt_ptr, void* const* dst,
+                           const void* const* src, const int64_t* n_rows,
+                           const uint32_t* fills, int32_t n_cols,
+                           int32_t n_live, int64_t lanes, int64_t width) {
+    if (n_cols < 1 || n_live < 0 || n_live > lanes || width < 1 ||
+        lanes < 1)
+        return -1;
+    for (int32_t i = 0; i < n_live; ++i)
+        if (n_rows[i] < 0 || n_rows[i] > width) return -1;
+    Runtime* rt = static_cast<Runtime*>(rt_ptr);
+    // pool fan-out threshold: below ~64K words per column the submit/wake
+    // latency costs more than the copy
+    if (rt != nullptr && n_cols > 1 && lanes * width >= (int64_t)1 << 16) {
+        Latch latch(n_cols);
+        for (int32_t c = 0; c < n_cols; ++c) {
+            uint32_t* d = static_cast<uint32_t*>(dst[c]);
+            const void* const* src_col = src + (int64_t)c * n_live;
+            const uint32_t fill = fills[c];
+            rt->submit([d, src_col, n_rows, fill, n_live, lanes, width,
+                        &latch] {
+                stage_one_column(d, src_col, n_rows, fill, n_live, lanes,
+                                 width);
+                latch.count_down();
+            });
+        }
+        latch.wait();
+    } else {
+        for (int32_t c = 0; c < n_cols; ++c)
+            stage_one_column(static_cast<uint32_t*>(dst[c]),
+                             src + (int64_t)c * n_live, n_rows, fills[c],
+                             n_live, lanes, width);
+    }
+    return (int64_t)n_cols * lanes * width;
+}
+
+// Matrix-carrier twin of anomod_stage_lanes: each live lane's columns are
+// rows of ONE C-contiguous 4-byte matrix (anomod.replay.stage_columns_fused
+// stages them that way), so a lane is described by a single base pointer +
+// row stride instead of n_cols separate pointers — the Python caller's
+// pointer extraction (the expensive part of ctypes marshalling) drops from
+// n_cols*n_live to one per STAGED BATCH, amortized across its chunks.
+// Column c of lane i starts at (uint32_t*)bases[i] + c * strides[i]
+// (strides in 4-byte elements).  Fill/parity semantics identical to
+// anomod_stage_lanes; returns words staged or -1 on malformed arguments.
+int64_t anomod_stage_lanes_mat(void* rt_ptr, void* const* dst,
+                               const void* const* bases,
+                               const int64_t* strides,
+                               const int64_t* n_rows, const uint32_t* fills,
+                               int32_t n_cols, int32_t n_live,
+                               int64_t lanes, int64_t width) {
+    if (n_cols < 1 || n_live < 0 || n_live > lanes || width < 1 ||
+        lanes < 1)
+        return -1;
+    for (int32_t i = 0; i < n_live; ++i)
+        if (n_rows[i] < 0 || n_rows[i] > width || strides[i] < n_rows[i])
+            return -1;
+    Runtime* rt = static_cast<Runtime*>(rt_ptr);
+    auto stage_col = [=](int32_t c) {
+        uint32_t* d = static_cast<uint32_t*>(dst[c]);
+        const uint32_t fill = fills[c];
+        for (int64_t i = 0; i < n_live; ++i) {
+            const int64_t m = n_rows[i];
+            uint32_t* row = d + i * width;
+            if (m > 0)
+                std::memcpy(row,
+                            static_cast<const uint32_t*>(bases[i]) +
+                                c * strides[i],
+                            (size_t)m * 4);
+            for (int64_t j = m; j < width; ++j) row[j] = fill;
+        }
+        for (int64_t i = n_live; i < lanes; ++i) {
+            uint32_t* row = d + i * width;
+            for (int64_t j = 0; j < width; ++j) row[j] = fill;
+        }
+    };
+    // pool fan-out threshold: below ~64K words per column the submit/wake
+    // latency costs more than the copy
+    if (rt != nullptr && n_cols > 1 && lanes * width >= (int64_t)1 << 16) {
+        Latch latch(n_cols);
+        for (int32_t c = 0; c < n_cols; ++c)
+            rt->submit([stage_col, c, &latch] {
+                stage_col(c);
+                latch.count_down();
+            });
+        latch.wait();
+    } else {
+        for (int32_t c = 0; c < n_cols; ++c) stage_col(c);
+    }
+    return (int64_t)n_cols * lanes * width;
+}
+
 // Multithreaded variant over pre-split chunks of one large buffer.
 int64_t anomod_scan_log_mt(const char* text, int64_t len,
                            int8_t* levels_out, double* ts_out,

@@ -26,10 +26,19 @@ the package must be in the validated Config contract or documented —
 a capture driven by an undocumented knob is not reproducible from the
 record.  Exit 4 = env contract violation.
 
+Serve mode also builds/validates the NATIVE runtime when the validated
+``ANOMOD_NATIVE`` knob requests it: the .so is (re)built on first touch,
+a tiny ``stage_lanes`` round-trip must reproduce the interpreter fill
+byte-for-byte, and a requested-but-unusable runtime (``ANOMOD_NATIVE=1``
+on a box without a toolchain) fails with the recorded build reason —
+exit 5, distinct from the generic serve failure, so a driver can tell
+"install g++ or unset ANOMOD_NATIVE" from "the bucket grid is broken".
+
 Exit codes: 0 = ready (warm cache, or --cold / caching disabled is
 explicit, or serve preconditions hold), 1 = cold cache without --cold,
 2 = caching disabled without --cold, 3 = serve precondition failure,
-4 = env contract violation.
+4 = env contract violation, 5 = ANOMOD_NATIVE requested but the native
+runtime is unusable (compiler missing / build failed).
 Always prints one JSON line describing the decision (plus the contract
 gate's line).  ``--traces`` must match the bench invocation's span
 count (the cache key includes it).
@@ -90,9 +99,38 @@ def _shard_fanout_smoke() -> dict:
             "served_spans": r1.served_spans}
 
 
+def _native_smoke() -> dict:
+    """One stage_lanes round-trip vs the interpreter fill, byte-for-byte
+    — proves the freshly-(re)built ABI before a capture trusts it."""
+    import numpy as np
+
+    from anomod.io import native
+    scratch = {"sid": native.aligned_empty((4, 32), np.int32),
+               "dur": native.aligned_empty((4, 32), np.float32)}
+    rng = np.random.default_rng(0)
+    group = [{"sid": rng.integers(0, 9, 20).astype(np.int32),
+              "dur": rng.random(20).astype(np.float32)},
+             {"sid": rng.integers(0, 9, 32).astype(np.int32),
+              "dur": rng.random(32).astype(np.float32)}]
+    fills = {"sid": 9, "dur": 0}
+    if not native.stage_lanes(scratch, group, lambda k: fills[k]):
+        raise RuntimeError("stage_lanes refused a well-formed slot")
+    for k, buf in scratch.items():
+        want = np.empty((4, 32), buf.dtype)
+        for i, cols in enumerate(group):
+            m = cols[k].shape[0]
+            want[i, :m] = cols[k]
+            want[i, m:] = fills[k]
+        want[2:] = fills[k]
+        if buf.tobytes() != want.tobytes():
+            raise RuntimeError(f"stage_lanes byte mismatch on {k!r}")
+    return {"status": "ok", "cols": len(scratch)}
+
+
 def check_serve() -> int:
     """Serve-bench preconditions: env contract parses, bucket set
-    compiles, the shard fan-out reproduces the 1-shard output.  Runs on
+    compiles, the shard fan-out reproduces the 1-shard output, and the
+    native runtime is healthy when ANOMOD_NATIVE requests it.  Runs on
     the pinned-CPU backend (the gate must never hang on a dead device
     tunnel — compilability is backend-independent)."""
     out = {"check": "pre_bench_serve", "mode": "serve"}
@@ -106,6 +144,23 @@ def check_serve() -> int:
         out["shards"] = cfg.serve_shards
         out["pipeline"] = cfg.serve_pipeline
         out["jit_cache"] = enable_jit_cache()
+        # native runtime: status() triggers the build when the .so is
+        # stale/missing; a requested-but-unusable runtime is its OWN
+        # failure mode (exit 5) — "install a toolchain or unset
+        # ANOMOD_NATIVE", not a bucket-grid problem
+        from anomod.io import native
+        out["native"] = native.status()
+        if cfg.native == "on" and not native.available():
+            out["status"] = "native-unusable"
+            print(json.dumps(out))
+            print("pre_bench_check: ANOMOD_NATIVE=on but the native "
+                  f"runtime is unusable: {native.build_error()} — "
+                  "install g++ and `make -C native smoke`, or unset "
+                  "ANOMOD_NATIVE to serve the pure-Python path",
+                  file=sys.stderr)
+            return 5
+        if out["native"]["staging"]:
+            out["native"]["smoke"] = _native_smoke()
         from anomod.serve.batcher import BucketRunner
         from anomod.serve.engine import serve_plane_cfg
         # the serve bench's plane shape (ONE definition with bench.py's

@@ -165,6 +165,29 @@ def test_bench_serve_mode_contract(tmp_path):
     assert fd["fused_dispatches"] > 0
     assert fd["lane_buckets"]
     assert 0.0 <= fd["lane_pad_waste"] < 1.0
+    # staging decomposition (ISSUE-7): stage/dispatch/fold/other walls on
+    # the native AND interpreter-staging legs of the same seed, plus the
+    # byte-parity bits the native path is pinned to
+    st = out["staging"]
+    assert st["native_mode"] in ("auto", "on", "off")
+    assert st["native_available"] in (True, False)
+    for leg in ("wall_s_native", "wall_s_python"):
+        walls = st[leg]
+        assert set(walls) == {"stage", "dispatch", "fold", "other",
+                              "serve"}
+        assert all(v >= 0 for v in walls.values())
+        assert walls["stage"] + walls["dispatch"] + walls["fold"] \
+            <= walls["serve"] + 1e-6
+    assert st["spans_per_sec_native"] > 0
+    assert st["spans_per_sec_python"] > 0
+    if st["native_available"] and st["native_mode"] != "off":
+        assert st["native_staging_headline"] is True
+        assert st["native_staged_dispatches"] > 0
+    par = st["parity"]
+    assert par["alerts_identical"] is True
+    assert par["states_identical"] is True
+    assert par["p99_identical"] is True
+    assert par["shed_identical"] is True
     # online-RCA block (ISSUE-6): alert→culprit numbers on the same
     # seed plus the determinism pins the capture must carry
     rca = out["rca"]

@@ -476,3 +476,41 @@ def test_edge_distinct_traces_match_exact():
         exact = len(set(batch.trace[sel].tolist()))
         assert abs(counts[i] - exact) <= max(3.0, 0.1 * exact), \
             (table[i], counts[i], exact)
+
+
+def test_pallas_lane_delta_interpret_matches_scatter_twin():
+    """The fused TPU lane kernel's tier-1 twin: make_lane_delta(engine=
+    "pallas") runs the single Mosaic kernel in INTERPRET mode on CPU
+    (the TPU tunnel being down must not stop the kernel logic from
+    being exercised) against the XLA:CPU scatter formulation — 0/1 and
+    histogram planes exact, latency moments within the bf16 hi/lo
+    envelope (the compiled-replay tolerance contract), and a dead pad
+    lane's delta exactly zero."""
+    import jax
+
+    from anomod.replay import (dead_chunk, default_lane_engine,
+                               make_lane_delta, stage_columns)
+
+    assert default_lane_engine() == "scatter"     # CPU backend default
+    cfg = ReplayConfig(n_services=5, n_windows=6, window_us=5_000_000,
+                       chunk_size=256)
+    chunks = []
+    for i in range(3):
+        batch = synth.generate_spans(labels.label_for("Normal_case"),
+                                     n_traces=40, seed=i)
+        batch = batch._replace(
+            service=(batch.service % cfg.n_services).astype(np.int32),
+            services=batch.services[:cfg.n_services])
+        staged, _ = stage_columns(batch, cfg, t0_us=0)
+        chunks.append({k: v[0] for k, v in staged.items()})
+    chunks.append(dead_chunk(cfg, 256, xp=np))    # dead pad lane
+    stack = {k: np.stack([c[k] for c in chunks]) for k in chunks[0]}
+    sca = jax.jit(make_lane_delta(cfg, engine="scatter"))
+    pal = jax.jit(make_lane_delta(cfg, engine="pallas"))
+    da, dh = map(np.asarray, sca(stack))
+    pa, ph = map(np.asarray, pal(stack))
+    np.testing.assert_array_equal(pa[..., :3], da[..., :3])
+    np.testing.assert_array_equal(ph, dh)
+    np.testing.assert_allclose(pa[..., 3:6], da[..., 3:6], rtol=2e-3,
+                               atol=1e-2)
+    assert (pa[-1] == 0).all() and (ph[-1] == 0).all()

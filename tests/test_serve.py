@@ -368,7 +368,8 @@ def test_overload_shedding_is_priority_ordered_and_deterministic():
     assert rep.latency["p99_latency_s"] > 0
 
     wall = ("serve_wall_s", "sustained_spans_per_sec", "compile_s",
-            "lane_compile_s")
+            "lane_compile_s", "stage_wall_s", "dispatch_wall_s",
+            "fold_wall_s")
     a = {k: v for k, v in _overload_report(5).to_dict().items()
          if k not in wall}
     b = {k: v for k, v in _overload_report(5).to_dict().items()
@@ -1230,3 +1231,156 @@ def test_serve_cli_emits_report(capsys):
     assert out["offered_spans"] > 0
     assert out["buckets"] == [128, 512]
     assert 0.0 <= out["shed_fraction"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# GIL-free native staging + the serve-tick wall decomposition (ISSUE-7)
+# ---------------------------------------------------------------------------
+
+def _small_serve_kw(seed=5):
+    return dict(n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+                overload=2.0, duration_s=20, tick_s=1.0, seed=seed,
+                window_s=5.0, baseline_windows=4, fault_tenants=1,
+                buckets=(64, 256), lane_buckets=(1, 2, 4),
+                max_backlog=1500, n_windows=16)
+
+
+def _engine_fingerprint(eng):
+    return {
+        tid: ([dataclasses.asdict(a) for a in eng.alerts_for(tid)],
+              np.asarray(eng._tenant_replay[tid].state.agg).tobytes(),
+              np.asarray(eng._tenant_replay[tid].state.hist).tobytes())
+        for tid in sorted(set(eng._tenant_det) | set(eng._tenant_replay))}
+
+
+from anomod.io import native as _native_io
+
+
+@pytest.mark.skipif(not _native_io.available(),
+                    reason="native lib not built")
+def test_native_staging_engine_byte_identical_to_python():
+    """THE native-staging parity pin, end to end: a seeded overloaded
+    fused run with the C++ GIL-free scratch packing emits per-tenant
+    alerts and replay states byte-identical to the interpreter fill on
+    the same seed — and the report says which path staged."""
+    from anomod.serve.engine import run_power_law
+    e_nat, r_nat = run_power_law(native=True, **_small_serve_kw())
+    e_py, r_py = run_power_law(native=False, **_small_serve_kw())
+    assert r_nat.native_staging is True and r_py.native_staging is False
+    assert r_nat.native_staged_dispatches > 0
+    assert r_py.native_staged_dispatches == 0
+    assert _engine_fingerprint(e_nat) == _engine_fingerprint(e_py)
+    # admission/SLO are staging-invariant by construction
+    assert r_nat.shed_fraction == r_py.shed_fraction
+    assert r_nat.latency == r_py.latency
+
+
+def test_scratch_ring_refill_hazard_regression_depths_1_to_3():
+    """The refill-under-dispatch hazard regression at every supported
+    small pipeline depth: depths 1 (synchronous), 2 (double-buffered)
+    and 3 must produce byte-identical states and alerts — a slot
+    refilled under a dispatch that can still read it would corrupt the
+    fold at depth >= 2 only, which is exactly what this pins against
+    the depth-1 oracle (native staging wherever available)."""
+    from anomod.serve.engine import run_power_law
+    prints = []
+    for depth in (1, 2, 3):
+        eng, rep = run_power_law(pipeline=depth, **_small_serve_kw(seed=7))
+        assert rep.pipeline == depth
+        prints.append(_engine_fingerprint(eng))
+    assert prints[0] == prints[1] == prints[2]
+
+
+def test_serve_report_carries_wall_decomposition():
+    """The staging decomposition the bench block reads: stage/dispatch/
+    fold walls accounted per runner, summing to less than the serve
+    wall (the rest is admission/detector bookkeeping)."""
+    from anomod.serve.engine import run_power_law
+    _, rep = run_power_law(**_small_serve_kw())
+    assert rep.stage_wall_s > 0
+    assert rep.dispatch_wall_s > 0
+    assert rep.fold_wall_s > 0
+    assert rep.stage_wall_s + rep.dispatch_wall_s + rep.fold_wall_s \
+        <= rep.serve_wall_s + 1e-6
+    # decomposition fields are wall measurements: excluded from the
+    # shard-determinism comparison by the ONE shared list
+    from anomod.serve.engine import SHARD_VARIANT_REPORT_FIELDS
+    for f in ("stage_wall_s", "dispatch_wall_s", "fold_wall_s",
+              "native_staged_dispatches"):
+        assert f in SHARD_VARIANT_REPORT_FIELDS
+
+
+def test_lane_engine_knob_registered_and_validated(monkeypatch):
+    """ANOMOD_SERVE_LANE_ENGINE joins the validated Config env contract:
+    auto/matmul/scatter/pallas parse, anything else fails loudly.  The
+    hands-off default FOLLOWS the step engine (bit-parity backend-stable
+    — on this CPU box both resolve to scatter); pallas is an explicit
+    opt-in that routes the runner's fused surface to the Mosaic kernel;
+    and an explicit ``engine=`` still pins BOTH surfaces to one
+    formulation regardless of the knob (the parity tests rely on that).
+    """
+    from anomod.config import Config, set_config
+    from anomod.replay import default_lane_engine, default_step_engine
+    assert Config().serve_lane_engine == "auto"
+    monkeypatch.setenv("ANOMOD_SERVE_LANE_ENGINE", "pallas")
+    assert Config().serve_lane_engine == "pallas"
+    monkeypatch.setenv("ANOMOD_SERVE_LANE_ENGINE", "banana")
+    with pytest.raises(ValueError, match="ANOMOD_SERVE_LANE_ENGINE"):
+        Config()
+
+    cfg = ReplayConfig(n_services=4, n_windows=8, window_us=5_000_000,
+                       chunk_size=256)
+    try:
+        monkeypatch.delenv("ANOMOD_SERVE_LANE_ENGINE")
+        set_config(Config())
+        assert default_lane_engine() == default_step_engine()
+        runner = BucketRunner(cfg, (64, 256), lane_buckets=(1, 2))
+        assert runner.lane_engine == runner.engine
+        monkeypatch.setenv("ANOMOD_SERVE_LANE_ENGINE", "pallas")
+        set_config(Config())
+        assert default_lane_engine() == "pallas"
+        runner = BucketRunner(cfg, (64, 256), lane_buckets=(1, 2))
+        assert runner.lane_engine == "pallas"
+        # an explicit engine= pins both surfaces, knob notwithstanding
+        runner = BucketRunner(cfg, (64, 256), lane_buckets=(1, 2),
+                              engine="scatter")
+        assert runner.engine == runner.lane_engine == "scatter"
+    finally:
+        monkeypatch.delenv("ANOMOD_SERVE_LANE_ENGINE", raising=False)
+        set_config(Config())
+
+
+def test_native_knob_registered_and_validated(monkeypatch):
+    """ANOMOD_NATIVE joins the validated Config env contract: auto/on/off
+    (with 1/0 aliases) parse, anything else fails loudly; off forces the
+    interpreter fill even when the .so is fine; on REFUSES to construct
+    a runner when the runtime is unusable, quoting the build reason."""
+    from anomod.config import Config
+    from anomod.io import native as native_io
+    assert Config().native == "auto"
+    monkeypatch.setenv("ANOMOD_NATIVE", "1")
+    assert Config().native == "on"
+    monkeypatch.setenv("ANOMOD_NATIVE", "off")
+    assert Config().native == "off"
+    monkeypatch.setenv("ANOMOD_NATIVE", "banana")
+    with pytest.raises(ValueError, match="ANOMOD_NATIVE"):
+        Config()
+
+    cfg = ReplayConfig(n_services=4, n_windows=8, window_us=5_000_000,
+                       chunk_size=256)
+    monkeypatch.setenv("ANOMOD_NATIVE", "off")
+    from anomod.config import set_config
+    try:
+        set_config(Config())
+        runner = BucketRunner(cfg, (64, 256), lane_buckets=(1, 2))
+        assert runner.native_stage is False
+        # =on with an unusable runtime: fail loud with the reason, never
+        # silently serve the slow path
+        monkeypatch.setenv("ANOMOD_NATIVE", "on")
+        set_config(Config())
+        monkeypatch.setattr(native_io, "available", lambda: False)
+        with pytest.raises(RuntimeError, match="ANOMOD_NATIVE"):
+            BucketRunner(cfg, (64, 256), lane_buckets=(1, 2))
+    finally:
+        monkeypatch.delenv("ANOMOD_NATIVE")
+        set_config(Config())

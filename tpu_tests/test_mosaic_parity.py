@@ -95,6 +95,44 @@ def test_replay_sorted_kernel_compiled(tt_corpus):
                                atol=3e-2)
 
 
+def test_lane_delta_kernel_compiled():
+    """The serving plane's fused lane-stacked score kernel (ISSUE-7),
+    Mosaic-compiled at serve shapes: [lanes, width] stacked chunks →
+    per-lane deltas as ONE kernel launch, vs the per-lane numpy oracle.
+    Dead pad lanes must come back exactly zero.  (The CPU-interpret twin
+    runs in tier-1: tests/test_replay.py.)"""
+    import jax
+
+    from anomod.replay import (ReplayConfig, dead_chunk, make_lane_delta,
+                               replay_numpy, stage_columns)
+    from anomod import labels, synth
+
+    cfg = ReplayConfig(n_services=12, n_windows=32,
+                       window_us=5_000_000, chunk_size=4096)  # serve shape
+    lanes = []
+    for i, l in enumerate(labels.labels_for_testbed("TT")[:4]):
+        b = synth.generate_spans(l, n_traces=40, seed=i)
+        b = b._replace(service=b.service % cfg.n_services,
+                       services=b.services[:cfg.n_services])
+        staged, _ = stage_columns(b, cfg, t0_us=0)
+        lanes.append({k: v[0] for k, v in staged.items()})
+    lanes.append(dead_chunk(cfg, cfg.chunk_size, xp=np))
+    stack = {k: np.stack([np.asarray(c[k]) for c in lanes])
+             for k in lanes[0]}
+    fn = jax.jit(make_lane_delta(cfg, engine="pallas"))
+    dagg, dhist = fn(stack)
+    dagg, dhist = np.asarray(dagg), np.asarray(dhist)
+    for i, chunk in enumerate(lanes):
+        ref = replay_numpy({k: np.asarray(v)[None] for k, v in
+                            chunk.items()}, cfg)
+        np.testing.assert_allclose(dagg[i, :, :3], ref.agg[:, :3],
+                                   rtol=0, atol=0)
+        np.testing.assert_allclose(dhist[i], ref.hist, rtol=0, atol=0)
+        np.testing.assert_allclose(dagg[i, :, 3:6], ref.agg[:, 3:6],
+                                   rtol=2e-3, atol=1e-2)
+    assert (dagg[-1] == 0).all() and (dhist[-1] == 0).all()
+
+
 def test_sharded_replay_pallas_compiled(tt_corpus):
     """make_sharded_replay_fn(kernel='pallas') on a real-device mesh: the
     compiled kernel inside shard_map with check_vma=False, psum merge."""
