@@ -377,6 +377,13 @@ def main(argv=None) -> int:
                               "inference over the tenant's live service "
                               "graph (anomod.serve.rca; default: "
                               "ANOMOD_SERVE_RCA)")
+    p_serve.add_argument("--state", choices=["auto", "host", "device"],
+                         default=None,
+                         help="tenant replay state residency: device = "
+                              "shard-owned device pool, on-device scatter "
+                              "fold + fused score gather (bit-identical); "
+                              "host = the per-tenant numpy seam "
+                              "(default: ANOMOD_SERVE_STATE, auto=device)")
     p_serve.add_argument("--no-native", action="store_true",
                          help="disable the GIL-free C++ lane staging for "
                               "this run: the interpreter fill, as before "
@@ -785,6 +792,7 @@ def main(argv=None) -> int:
             lane_buckets=lane_buckets, shards=args.shards,
             pipeline=args.pipeline,
             native=False if args.no_native else None,
+            state=args.state,
             # --no-score forces RCA off even when ANOMOD_SERVE_RCA=1
             # (the explicit CLI ask wins over the env default; the
             # --rca + --no-score combination already parser.error'd)

@@ -243,6 +243,35 @@ def _serve_lane_engine_env() -> str:
         f"pallas, got {raw!r}")
 
 
+def _serve_state_env() -> str:
+    """ANOMOD_SERVE_STATE: where the serving plane keeps tenant replay
+    states between ticks (anomod.serve.batcher).
+
+    ``host`` is the pre-device-pool seam: per-tenant numpy state pytrees,
+    the lane fold materializes every dispatch's deltas to host and adds
+    them per lane.  ``device`` keeps every shard's tenant states in ONE
+    device-resident pool ([slots, SW, F] agg + hist planes, tenants
+    mapped to slots at first service) and folds lane deltas with an
+    on-device scatter-add in dispatch order — pinned BIT-identical to
+    the host seam (an XLA f32 scatter-add with unique per-dispatch slots
+    performs exactly the same elementwise adds), with
+    ``get_state``/``set_state`` surviving as the on-demand gather seam
+    for parity checks, checkpoints and migration.  ``auto`` (the
+    default) resolves to ``device`` for the bucket-runner serve plane on
+    every backend (the pool is exact, not a tolerance trade) and to
+    ``host`` where a pool cannot apply (the mesh plane manages its own
+    sharded state).  Validated here so a typo fails loudly at config
+    construction instead of silently serving the slow seam.
+    """
+    raw = _env("ANOMOD_SERVE_STATE", "auto").strip().lower()
+    if raw in ("auto", ""):
+        return "auto"
+    if raw in ("host", "device"):
+        return raw
+    raise ValueError(
+        f"ANOMOD_SERVE_STATE must be auto, host or device, got {raw!r}")
+
+
 def _serve_rca_env() -> bool:
     """ANOMOD_SERVE_RCA: online root-cause inference in the serve tick.
 
@@ -475,6 +504,11 @@ class Config:
     # Mosaic kernel, TPU opt-in), matmul/scatter (explicit pin).
     serve_lane_engine: str = dataclasses.field(
         default_factory=_serve_lane_engine_env)
+    # ANOMOD_SERVE_STATE — tenant replay state residency: auto (default,
+    # = device for the bucket-runner plane), device (shard-owned
+    # device-resident pool, scatter-add fold, bit-identical), host (the
+    # per-tenant numpy seam; anomod.serve.batcher).
+    serve_state: str = dataclasses.field(default_factory=_serve_state_env)
     # ANOMOD_SERVE_RCA — online root-cause inference in the serve tick
     # (anomod.serve.rca; off = the serving plane stops at alerts).
     serve_rca: bool = dataclasses.field(default_factory=_serve_rca_env)

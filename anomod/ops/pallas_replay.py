@@ -349,3 +349,64 @@ def pallas_replay_numpy(sid, planes, n_segments, n_hist):
     bucket = np.clip(planes[4].astype(np.int32), 0, n_hist - 1)
     np.add.at(out, (sid, N_PLANES + bucket), valid)
     return out[:n_segments]
+
+
+def make_pallas_window_gather_fn(n_services: int, n_windows: int,
+                                 n_feats: int, interpret: bool = False):
+    """The device state pool's batched-scoring gather as ONE Mosaic
+    kernel: ``fn(pool[P, S*W, F], slots[T], cols[T]) -> [T, S, F]`` —
+    tenant ``t``'s scored window column ``pool[slots[t]].reshape(
+    S, W, F)[:, cols[t]]``, one grid step per tenant, slot/column
+    indices scalar-prefetched so the block index maps can address the
+    pool rows directly (the same PrefetchScalarGridSpec pattern as the
+    sorted-window replay kernel above).
+
+    This is the SCORE half of the serve plane's pallas opt-in
+    (``ANOMOD_SERVE_LANE_ENGINE=pallas`` routes the pool's gather here;
+    anomod.replay.TenantStatePool).  A pure copy, so the gathered
+    columns are bit-identical to the XLA take_along_axis gather on
+    every backend — interpret mode keeps it exercised in tier-1 on CPU.
+
+    The FOLD half deliberately stays on XLA's scatter-add: it already
+    runs as one fused dispatch, and a Mosaic scatter must revisit
+    aliased output blocks when lanes share a slot (dead pad lanes all
+    target slot 0), a write-back ordering hazard interpret mode cannot
+    pin — fused-gather + XLA-scatter is the whole win without the
+    unverifiable half.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, W, F = n_services, n_windows, n_feats
+
+    def kernel(slots_ref, cols_ref, pool_ref, out_ref):
+        del slots_ref                  # consumed by the index map
+        row = pool_ref[0].reshape(S, W, F)
+        c = cols_ref[pl.program_id(0)]
+        out_ref[0] = jax.lax.dynamic_slice_in_dim(row, c, 1, axis=1)[:, 0]
+
+    @jax.jit
+    def run(pool, slots, cols):
+        T = slots.shape[0]
+        assert pool.shape[1:] == (S * W, F), "pool must be [P, S*W, F]"
+        assert cols.shape == (T,)
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(T,),
+                in_specs=[
+                    pl.BlockSpec((1, S * W, F),
+                                 lambda t, s, c: (s[t], 0, 0)),
+                ],
+                out_specs=pl.BlockSpec((1, S, F), lambda t, s, c: (t, 0, 0)),
+            ),
+            out_shape=jax.ShapeDtypeStruct((T, S, F), jnp.float32),
+            compiler_params=_compiler_params(
+                dimension_semantics=("arbitrary",)),
+            interpret=interpret,
+        )(slots, cols, pool)
+
+    return run

@@ -403,6 +403,364 @@ def make_lane_delta(cfg: ReplayConfig, engine: str = "scatter"):
     return lane_delta
 
 
+def fold_delta(state: ReplayState, dagg, dhist) -> ReplayState:
+    """THE host-seam fold: apply one lane's aggregation delta to a tenant
+    state with the same elementwise f32 adds the in-step update performs
+    (``state + delta``).  ONE definition shared by the synchronous
+    (``BucketRunner.run_lanes``) and pipelined (``_retire_one``) fold
+    paths — and the contract the device pool's scatter-add is pinned
+    bit-identical to (an XLA f32 scatter with unique per-dispatch slots
+    performs exactly this add per slot)."""
+    return ReplayState(agg=np.asarray(state.agg) + dagg,
+                       hist=np.asarray(state.hist) + dhist)
+
+
+class TenantStatePool:
+    """POOL-RESIDENT per-tenant replay states for the serving plane.
+
+    One ``[slots, SW, F]`` agg plane plus a matching ``[slots, SW, H]``
+    hist plane per shard runner; tenants map to slots at first service
+    (:meth:`acquire`).  Row 0 is the DEAD slot: dead pad lanes (and the
+    non-current occurrences of a duplicated slot, see
+    :meth:`scatter_fold`) scatter their deltas there, and it is never
+    read.  The hot-loop fold becomes one scatter-add per retired
+    dispatch — the per-lane interpreter adds (and, on accelerator
+    backends, the per-tick device→host materialization barrier) of the
+    host seam disappear — while :meth:`gather`/:meth:`put` keep the
+    ``get_state``/``set_state`` round-trip bit-exact for parity checks,
+    checkpoints and (future) migration.
+
+    Two fold ENGINES behind one seam, picked by backend (``auto``):
+
+    - ``jax`` (accelerator backends): the planes are device arrays, the
+      ops are jitted with buffer DONATION (XLA updates them in place —
+      no per-op pool copy), the scored-window gather is one fused
+      dispatch materializing only the requested columns.
+    - ``numpy`` (the CPU backend): "device" memory IS host RAM there,
+      and XLA:CPU's fixed per-dispatch overhead (~0.2-0.5 ms/call)
+      swamps these row shapes — so the planes are host arrays and every
+      op is an in-place vectorized numpy update, with the lane deltas
+      read through the CPU backend's zero-copy ``np.asarray`` view (no
+      readback copy, no XLA dispatch).  Same pool architecture, same
+      adds; the engine choice is measured in
+      ``scripts/bench_fold_sweep.py``.
+
+    Bit-parity contract (pinned in tests/test_serve_state.py, both
+    engines): every pool operation performs the SAME IEEE f32
+    arithmetic as the host seam — scatter-add = ``state + delta`` per
+    slot in dispatch order (duplicate slots within one dispatch fold in
+    lane order via wave splitting), :meth:`roll` =
+    :func:`anomod.stream.roll_ring_state`'s shift+zero, gather/put are
+    pure copies — so ``device`` vs ``host`` serving is byte-identical,
+    not a tolerance trade.
+    """
+
+    def __init__(self, cfg: ReplayConfig, capacity: int = 32,
+                 engine: str = "auto", gather_engine: str = "xla"):
+        import jax
+        import jax.numpy as jnp
+        self.cfg = cfg
+        self._jnp = jnp
+        if engine not in ("auto", "jax", "numpy"):
+            raise ValueError(f"unknown pool engine {engine!r} "
+                             "(auto|jax|numpy)")
+        if engine == "auto":
+            engine = "numpy" if jax.default_backend() == "cpu" else "jax"
+        self.engine = engine
+        if gather_engine not in ("xla", "pallas"):
+            raise ValueError(f"unknown pool gather engine "
+                             f"{gather_engine!r} (xla|pallas)")
+        #: batched-scoring gather formulation: "xla" (take_along_axis /
+        #: the numpy engine's fancy-index twin) or "pallas" (the fused
+        #: Mosaic gather kernel, anomod.ops.pallas_replay.
+        #: make_pallas_window_gather_fn — the serve plane routes
+        #: ANOMOD_SERVE_LANE_ENGINE=pallas here).  A pure copy either
+        #: way: bit-identical outputs.  The scatter FOLD stays on the
+        #: engine's scatter-add (one fused dispatch / one vectorized
+        #: in-place add already; see the kernel's docstring for why a
+        #: Mosaic scatter is the unverifiable half).
+        self.gather_engine = gather_engine
+        self._pallas_gather = None
+        if gather_engine == "pallas":
+            from anomod.ops.pallas_replay import make_pallas_window_gather_fn
+            self._pallas_gather = make_pallas_window_gather_fn(
+                cfg.n_services, cfg.n_windows, N_FEATS,
+                interpret=jax.default_backend() != "tpu")
+        cap = max(int(capacity), 1)
+        # +1: row 0 is the dead slot
+        shape_a = (cap + 1, cfg.sw, N_FEATS)
+        shape_h = (cap + 1, cfg.sw, cfg.n_hist_buckets)
+        if engine == "numpy":
+            self.agg = np.zeros(shape_a, np.float32)
+            self.hist = np.zeros(shape_h, np.float32)
+        else:
+            self.agg = jnp.zeros(shape_a, jnp.float32)
+            self.hist = jnp.zeros(shape_h, jnp.float32)
+        self._free: list = []
+        self._next = 1
+        S, W = cfg.n_services, cfg.n_windows
+        if engine == "numpy":
+            return
+
+        # jitted pool ops (jax engine; jax.jit caches per concrete
+        # shape, so pool growth or new lane-bucket widths just add
+        # compile-cache entries — warm() precompiles the serve grid).
+        # The mutating ops DONATE the planes: the pool is the sole
+        # owner of its buffers (every read goes through gather /
+        # gather_window), so XLA updates the [slots, SW, *] planes in
+        # place instead of copying megabytes per fold — the rebind
+        # below always installs the op's output before anything can
+        # read again.
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def _scatter(agg, hist, slots, dagg, dhist):
+            return agg.at[slots].add(dagg), hist.at[slots].add(dhist)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def _put(agg, hist, slot, ragg, rhist):
+            return agg.at[slot].set(ragg), hist.at[slot].set(rhist)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def _roll(agg, hist, slot, shift):
+            # device twin of anomod.stream.roll_ring_state on one row:
+            # shift plane columns left, zero the tail.  Taken values
+            # pass through verbatim and the tail is exact 0.0, so the
+            # result is bit-identical to the host roll.
+            idx = jnp.arange(W) + shift
+            take = jnp.clip(idx, 0, W - 1)
+            live = (idx < W)[None, :, None]
+
+            def roll2(plane, width):
+                x = plane[slot].reshape(S, W, width)
+                out = jnp.where(live, jnp.take(x, take, axis=1), 0.0)
+                return plane.at[slot].set(out.reshape(S * W, width))
+
+            return (roll2(agg, N_FEATS), roll2(hist, cfg.n_hist_buckets))
+
+        @jax.jit
+        def _gather_window(agg, slots, cols):
+            # [T, S, F]: ONE dispatch materializing only the scored
+            # window column of each requested tenant — the batched
+            # scorer's gather (the full [SW, F] rows stay on device)
+            rows = agg[slots].reshape(slots.shape[0], S, W, N_FEATS)
+            return jnp.take_along_axis(
+                rows, cols[:, None, None, None], axis=2)[:, :, 0]
+
+        self._scatter_fn = _scatter
+        self._put_fn = _put
+        self._roll_fn = _roll
+        self._gather_window_fn = _gather_window
+
+    @property
+    def capacity(self) -> int:
+        return int(self.agg.shape[0]) - 1
+
+    @property
+    def live_slots(self) -> int:
+        return self._next - 1 - len(self._free)
+
+    def acquire(self) -> int:
+        """Map a new tenant to a zeroed slot (>= 1), growing the pool by
+        doubling on exhaustion (growth concatenates zero rows — existing
+        states keep their bits)."""
+        if self._free:
+            return self._free.pop()
+        if self._next > self.capacity:
+            xp = np if self.engine == "numpy" else self._jnp
+            grow = max(self.capacity, 1)
+            self.agg = xp.concatenate(
+                [self.agg, xp.zeros((grow,) + self.agg.shape[1:],
+                                    xp.float32)])
+            self.hist = xp.concatenate(
+                [self.hist, xp.zeros((grow,) + self.hist.shape[1:],
+                                     xp.float32)])
+        slot = self._next
+        self._next += 1
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Return a churned tenant's slot to the free list, zeroed (the
+        next acquire must start from a fresh state)."""
+        z = self.zero_state()
+        self.put(slot, z)
+        self._free.append(int(slot))
+
+    def zero_state(self) -> ReplayState:
+        cfg = self.cfg
+        return ReplayState(
+            agg=np.zeros((cfg.sw, N_FEATS), np.float32),
+            hist=np.zeros((cfg.sw, cfg.n_hist_buckets), np.float32))
+
+    def gather(self, slot: int) -> ReplayState:
+        """On-demand readback of one tenant's state (the get_state seam:
+        parity, checkpoint, calibration, migration).  Always a COPY —
+        the returned pytree must not alias rows later folds mutate."""
+        slot = int(slot)   # a None slot must raise, not np.newaxis
+        if self.engine == "numpy":
+            return ReplayState(agg=self.agg[slot].copy(),
+                               hist=self.hist[slot].copy())
+        return ReplayState(agg=np.asarray(self.agg[slot]),
+                           hist=np.asarray(self.hist[slot]))
+
+    def put(self, slot: int, state: ReplayState) -> None:
+        """Install an externally-built state into a slot (set_state
+        seam); a put(gather()) round-trip is byte-identical."""
+        slot = int(slot)   # a None slot must raise, not broadcast
+        if self.engine == "numpy":
+            self.agg[slot] = np.asarray(state.agg, np.float32)
+            self.hist[slot] = np.asarray(state.hist, np.float32)
+            return
+        self.agg, self.hist = self._put_fn(
+            self.agg, self.hist, np.int32(slot),
+            np.asarray(state.agg, np.float32),
+            np.asarray(state.hist, np.float32))
+
+    def roll(self, slot: int, k: int) -> None:
+        """Evict the oldest ``k`` ring windows of one tenant's row —
+        bit-identical to the host roll_ring_state (values pass through
+        verbatim, the tail is exact 0.0)."""
+        slot = int(slot)
+        shift = min(int(k), self.cfg.n_windows)
+        if self.engine == "numpy":
+            cfg = self.cfg
+            S, W = cfg.n_services, cfg.n_windows
+            for plane, width in ((self.agg, N_FEATS),
+                                 (self.hist, cfg.n_hist_buckets)):
+                x = plane[slot].reshape(S, W, width)   # in-place view
+                if shift < W:
+                    x[:, :W - shift] = x[:, shift:].copy()
+                    x[:, W - shift:] = 0.0
+                else:
+                    x[:] = 0.0
+            return
+        self.agg, self.hist = self._roll_fn(self.agg, self.hist,
+                                            np.int32(slot),
+                                            np.int32(shift))
+
+    def scatter_fold(self, slots, dagg, dhist) -> None:
+        """Fold one retired dispatch's per-lane deltas into the pool:
+        ``pool[slot] += delta`` on device, in dispatch order.
+
+        ``slots`` has one entry per LIVE lane (dead pad lanes are
+        routed to the dead slot 0 here).  Within one dispatch each live
+        slot normally appears once (the engine stacks at most one chunk
+        per tenant per round) and the scatter performs exactly one f32
+        add per slot — the host seam's :func:`fold_delta` bit-for-bit.
+        A duplicated slot folds in lane order on both engines: the
+        numpy engine's per-row in-place adds apply sequentially, and
+        the jax engine splits the dispatch into WAVES (k-th occurrence
+        in wave k, other lanes routed to the dead slot — XLA's
+        duplicate-index add order is unspecified) — always
+        ((state + d_i) + d_j), never a pre-combined d_i + d_j."""
+        L = dagg.shape[0]
+        if self.engine == "numpy":
+            ls = [int(s) for s in slots]
+            n = len(ls)
+            if not n:
+                return
+            # the CPU backend's np.asarray of a jax array is a
+            # zero-copy view (it blocks until the dispatch's outputs
+            # are ready) — the fold reads the deltas in place, with no
+            # readback copy and no fresh state allocations: one slice
+            # += when the slots are a contiguous run, else per-row
+            # in-place adds (measured in bench_fold_sweep.py — a
+            # fancy-index += triggers numpy's gather/add/scatter
+            # temporaries and loses to both)
+            da = np.asarray(dagg)
+            dh = np.asarray(dhist)
+            lo = ls[0]
+            if ls == list(range(lo, lo + n)):
+                self.agg[lo:lo + n] += da[:n]
+                self.hist[lo:lo + n] += dh[:n]
+            else:
+                for i, s in enumerate(ls):
+                    a = self.agg[s]
+                    np.add(a, da[i], out=a)
+                    h = self.hist[s]
+                    np.add(h, dh[i], out=h)
+            return
+        live = np.asarray(slots, np.int32)
+        n = len(live)
+        waves = 1
+        wave_of = None
+        if n and len(np.unique(live)) != n:
+            order = {}
+            wave_of = np.zeros(n, np.int32)
+            for i, s in enumerate(live.tolist()):
+                wave_of[i] = order.get(s, 0)
+                order[s] = wave_of[i] + 1
+            waves = int(wave_of.max()) + 1
+        lane_slots = np.zeros(L, np.int32)
+        lane_slots[:n] = live
+        for k in range(waves):
+            ws = lane_slots.copy()
+            if waves > 1:
+                mask = np.zeros(L, bool)
+                mask[:n] = wave_of == k
+                ws[~mask] = 0
+            self.agg, self.hist = self._scatter_fn(
+                self.agg, self.hist, ws, dagg, dhist)
+
+    def gather_window(self, slots, cols) -> np.ndarray:
+        """[T, S, F] host copy of one plane column per tenant — the
+        batched scorer's fused gather (one dispatch, only the scored
+        columns materialize).  The request pads to the next power of
+        two with dead-slot/column-0 entries (sliced off before return),
+        so the jitted gather compiles O(log capacity) shapes instead of
+        one per distinct tenant count."""
+        slots = np.asarray(slots, np.int32)
+        cols = np.asarray(cols, np.int32)
+        T = slots.shape[0]
+        if self._pallas_gather is None and self.engine == "numpy":
+            cfg = self.cfg
+            r = self.agg.reshape(self.agg.shape[0], cfg.n_services,
+                                 cfg.n_windows, N_FEATS)
+            return r[slots[:, None], :, cols[:, None]][:, 0]
+        pad = 1
+        while pad < T:
+            pad *= 2
+        if pad != T:
+            slots = np.concatenate([slots, np.zeros(pad - T, np.int32)])
+            cols = np.concatenate([cols, np.zeros(pad - T, np.int32)])
+        fn = (self._pallas_gather if self._pallas_gather is not None
+              else self._gather_window_fn)
+        return np.asarray(fn(self.agg, slots, cols))[:T]
+
+    def gather_rows(self, slots) -> np.ndarray:
+        """[T, SW, F] host copy of whole agg rows (calibration-time
+        bulk gather; scoring uses :meth:`gather_window`)."""
+        return np.asarray(self.agg[np.asarray(slots, np.int32)])
+
+    def warm(self, lane_buckets: Tuple[int, ...] = ()) -> float:
+        """Compile the pool's hot ops OUTSIDE the measured serve wall:
+        one scatter shape per lane bucket (all-zero deltas into the dead
+        slot — numerically a no-op on any state), the put/roll row ops,
+        and the power-of-two gather grid up to capacity.  Idempotent
+        per shape (jax.jit caches); a no-op on the numpy engine (nothing
+        compiles there).  Returns the warm wall."""
+        if self.engine == "numpy" and self._pallas_gather is None:
+            return 0.0
+        t0 = time.perf_counter()
+        cfg = self.cfg
+        if self.engine != "numpy":
+            for lanes in lane_buckets:
+                self.scatter_fold(
+                    [0], np.zeros((lanes, cfg.sw, N_FEATS), np.float32),
+                    np.zeros((lanes, cfg.sw, cfg.n_hist_buckets),
+                             np.float32))
+            self.put(0, self.zero_state())
+            self.roll(0, 0)
+        pad = 1
+        while True:
+            self.gather_window(np.zeros(pad, np.int32),
+                               np.zeros(pad, np.int32))
+            if pad >= self.capacity:
+                break
+            pad *= 2
+        if self.engine != "numpy":
+            self.agg.block_until_ready()
+        return time.perf_counter() - t0
+
+
 def make_replay_fn(cfg: ReplayConfig, with_hll: bool = False,
                    inner_repeats: int = 1):
     """Build the jitted replay: scan over chunks, one-hot matmul aggregation.

@@ -79,9 +79,10 @@ from anomod.config import validate_lane_buckets
 from anomod.config import validate_serve_buckets as validate_buckets
 from anomod.io import native as native_io
 from anomod.replay import (N_FEATS, STAGE_KEYS, ReplayConfig, ReplayState,
-                           dead_chunk, default_lane_engine,
-                           default_step_engine, make_chunk_step,
-                           make_lane_delta, stage_columns_fused)
+                           TenantStatePool, dead_chunk,
+                           default_lane_engine, default_step_engine,
+                           fold_delta, make_chunk_step, make_lane_delta,
+                           stage_columns_fused)
 from anomod.schemas import SpanBatch
 from anomod.stream import StreamReplay
 
@@ -126,7 +127,9 @@ class BucketRunner:
                  engine: Optional[str] = None, registry=None,
                  pipeline: int = 1,
                  native_stage: Optional[bool] = None,
-                 lane_engine: Optional[str] = None):
+                 lane_engine: Optional[str] = None,
+                 state: Optional[str] = None,
+                 pool_slots: int = 32):
         import jax
         from anomod.config import get_config
         if buckets is None:
@@ -136,6 +139,29 @@ class BucketRunner:
         if pipeline < 1:
             raise ValueError("pipeline depth must be >= 1")
         self.cfg = cfg
+        #: tenant-state residency (the validated ANOMOD_SERVE_STATE knob
+        #: unless the caller overrides): "device" owns a per-runner
+        #: TenantStatePool — tenants map to slots at first service, the
+        #: retire fold is an on-device scatter-add in dispatch order,
+        #: pinned BIT-identical to the host seam — "host" is the
+        #: per-tenant numpy pytree seam.  "auto" resolves to device on
+        #: every backend: the pool performs the exact same f32 adds, so
+        #: there is no tolerance trade to gate on.
+        _state = state if state is not None else get_config().serve_state
+        if _state not in ("auto", "host", "device"):
+            raise ValueError(f"unknown serve state mode {_state!r} "
+                             "(auto|host|device)")
+        self.state_mode = "device" if _state == "auto" else _state
+        _lane_eng = lane_engine if lane_engine is not None else \
+            (engine if engine is not None else default_lane_engine())
+        #: the shard's device-resident state pool (None on the host
+        #: seam).  ANOMOD_SERVE_LANE_ENGINE=pallas routes the pool's
+        #: batched-scoring gather to the fused Mosaic kernel too (the
+        #: same TPU opt-in; bit-identical — a pure copy either way).
+        self.pool = (TenantStatePool(
+            cfg, capacity=max(int(pool_slots), 1),
+            gather_engine="pallas" if _lane_eng == "pallas" else "xla")
+            if self.state_mode == "device" else None)
         #: GIL-free native scratch packing (anomod.io.native.stage_lanes):
         #: resolved from the validated ANOMOD_NATIVE knob (auto/on/off)
         #: unless the caller overrides — the bench's python-staging
@@ -165,8 +191,7 @@ class BucketRunner:
         #: a deliberate TPU opt-in whose latency moments carry the bf16
         #: hi/lo envelope), else the step engine itself so fused and
         #: single-chunk dispatch stay BIT-identical on every backend
-        self.lane_engine = lane_engine if lane_engine is not None else \
-            (engine if engine is not None else default_lane_engine())
+        self.lane_engine = _lane_eng
         step = make_chunk_step(cfg, with_hll=False, engine=self.engine)
         self._step = jax.jit(lambda st, ch: step(st, ch)[0])
         self._lane_fn = jax.jit(make_lane_delta(cfg,
@@ -195,6 +220,10 @@ class BucketRunner:
         self.stage_wall_s = 0.0
         self.dispatch_wall_s = 0.0
         self.fold_wall_s = 0.0
+        #: window-scoring wall (the engine's COMMIT phase adds here, so
+        #: the decomposition splits the old ``other`` leg into score vs
+        #: true bookkeeping)
+        self.score_wall_s = 0.0
         #: fused dispatches whose scratch was packed natively (GIL-free)
         self.native_staged = 0
         #: fused dispatches per lane-bucket (the lanes histogram's
@@ -253,6 +282,7 @@ class BucketRunner:
         self._obs_dispatch_s = reg.counter(
             "anomod_serve_dispatch_seconds_total")
         self._obs_fold_s = reg.counter("anomod_serve_fold_seconds_total")
+        self._obs_score_s = reg.counter("anomod_serve_score_seconds_total")
         self._obs_native = reg.counter("anomod_serve_native_staged_total")
         reg.gauge("anomod_serve_native_staging").set(
             1.0 if self.native_stage else 0.0)
@@ -316,6 +346,11 @@ class BucketRunner:
                 dagg, _ = exe(stacked)
                 np.asarray(dagg)                # execute barrier
                 total += self._lane_compile_s[key]
+        if self.pool is not None:
+            # device-state mode: the pool's scatter/gather/roll shapes
+            # compile here too, so the first serving tick never pays a
+            # pool-op compile inside the measured wall
+            total += self.pool.warm(self.lane_buckets)
         return total
 
     def _lane_exec_for(self, key: Tuple[int, int], args: dict):
@@ -572,9 +607,7 @@ class BucketRunner:
             dagg = np.asarray(dagg)
             dhist = np.asarray(dhist)
             for i, (st, _) in enumerate(group):
-                out.append(ReplayState(
-                    agg=np.asarray(st.agg) + dagg[i],
-                    hist=np.asarray(st.hist) + dhist[i]))
+                out.append(fold_delta(st, dagg[i], dhist[i]))
             t2 = time.perf_counter()
             self.dispatch_wall_s += t1 - t0
             self._obs_dispatch_s.inc(t1 - t0)
@@ -618,20 +651,39 @@ class BucketRunner:
                 self._retire_one()
 
     def _retire_one(self) -> None:
-        """Materialize the OLDEST in-flight dispatch (the host copy is
-        the execute barrier — after it, the dispatch can no longer read
-        its scratch slot) and fold its per-lane deltas into the paired
-        replay planes through the get_state/set_state seam, with the
-        same elementwise f32 add the in-step update performs."""
+        """Retire the OLDEST in-flight dispatch and fold its per-lane
+        deltas into the paired replay planes.
+
+        DEVICE path (every paired replay lives in this runner's state
+        pool): the fold is ONE on-device scatter-add
+        (``TenantStatePool.scatter_fold``) — no host materialization of
+        the [lanes, SW, F+H] deltas, no per-lane numpy adds — pinned
+        bit-identical to the host seam because the scatter performs the
+        same f32 ``state + delta`` per slot in the same dispatch order.
+        The scratch-reuse barrier is ``block_until_ready`` on the delta:
+        the lane dispatch's outputs being ready means it can no longer
+        read its host scratch slot (no host copy needed).
+
+        HOST path (any replay without a slot on this pool — the
+        host-seam mode, or generic callers pairing plain replays): the
+        host copy is the execute barrier, then :func:`fold_delta` per
+        lane through the get_state/set_state seam — the same
+        elementwise f32 add the in-step update performs."""
         replays, dagg, dhist, _ = self._inflight.popleft()
         t0 = time.perf_counter()
-        dagg = np.asarray(dagg)
-        dhist = np.asarray(dhist)
-        for i, replay in enumerate(replays):
-            st = replay.get_state()
-            replay.set_state(ReplayState(
-                agg=np.asarray(st.agg) + dagg[i],
-                hist=np.asarray(st.hist) + dhist[i]))
+        pool = self.pool
+        if pool is not None and replays and all(
+                getattr(r, "_slot", None) is not None
+                and getattr(r, "_runner", None) is self
+                for r in replays):
+            pool.scatter_fold([r._slot for r in replays], dagg, dhist)
+            dagg.block_until_ready()           # scratch-reuse barrier
+        else:
+            dagg = np.asarray(dagg)
+            dhist = np.asarray(dhist)
+            for i, replay in enumerate(replays):
+                replay.set_state(fold_delta(replay.get_state(),
+                                            dagg[i], dhist[i]))
         dt = time.perf_counter() - t0
         self.fold_wall_s += dt
         self._obs_fold_s.inc(dt)
@@ -725,3 +777,63 @@ class BucketedStreamReplay(StreamReplay):
         for width, cols in plan:
             self.state = self._runner.dispatch(self.state, cols, width)
         return w_ret
+
+
+class PooledStreamReplay(BucketedStreamReplay):
+    """BucketedStreamReplay whose state lives in the runner's
+    DEVICE-RESIDENT tenant pool (``ANOMOD_SERVE_STATE=device``/``auto``).
+
+    The tenant maps to a pool slot at construction (= first service).
+    ``state`` stays the official surface — reads GATHER the slot to host,
+    writes SCATTER it back, so every ``get_state``/``set_state`` consumer
+    (parity tests, checkpoints, the host-seam fold fallback, future
+    migration) behaves exactly as before and round-trips byte-identically
+    — but the hot paths never touch it: the lane fold is the runner's
+    on-device scatter-add (:meth:`BucketRunner._retire_one`), the ring
+    roll runs on the pool row (bit-identical to the host roll), and the
+    batched serve scorer gathers only the scored window columns."""
+
+    def __init__(self, cfg: ReplayConfig, t0_us: int, runner: BucketRunner):
+        if runner.pool is None:
+            raise ValueError(
+                "runner keeps host-seam states (ANOMOD_SERVE_STATE=host); "
+                "use BucketedStreamReplay or a device-state runner")
+        self._slot = runner.pool.acquire()
+        try:
+            super().__init__(cfg, t0_us, runner)
+        except BaseException:
+            # a failed construction must hand its slot back, or every
+            # retried admission leaks a pool row
+            runner.pool.release(self._slot)
+            self._slot = None
+            raise
+
+    def _live_slot(self) -> int:
+        # a released replay must fail loud: pool.put(None, ...) would
+        # broadcast over EVERY slot (None is np.newaxis on the numpy
+        # engine) — silent fleet-wide state corruption
+        if self._slot is None:
+            raise ValueError("pool slot was released (tenant churn); "
+                             "this PooledStreamReplay is dead")
+        return self._slot
+
+    @property
+    def state(self) -> ReplayState:
+        return self._runner.pool.gather(self._live_slot())
+
+    @state.setter
+    def state(self, st: ReplayState) -> None:
+        self._runner.pool.put(self._live_slot(), st)
+
+    def _roll(self, k: int) -> None:
+        self._runner.pool.roll(self._live_slot(), k)
+        self.t0_us += k * self.cfg.window_us
+        self.window_offset += k
+
+    def release(self) -> None:
+        """Return the slot to the pool, zeroed (tenant churn; the
+        migration seam's teardown half).  Idempotent is NOT the
+        contract — a double release would re-free a slot another
+        tenant may already own."""
+        self._runner.pool.release(self._live_slot())
+        self._slot = None

@@ -47,9 +47,10 @@ import numpy as np
 from anomod import obs
 from anomod.ops.tdigest import (TDigest, tdigest_build, tdigest_merge_many,
                                 tdigest_quantile)
-from anomod.replay import ReplayConfig
+from anomod.replay import N_FEATS, ReplayConfig
 from anomod.schemas import concat_span_batches
-from anomod.serve.batcher import BucketedStreamReplay, BucketRunner
+from anomod.serve.batcher import (BucketedStreamReplay, BucketRunner,
+                                  PooledStreamReplay)
 from anomod.serve.queues import (AdmissionController, QueuedBatch,
                                  TenantSpec)
 
@@ -149,8 +150,44 @@ SHARD_VARIANT_REPORT_FIELDS = (
     "shard_spans", "shard_imbalance", "rca_latency", "rca_wall_s",
     # tick-wall decomposition: wall measurements, and the native-staged
     # dispatch count follows the fused-dispatch grouping topology
-    "stage_wall_s", "dispatch_wall_s", "fold_wall_s",
+    "stage_wall_s", "dispatch_wall_s", "fold_wall_s", "score_wall_s",
     "native_staged_dispatches")
+
+
+def _plane_col_gather(work):
+    """The ``gather_cols`` backend for one batched COMMIT pass
+    (:func:`anomod.stream.score_closed_windows_batched`) over the
+    engine's replay planes.
+
+    DEVICE path — every requested plane lives in the SAME runner's
+    tenant pool (the engine maps a tenant's replay to its owning
+    shard's runner, and one commit pass only ever sees one shard's
+    tenants): ONE fused pool gather per scored window
+    (:meth:`anomod.replay.TenantStatePool.gather_window`), so only the
+    small scored columns materialize to host — never the full
+    [SW, F] rows.  HOST path (host-seam replays, or mixed callers):
+    per-plane host views, cached across the pass's windows (the plane
+    is static during scoring — same snapshot discipline as the
+    sequential scorer's one ``agg_plane()`` read)."""
+    planes: Dict[int, np.ndarray] = {}
+
+    def gather(items):
+        reps = [work[i][0].replay for i, _ in items]
+        if reps and all(type(r) is PooledStreamReplay for r in reps) \
+                and all(r._runner is reps[0]._runner for r in reps):
+            return reps[0]._runner.pool.gather_window(
+                [r._slot for r in reps], [c for _, c in items])
+        out = np.empty((len(items), reps[0].cfg.n_services, N_FEATS),
+                       np.float32)
+        for j, (i, c) in enumerate(items):
+            pl = planes.get(i)
+            if pl is None:
+                pl = planes[i] = np.asarray(
+                    work[i][0].replay.agg_plane(), np.float32)
+            out[j] = pl[:, c]
+        return out
+
+    return gather
 
 
 def onset_eligible(window: int, onset_window: int) -> bool:
@@ -197,9 +234,12 @@ class ServeReport:
     lane_compile_s: float
     native_staging: bool                         # GIL-free C++ scratch pack?
     native_staged_dispatches: int                # fused dispatches so packed
+    serve_state: str                             # tenant states: host|device
     stage_wall_s: float                          # host packing wall
     dispatch_wall_s: float                       # executable-issue wall
-    fold_wall_s: float                           # materialize+state-add wall
+    fold_wall_s: float                           # delta fold wall (device:
+    #                                              scatter-add + barrier)
+    score_wall_s: float                          # window-scoring wall
     shards: int                                  # engine-worker shard count
     pipeline: int                                # in-flight dispatch depth
     shard_tenants: Dict[int, int]                # tenants owned per shard
@@ -267,7 +307,8 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   shards: Optional[int] = None,
                   pipeline: Optional[int] = None,
                   rca: Optional[bool] = None,
-                  native: Optional[bool] = None
+                  native: Optional[bool] = None,
+                  state: Optional[str] = None
                   ) -> Tuple["ServeEngine", ServeReport]:
     """The canonical seeded serve run shared by ``anomod serve`` and
     ``bench.py --mode serve``: a power-law tenant fleet offering
@@ -295,7 +336,8 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          z_threshold=z_threshold, mesh=mesh,
                          tracer=tracer, fuse=fuse,
                          lane_buckets=lane_buckets, shards=shards,
-                         pipeline=pipeline, rca=rca, native=native)
+                         pipeline=pipeline, rca=rca, native=native,
+                         state=state)
     report = engine.run(traffic, duration_s=duration_s)
     return engine, report
 
@@ -322,7 +364,8 @@ class ServeEngine:
                  rca_topk: Optional[int] = None,
                  rca_budget: Optional[int] = None,
                  rca_windows: Optional[int] = None,
-                 native: Optional[bool] = None):
+                 native: Optional[bool] = None,
+                 state: Optional[str] = None):
         from anomod.config import get_config
         from anomod.utils.platform import enable_jit_cache
         if capacity_spans_per_s <= 0:
@@ -379,6 +422,25 @@ class ServeEngine:
             raise ValueError(
                 "the mesh plane manages its own sharded dispatch; "
                 "run it with shards=1 (ANOMOD_SERVE_SHARDS=1)")
+        #: tenant-state residency (ANOMOD_SERVE_STATE): "device" keeps
+        #: each shard's tenant states in its runner's device-resident
+        #: pool (lane folds = on-device scatter-adds in dispatch order,
+        #: pinned BIT-identical to the host seam); "host" is the
+        #: per-tenant numpy seam.  The mesh plane manages its own
+        #: sharded state, so the pool cannot apply there: forcing
+        #: "device" with a mesh is refused (auto degrades to host).
+        _state = state if state is not None else app_cfg.serve_state
+        if _state not in ("auto", "host", "device"):
+            raise ValueError(f"unknown serve state mode {_state!r} "
+                             "(auto|host|device)")
+        if mesh is not None:
+            if _state == "device":
+                raise ValueError(
+                    "the mesh plane manages its own sharded state; "
+                    "a device state pool cannot apply "
+                    "(ANOMOD_SERVE_STATE=host or auto)")
+            _state = "host"
+        self.serve_state = "device" if _state == "auto" else _state
         _buckets = (buckets if buckets is not None
                     else app_cfg.serve_buckets)
         self._proc_registry = obs.get_registry()
@@ -395,11 +457,14 @@ class ServeEngine:
             self._shard_regs = [
                 obs.Registry(enabled=self._proc_registry.enabled)
                 for _ in range(self.shards)]
+            owned = [sum(1 for t in self.shard_of.values() if t == s)
+                     for s in range(self.shards)]
             self._runners = [
                 BucketRunner(self.cfg, _buckets, lane_buckets=lane_buckets,
                              registry=reg, pipeline=self.pipeline,
-                             native_stage=native)
-                for reg in self._shard_regs]
+                             native_stage=native, state=self.serve_state,
+                             pool_slots=max(owned[s], 1))
+                for s, reg in enumerate(self._shard_regs)]
             self._fold_state = [dict() for _ in range(self.shards)]
             self.runner = self._runners[0]
         else:
@@ -407,7 +472,9 @@ class ServeEngine:
             self.runner = BucketRunner(self.cfg, _buckets,
                                        lane_buckets=lane_buckets,
                                        pipeline=self.pipeline,
-                                       native_stage=native)
+                                       native_stage=native,
+                                       state=self.serve_state,
+                                       pool_slots=max(len(self.specs), 1))
             self._runners = [self.runner]
         self._workers = None
         #: online RCA (ANOMOD_SERVE_RCA): when a tenant's detector fires
@@ -517,9 +584,12 @@ class ServeEngine:
                 else:
                     got._fn = self._shared_sharded_fn
             else:
-                got = BucketedStreamReplay(
-                    self.cfg, self.t0_us,
-                    self._runners[self.shard_of.get(tenant_id, 0)])
+                runner = self._runners[self.shard_of.get(tenant_id, 0)]
+                # first service maps the tenant to its shard's pool slot
+                # (device mode); the host seam keeps per-tenant pytrees
+                cls = (PooledStreamReplay if runner.pool is not None
+                       else BucketedStreamReplay)
+                got = cls(self.cfg, self.t0_us, runner)
             self._tenant_replay[tenant_id] = got
         return got
 
@@ -704,19 +774,21 @@ class ServeEngine:
         """
         pending = self._stage_pending(served)
         self._dispatch_rounds(pending, self.runner)
-        self._commit_pending(pending)
+        self._commit_pending(pending, self.runner)
 
     def _dispatch_rounds(self, pending: list, runner) -> None:
         """Phase 2 of fused scoring (STACK + DISPATCH), shared by the
         inline and sharded paths: per chunk round, same-width staged
-        chunks lane-stack into fused dispatches.  With the runner's
-        pipeline depth > 1 the dispatches go through the ASYNC
-        submit/drain path — stage round r+1's scratch while round r's
-        XLA dispatch is still in flight, fold deltas in dispatch order
-        at retire (bit-identical at any depth), drain before window
-        scoring.  Depth 1 is the synchronous pre-pipelining path,
-        unchanged."""
-        pipelined = runner.pipeline > 1
+        chunks lane-stack into fused dispatches through the runner's
+        submit/drain path.  At pipeline depth 1 every dispatch retires
+        immediately after issue (the exact synchronous fold order);
+        depth > 1 stages round r+1's scratch while round r's XLA
+        dispatch is still in flight, folding deltas in dispatch order at
+        retire (bit-identical at any depth), drained before window
+        scoring.  With the device state pool the retire fold is an
+        on-device scatter-add — the replay planes ride the submit path
+        at EVERY depth so per-tenant host states never materialize in
+        the hot loop."""
         try:
             rnd = 0
             while True:
@@ -727,26 +799,16 @@ class ServeEngine:
                 if not groups:
                     break
                 for width in sorted(groups):
-                    idxs = groups[width]
-                    if pipelined:
-                        runner.submit_lanes(
-                            width, [(pending[i][1], pending[i][4][rnd][1])
-                                    for i in idxs])
-                    else:
-                        work = [(pending[i][1].get_state(),
-                                 pending[i][4][rnd][1]) for i in idxs]
-                        for i, st in zip(idxs,
-                                         runner.run_lanes(width, work)):
-                            pending[i][1].set_state(st)
+                    runner.submit_lanes(
+                        width, [(pending[i][1], pending[i][4][rnd][1])
+                                for i in groups[width]])
                 rnd += 1
-            if pipelined:
-                runner.drain_lanes()     # tick-end barrier: folds land
+            runner.drain_lanes()         # tick-end barrier: folds land
         except BaseException:
             # a failed tick must not park its issued dispatches in the
             # runner: a LATER tick's drain would fold the aborted
             # tick's stale deltas into tenant states with no error
-            if pipelined:
-                runner.abort_lanes()
+            runner.abort_lanes()
             raise
 
     def _stage_pending(self, served: List[QueuedBatch]) -> list:
@@ -775,16 +837,38 @@ class ServeEngine:
             pending.append((det, replay, batch.n_spans, w_ret, plan))
         return pending
 
-    def _commit_pending(self, pending: list) -> None:
+    def _commit_pending(self, pending: list, runner) -> None:
         """Phase 3 of fused scoring (COMMIT), shared by the inline and
         sharded paths: per tenant, the detector's post-replay half
-        scores newly closed windows exactly as a sequential push
-        would."""
+        scores newly closed windows exactly as a sequential push would —
+        with every batch-scorable tenant's window scoring VECTORIZED
+        into one pass per closed window
+        (anomod.stream.score_closed_windows_batched: the sequential
+        scorer's own z core with a leading tenant axis, byte-identical
+        alerts/streaks/CUSUM — pinned), fed by one fused device-pool
+        gather that materializes only the scored columns.  Modality and
+        edge-attributing detectors keep the per-tenant sequential path.
+        The wall lands in the ``score`` leg of the serve
+        decomposition."""
+        from anomod.stream import score_closed_windows_batched
+        t0 = time.perf_counter()
+        work = []
         for det, replay, n_in, w_ret, plan in pending:
-            if det is not None:
-                t0 = time.perf_counter()
+            if det is None:
+                continue
+            if det.batch_scorable:
+                through = det.note_bookkeep(n_in, w_ret)
+                rng = (det.scoring_window_range(through)
+                       if through is not None else None)
+                if rng is not None:
+                    work.append((det, rng[0], rng[1]))
+            else:
                 det.note_pushed(n_in, w_ret)
-                det.push_wall_s += time.perf_counter() - t0
+        if work:
+            score_closed_windows_batched(work, _plane_col_gather(work))
+        dt = time.perf_counter() - t0
+        runner.score_wall_s += dt
+        runner._obs_score_s.inc(dt)
 
     # -- the sharded (scale-out) score path -------------------------------
 
@@ -854,7 +938,7 @@ class ServeEngine:
         if self._fused:
             pending = self._stage_pending(served)
             self._dispatch_rounds(pending, runner)
-            self._commit_pending(pending)
+            self._commit_pending(pending, runner)
         else:
             for qb in served:
                 if self.score:
@@ -1119,7 +1203,7 @@ class ServeEngine:
         staged_lanes = live_lanes = fused_dispatches = 0
         compile_s = lane_compile_s = 0.0
         native_staged = 0
-        stage_wall = dispatch_wall = fold_wall = 0.0
+        stage_wall = dispatch_wall = fold_wall = score_wall = 0.0
         for r in self._runners:
             for w, n in r.dispatches_by_width.items():
                 disp_by_width[w] = disp_by_width.get(w, 0) + n
@@ -1134,6 +1218,7 @@ class ServeEngine:
             stage_wall += r.stage_wall_s
             dispatch_wall += r.dispatch_wall_s
             fold_wall += r.fold_wall_s
+            score_wall += r.score_wall_s
         shard_tenants: Dict[int, int] = {s: 0 for s in range(self.shards)}
         shard_spans: Dict[int, int] = {s: 0 for s in range(self.shards)}
         for spec in self.specs:
@@ -1181,9 +1266,11 @@ class ServeEngine:
             lane_compile_s=round(lane_compile_s, 4),
             native_staging=any(r.native_stage for r in self._runners),
             native_staged_dispatches=native_staged,
+            serve_state=self.serve_state,
             stage_wall_s=round(stage_wall, 4),
             dispatch_wall_s=round(dispatch_wall, 4),
             fold_wall_s=round(fold_wall, 4),
+            score_wall_s=round(score_wall, 4),
             shards=self.shards,
             pipeline=self.pipeline,
             shard_tenants=shard_tenants,

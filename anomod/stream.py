@@ -174,6 +174,73 @@ def resolve_parent_services(batch: SpanBatch) -> np.ndarray:
     return psvc
 
 
+def window_span_z(col_plane: np.ndarray, b: dict, cusum, cusum_k,
+                  min_count, drop_memory) -> dict:
+    """THE per-closed-window span-plane z math, in one place.
+
+    ``col_plane`` is the window's aggregate column ``[..., K, F]``,
+    ``b`` the frozen calibration snapshot with ``[..., K]`` fields,
+    ``cusum``/``cusum_k`` the CUSUM carry state, ``min_count`` /
+    ``drop_memory`` the detector thresholds (scalars, or ``[..., 1]``
+    arrays when batching).  Everything is elementwise/broadcast numpy,
+    so a leading batch axis prepends freely: the sequential scorer
+    (:meth:`OnlineDetector._score_through`, no batch axis) and the
+    serving plane's batched scorer (:func:`score_closed_windows_batched`,
+    tenants stacked on axis 0) run the IDENTICAL per-element arithmetic
+    — which is what makes batched serving scoring byte-identical to
+    per-tenant scoring, pinned in tests/test_serve_state.py.
+
+    The three signals read straight off the aggregate plane's moments,
+    each normalized by the statistically right denominator for sparse
+    windows (see the scoring notes on :class:`OnlineDetector`):
+    latency = standard-error z on the window's log-latency mean, error
+    rate = binomial z vs the pooled baseline, throughput = Poisson z on
+    MISSING spans plus a recovery-resetting CUSUM (the signal that
+    catches a SPARSE service going dark — per-window evidence for a
+    3-spans/min service never clears any sane threshold; 8 windows of
+    total silence does).  The ``frac_*`` weights price detection vs
+    localization: a high-fan-in carrier's statistically huge z on a 30%
+    dip must not outrank certainty about a service 100% dark, so the
+    ranking score weights the drop signals by their deficit FRACTION.
+
+    Returns ``dict(zl, ze, zd, zdc, frac_w, frac_t, cusum, cusum_k)``
+    with the CUSUM state advanced (the caller installs it).
+    """
+    n_w = col_plane[..., F_COUNT]
+    safe = np.maximum(n_w, 1.0)
+    ok = (n_w >= min_count) & b["calibrated"]
+    zl = np.where(ok, (col_plane[..., F_LOGLAT] / safe - b["mu_l"])
+                  / np.sqrt(b["var_span"] / safe + b["var_bl"]), 0.0)
+    ze = np.where(ok, (col_plane[..., F_ERR] / safe - b["p_err"])
+                  / np.sqrt(b["err_var"] / safe + b["var_be"]), 0.0)
+    zd = np.where(b["active"], (b["rate0"] - n_w) / b["sd_cnt"], 0.0)
+    # CUSUM on missing throughput: the slack term keeps healthy jitter
+    # from accumulating; a window back at (or above) the baseline rate
+    # RESETS the run — no lingering "still down" alerts after recovery.
+    # Run length is capped at drop_memory for the normalization.
+    healthy = n_w >= b["rate0"]
+    slack = 0.25 * b["sd_cnt"]
+    cusum = np.where(healthy, 0.0,
+                     np.maximum(0.0, cusum + b["rate0"] - n_w - slack))
+    cusum_k = np.where(cusum > 0,
+                       np.minimum(cusum_k + 1, drop_memory),
+                       0).astype(np.int32)
+    k_run = np.maximum(cusum_k, 1)
+    zdc = np.where(b["cum_active"],
+                   cusum / (b["sd_cnt"] * np.sqrt(k_run)), 0.0)
+    frac_t = np.clip(cusum / np.maximum(k_run * b["rate0"], 1e-9),
+                     0.0, 1.0)
+    frac_w = np.clip(1.0 - n_w / np.maximum(b["rate0"], 1e-9), 0.0, 1.0)
+    return dict(zl=zl, ze=ze, zd=zd, zdc=zdc, frac_w=frac_w,
+                frac_t=frac_t, cusum=cusum, cusum_k=cusum_k)
+
+
+#: ranking-evidence channel order of the base span planes — the ONE
+#: ordering shared by the sequential scorer's part dicts and the batched
+#: scorer's stacks (argmax indices must mean the same channel in both)
+SPAN_EV_NAMES = ("latency", "error", "drop", "cusum")
+
+
 class StreamReplay:
     """Incremental replay state over arrival-ordered span micro-batches.
 
@@ -569,11 +636,55 @@ class OnlineDetector:
         the newly closed windows.  ``n_spans`` is the ORIGINAL batch's
         span count (pre edge duplication); ``w_max`` is the replay
         plane's returned newest absolute window."""
-        if w_max < 0:
+        through = self.note_bookkeep(n_spans, w_max)
+        if through is None:
             return []
+        return self._score_through(through)
+
+    def note_bookkeep(self, n_spans: int, w_max: int) -> Optional[int]:
+        """The bookkeeping half of :meth:`note_pushed` (span count +
+        window high-water mark); returns the ``through`` bound scoring
+        would scan, or None for an empty push.  The serving plane's
+        batched COMMIT phase calls this per tenant and then scores every
+        batch-scorable tenant in one vectorized pass
+        (:func:`score_closed_windows_batched`) — one definition of the
+        bookkeeping for the sequential and batched paths."""
+        if w_max < 0:
+            return None
         self.n_spans_in += n_spans
         self._max_seen = max(self._max_seen, w_max)
-        return self._score_through(self._max_seen - 1)
+        return self._max_seen - 1
+
+    def scoring_window_range(self, through: int):
+        """The closed-window range ``(start, through)`` that
+        :meth:`_score_through` would score, or None after recording the
+        no-op advance — ONE definition of the early return, shared by
+        the sequential scorer and the batched serve scorer (so the two
+        advance ``_scored_through`` identically)."""
+        start = max(self._scored_through + 1, self.baseline_windows)
+        if through < start:
+            self._scored_through = max(self._scored_through, through)
+            return None
+        return start, through
+
+    def ensure_baseline(self, plane: np.ndarray) -> dict:
+        """The frozen calibration snapshot, computed from ``plane`` on
+        first need.  Calibration reads only columns ``[0, B)``, so the
+        batched serve scorer may pass a gathered ``[K, B, F]`` block —
+        same values, same frozen statistics."""
+        if self._baseline is None:
+            self._baseline = self._calibrate(plane)
+        return self._baseline
+
+    @property
+    def batch_scorable(self) -> bool:
+        """True when scoring is exactly the base span-plane math — no
+        edge rows, no modality planes — i.e. the serve engine's batched
+        scorer (:func:`score_closed_windows_batched`) can score this
+        detector in the vectorized pass with byte-identical results.
+        Subclasses (the multimodal detector: per-tenant modality dicts)
+        and edge-attributing detectors keep the sequential path."""
+        return type(self) is OnlineDetector and not self.edge_attribution
 
     def finish(self) -> List[Alert]:
         """End of stream: the newest window with data counts as closed.
@@ -759,15 +870,12 @@ class OnlineDetector:
 
     def _score_through(self, through: int) -> List[Alert]:
         """Score closed ABSOLUTE windows (scored_through, through]."""
-        B = self.baseline_windows
-        start = max(self._scored_through + 1, B)
-        if through < start:
-            self._scored_through = max(self._scored_through, through)
+        rng = self.scoring_window_range(through)
+        if rng is None:
             return []
+        start, through = rng
         plane = self.replay.agg_plane()
-        if self._baseline is None:
-            self._baseline = self._calibrate(plane)
-        b = self._baseline
+        b = self.ensure_baseline(plane)
         S, K = self._n_svc, self._K
         cnt = plane[..., F_COUNT]
         off = self.replay.window_offset
@@ -795,49 +903,23 @@ class OnlineDetector:
                 self._cusum[:] = 0.0
                 self._cusum_k[:] = 0
                 continue
-            n_w = cnt[:, col]
-            safe = np.maximum(n_w, 1.0)
-            ok = (n_w >= self.min_count) & b["calibrated"]
-            zl = np.where(ok, (plane[:, col, F_LOGLAT] / safe - b["mu_l"])
-                          / np.sqrt(b["var_span"] / safe + b["var_bl"]), 0.0)
-            ze = np.where(ok, (plane[:, col, F_ERR] / safe - b["p_err"])
-                          / np.sqrt(b["err_var"] / safe + b["var_be"]), 0.0)
-            zd = np.where(b["active"],
-                          (b["rate0"] - n_w) / b["sd_cnt"], 0.0)
-            # CUSUM on missing throughput: per-window Poisson evidence for
-            # a 2-3 spans/window service never clears the threshold, but
-            # several windows of silence accumulate to certainty.  The
-            # slack term keeps healthy jitter from accumulating; a window
-            # back at (or above) the baseline rate RESETS the run — no
-            # lingering "still down" alerts after recovery.  Run length is
-            # capped at drop_memory for the normalization.
-            healthy = n_w >= b["rate0"]
-            slack = 0.25 * b["sd_cnt"]
-            self._cusum = np.where(
-                healthy, 0.0,
-                np.maximum(0.0, self._cusum + b["rate0"] - n_w - slack))
-            self._cusum_k = np.where(
-                self._cusum > 0,
-                np.minimum(self._cusum_k + 1, self.drop_memory),
-                0).astype(np.int32)
-            k_run = np.maximum(self._cusum_k, 1)
-            zdc = np.where(b["cum_active"],
-                           self._cusum / (b["sd_cnt"] * np.sqrt(k_run)),
-                           0.0)
-            frac_t = np.clip(self._cusum / np.maximum(
-                k_run * b["rate0"], 1e-9), 0.0, 1.0)
-            # Detection vs localization: a high-fan-in carrier (the
-            # gateway) loses a FRACTION of its traffic when any callee
-            # dies, and its sheer volume makes that partial deficit a
-            # statistically huge z — certainty about a 30% dip must not
-            # outrank certainty about a service that went 100% dark.
-            # Alerts fire on the raw z (sensitivity); the recorded score
-            # used for culprit ranking weights the drop signals by their
-            # deficit FRACTION (specificity).  Subclass modality planes
-            # (log/metric/api z's) join both sides at full weight — they
-            # are per-service direct evidence, not blast-radius carriers.
-            frac_w = np.clip(1.0 - n_w / np.maximum(b["rate0"], 1e-9),
-                             0.0, 1.0)
+            # the per-window z math lives in window_span_z — ONE
+            # definition with the batched serve scorer.  CUSUM evidence:
+            # per-window Poisson z for a 2-3 spans/window service never
+            # clears the threshold, but several windows of silence
+            # accumulate to certainty.  Detection vs localization: alerts
+            # fire on the raw z (sensitivity); the recorded ranking score
+            # weights the drop signals by their deficit FRACTION
+            # (specificity) — subclass modality planes (log/metric/api
+            # z's) join both sides at full weight, they are per-service
+            # direct evidence, not blast-radius carriers.
+            z = window_span_z(plane[:, col], b, self._cusum,
+                              self._cusum_k, self.min_count,
+                              self.drop_memory)
+            self._cusum = z["cusum"]
+            self._cusum_k = z["cusum_k"]
+            zl, ze, zd, zdc = z["zl"], z["ze"], z["zd"], z["zdc"]
+            frac_w, frac_t = z["frac_w"], z["frac_t"]
             extras = self._modality_z(w)
             if K > S:
                 # modality planes are node-scoped by construction; edge
@@ -1256,6 +1338,122 @@ class OnlineDetector:
         ws = [a.window for a in self.alerts
               if service_name is None or a.service_name == service_name]
         return min(ws) if ws else None
+
+
+def score_closed_windows_batched(work, gather_cols) -> int:
+    """Score many detectors' newly closed windows in ONE vectorized pass.
+
+    ``work`` is a list of ``(det, start, through)`` — ``batch_scorable``
+    detectors (base span-plane math only) whose
+    :meth:`OnlineDetector.scoring_window_range` returned ``(start,
+    through)``.  ``gather_cols(items)`` materializes plane columns:
+    ``items`` is a list of ``(work_index, col)`` pairs and the return is
+    float32 ``[len(items), K, F]`` — the serve engine backs it with one
+    fused device-pool gather per window (only the scored columns leave
+    the device), host-state replays contribute plane views.
+
+    This is the serving plane's batched COMMIT scorer: the per-window z
+    math is :func:`window_span_z` (the sequential scorer's own core)
+    applied with a leading tenant axis, and the threshold compare /
+    hysteresis streak / CUSUM carry / alert construction run the same
+    elementwise ops the per-tenant loop runs — so alerts, streaks, CUSUM
+    state and ``_scored_through`` advance BYTE-identically to calling
+    ``det._score_through(through)`` per tenant (pinned in
+    tests/test_serve_state.py), while the per-tenant Python loop over
+    plane readbacks and small-array z pipelines collapses into one
+    stacked pass per closed window.  Calibration (a once-per-tenant
+    event) gathers each tenant's baseline block through its own
+    ``agg_plane()`` exactly as the sequential path would.
+
+    Returns the number of alerts raised.
+    """
+    if not work:
+        return 0
+    dets = [d for d, _, _ in work]
+    K = dets[0]._K
+    assert all(d._K == K for d in dets), \
+        "batched scoring needs a uniform service table"
+    # calibrate first (the sequential path calibrates at the same
+    # moment: the first _score_through that passes the early return)
+    for det in dets:
+        if det._baseline is None:
+            det.ensure_baseline(det.replay.agg_plane())
+    # stacked frozen baselines + mutable scoring state (written back at
+    # the end; rows are per-tenant, so views never alias across tenants)
+    bkeys = ("mu_l", "var_span", "var_bl", "p_err", "err_var", "var_be",
+             "active", "cum_active", "calibrated", "rate0", "sd_cnt")
+    b_all = {k: np.stack([d._baseline[k] for d in dets]) for k in bkeys}
+    streak = np.stack([d._streak for d in dets])
+    cusum = np.stack([d._cusum for d in dets])
+    cusum_k = np.stack([d._cusum_k for d in dets])
+    min_count = np.asarray([d.min_count for d in dets])[:, None]
+    drop_memory = np.asarray([d.drop_memory for d in dets])[:, None]
+    consecutive = np.asarray([d.consecutive for d in dets])[:, None]
+    thr = np.asarray([d.z_threshold for d in dets])[:, None]
+    offs = np.asarray([d.replay.window_offset for d in dets])
+    new_alerts: dict = {t: [] for t in range(len(dets))}
+    lo = min(s for _, s, _ in work)
+    hi = max(t for _, _, t in work)
+    for w in range(lo, hi + 1):
+        act = np.asarray([s <= w <= t for _, s, t in work], bool)
+        if not act.any():
+            continue
+        idx = np.nonzero(act)[0]
+        cols = w - offs[idx]
+        gathered = gather_cols(
+            [(int(i), int(max(c, 0))) for i, c in zip(idx, cols)])
+        # fleet activity per tenant (node rows see every span once);
+        # a window nobody reported in is feed silence, and — exactly as
+        # a column evicted before it could score — it breaks hysteresis
+        # and the CUSUM run instead of becoming per-service evidence
+        fleet = gathered[..., F_COUNT].sum(axis=1) > 0
+        skip = (cols < 0) | ~fleet
+        if skip.any():
+            reset = idx[skip]
+            streak[reset] = 0
+            cusum[reset] = 0.0
+            cusum_k[reset] = 0
+        live = idx[~skip]
+        if live.size == 0:
+            continue
+        z = window_span_z(gathered[~skip],
+                          {k: v[live] for k, v in b_all.items()},
+                          cusum[live], cusum_k[live],
+                          min_count[live], drop_memory[live])
+        cusum[live] = z["cusum"]
+        cusum_k[live] = z["cusum_k"]
+        # channel order = SPAN_EV_NAMES, the sequential part-dict order
+        det_stack = np.stack([z["zl"], z["ze"], z["zd"], z["zdc"]])
+        rank_stack = np.stack([z["zl"], z["ze"], z["zd"] * z["frac_w"],
+                               z["zdc"] * z["frac_t"]])
+        detect_z = det_stack.max(axis=0)
+        score = rank_stack.max(axis=0)
+        ev_idx = rank_stack.argmax(axis=0)
+        hot = detect_z >= thr[live]
+        streak[live] = np.where(hot, streak[live] + 1, 0)
+        firing = streak[live] >= consecutive[live]
+        for j, s in np.argwhere(firing):
+            t = int(live[j])
+            det = dets[t]
+            new_alerts[t].append(Alert(
+                window=w, service=int(s),
+                service_name=det.services[s],
+                score=float(score[j, s]),
+                z_latency=float(z["zl"][j, s]),
+                z_error=float(z["ze"][j, s]),
+                z_drop=float(z["zd"][j, s]),
+                z_drop_cum=float(z["zdc"][j, s]),
+                evidence=SPAN_EV_NAMES[int(ev_idx[j, s])]))
+    n_alerts = 0
+    for t, (det, _, through) in enumerate(work):
+        det._streak = streak[t].copy()
+        det._cusum = cusum[t].copy()
+        det._cusum_k = cusum_k[t].copy()
+        det._scored_through = through
+        det._after_score(through)
+        det.alerts.extend(new_alerts[t])
+        n_alerts += len(new_alerts[t])
+    return n_alerts
 
 
 class MultimodalDetector(OnlineDetector):

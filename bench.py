@@ -153,9 +153,14 @@ def serve_main(probe_fresh=False) -> int:
     never flattered by warmup order).  A PYTHON-STAGING leg (same seed,
     ``native=False``) then isolates the C++ GIL-free lane packing: the
     ``staging`` block decomposes the serve wall into stage / dispatch /
-    fold / other for both legs — the serving-overhead gap attributed
-    with numbers — plus the byte-parity bits (native staging is pinned
-    byte-identical, so every decision metric must match exactly).
+    fold / score / other for both legs — the serving-overhead gap
+    attributed with numbers — plus the byte-parity bits (native staging
+    is pinned byte-identical, so every decision metric must match
+    exactly).  A HOST-SEAM state leg (same seed,
+    ``ANOMOD_SERVE_STATE=host``) isolates the device-resident tenant
+    pool the same way: the ``serve_state`` block carries both legs'
+    five-way decompositions, the fold+score+other share the residency
+    change attacks, and the pool's byte-parity bits.
     After the shard-scaling legs,
     two ONLINE-RCA legs (1-shard and 2-shard, ``rca=True``, same seed)
     fill the ``rca`` block: top-k hit-rate (k=1,3,5) against the
@@ -226,6 +231,16 @@ def serve_main(probe_fresh=False) -> int:
             set_registry(Registry(enabled=True))
             eng_pystage, rep_pystage = run_power_law(
                 native=False, shards=1, **run_kw)
+            # the host-seam state reference leg: same seed, tenant
+            # states kept as per-tenant numpy pytrees (the pre-pool
+            # seam, ANOMOD_SERVE_STATE=host) with the per-lane fold
+            # adds and per-tenant sequential window scoring — the
+            # device-pool headline is pinned byte-identical, and this
+            # leg's five-way wall decomposition is what the residency
+            # change is measured against
+            set_registry(Registry(enabled=True))
+            eng_hostst, rep_hostst = run_power_law(
+                state="host", shards=1, **run_kw)
             # the shard-scaling legs (2 and 4 engine workers, same
             # seed), then a FRESH 1-shard reference leg LAST: the
             # reference inherits the most process warmup of the whole
@@ -306,11 +321,19 @@ def serve_main(probe_fresh=False) -> int:
 
         def _decomp(r):
             walls = {"stage": r.stage_wall_s, "dispatch": r.dispatch_wall_s,
-                     "fold": r.fold_wall_s}
+                     "fold": r.fold_wall_s, "score": r.score_wall_s}
             walls["other"] = round(
                 max(0.0, r.serve_wall_s - sum(walls.values())), 4)
             walls["serve"] = r.serve_wall_s
             return walls
+
+        def _fso_share(r):
+            """fold+score+other share of the serve wall — the serving-
+            overhead gap's remaining interpreter/fold tax (the ISSUE-8
+            acceptance number)."""
+            w = _decomp(r)
+            return round((w["fold"] + w["score"] + w["other"])
+                         / max(w["serve"], 1e-9), 4)
 
         def _engines_identical(eng_a, eng_b):
             """(alerts_same, states_same) over the union of the two
@@ -351,6 +374,33 @@ def serve_main(probe_fresh=False) -> int:
                 == rep.latency.get("p99_latency_s"),
                 "shed_identical":
                     rep_pystage.shed_fraction == rep.shed_fraction,
+            },
+        }
+        # tenant-state residency (ISSUE-8): the device-pool headline vs
+        # the host-seam reference on the same seed — five-leg wall
+        # decomposition, the fold+score+other share the residency
+        # change attacks, and the byte-parity bits the pool is pinned
+        # to (states, alerts, p99, shed — the pool performs the exact
+        # same f32 adds, so every bit must match)
+        _st_alerts_same, _st_states_same = _engines_identical(
+            eng_head, eng_hostst)
+        out["serve_state"] = {
+            "headline": rep.serve_state,
+            "pool_engine": (eng_head.runner.pool.engine
+                            if eng_head.runner.pool is not None else None),
+            "wall_s_device": _decomp(rep),
+            "wall_s_host_seam": _decomp(rep_hostst),
+            "fold_score_other_share_device": _fso_share(rep),
+            "fold_score_other_share_host_seam": _fso_share(rep_hostst),
+            "spans_per_sec_device": rep.sustained_spans_per_sec,
+            "spans_per_sec_host_seam": rep_hostst.sustained_spans_per_sec,
+            "parity": {
+                "alerts_identical": _st_alerts_same,
+                "states_identical": _st_states_same,
+                "p99_identical": rep_hostst.latency.get("p99_latency_s")
+                == rep.latency.get("p99_latency_s"),
+                "shed_identical":
+                    rep_hostst.shed_fraction == rep.shed_fraction,
             },
         }
         # shard scaling on the same seed (1 / 2 / 4 engine workers; the

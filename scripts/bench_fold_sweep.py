@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Isolated microbench: host-seam fold vs device scatter fold, and
+sequential vs batched window scoring, at serve shapes.
+
+The end-to-end serve capture on this 2-core box carries a documented
+±35% noise floor (docs/BENCHMARKS.md), so the device-resident-state
+win (PR 8) is PROVEN here, where each leg isolates exactly the work
+the residency change removes:
+
+  - ``fold``: one retired lane dispatch's delta fold per lane bucket.
+    The host seam applies L per-lane ``state + delta`` adds through the
+    get_state/set_state seam — L interpreter iterations, 2L fresh
+    array allocations; the pool path is ONE vectorized scatter-add
+    into the tenant pool (the numpy engine on this CPU backend: an
+    in-place ``+=`` over a zero-copy view of the deltas; the jax
+    engine on accelerators: one donated-buffer device scatter) plus
+    the block_until_ready barrier the scratch ring needs.  Same
+    deltas, same f32 adds, same bits — the ratio prices the
+    interpreter loop the pool deletes.
+  - ``score``: T tenants' newly closed windows (serve-like density:
+    one hot service on every 8th tenant), sequential per-tenant
+    ``_score_through`` loop vs ONE ``score_closed_windows_batched``
+    pass fed by the pool's fused column gather.  Identical alert
+    streams (asserted per rep — a microbench that drifted from parity
+    would be measuring a different computation).
+
+Shapes follow the serve plane (``serve_plane_cfg``: 12 services, 32
+windows) and the default lane-bucket grid.  Writes one bench_runs/
+record (``fold_score_microbench``); runs on CPU — the point is this
+box, where the serve capture itself cannot resolve the legs.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _timed(fn, reps: int):
+    """Median-of-reps wall (one untimed warmup call)."""
+    fn()
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        walls.append(time.perf_counter() - t0)
+    walls.sort()
+    return walls[len(walls) // 2]
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import dataclasses
+
+    import numpy as np
+
+    from anomod.config import get_config
+    from anomod.provenance import capture_record, write_capture
+    from anomod.replay import (N_FEATS, ReplayState, TenantStatePool,
+                               fold_delta)
+    from anomod.serve.engine import serve_plane_cfg
+    from anomod.stream import (OnlineDetector, StreamReplay,
+                               score_closed_windows_batched)
+
+    cfg = serve_plane_cfg()
+    H = cfg.n_hist_buckets
+    lane_buckets = get_config().serve_lane_buckets
+    reps = int(os.environ.get("ANOMOD_FOLD_SWEEP_REPS", "30"))
+    rng = np.random.default_rng(0)
+    out = {"metric": "fold_score_microbench", "unit": "x",
+           "mode": "micro", "reps": reps,
+           "device": jax.devices()[0].device_kind,
+           "plane": {"n_services": cfg.n_services,
+                     "n_windows": cfg.n_windows},
+           "lane_buckets": list(lane_buckets)}
+
+    # -- fold: host readback+adds vs device scatter-add -------------------
+    fold_rows = {}
+    for L in lane_buckets:
+        dagg = jax.device_put(
+            rng.random((L, cfg.sw, N_FEATS)).astype(np.float32))
+        dhist = jax.device_put(
+            rng.random((L, cfg.sw, H)).astype(np.float32))
+        states = [ReplayState(
+            agg=rng.random((cfg.sw, N_FEATS)).astype(np.float32),
+            hist=rng.random((cfg.sw, H)).astype(np.float32))
+            for _ in range(L)]
+
+        def host_fold():
+            da, dh = np.asarray(dagg), np.asarray(dhist)
+            return [fold_delta(st, da[i], dh[i])
+                    for i, st in enumerate(states)]
+
+        pool = TenantStatePool(cfg, capacity=L)
+        slots = [pool.acquire() for _ in range(L)]
+        for s, st in zip(slots, states):
+            pool.put(s, st)
+        pool.warm((L,))
+
+        def pool_fold():
+            pool.scatter_fold(slots, dagg, dhist)
+            dagg.block_until_ready()
+
+        t_host = _timed(host_fold, reps)
+        t_pool = _timed(pool_fold, reps)
+        fold_rows[str(L)] = {
+            "host_seam_us": round(t_host * 1e6, 1),
+            "pool_us": round(t_pool * 1e6, 1),
+            "speedup": round(t_host / max(t_pool, 1e-9), 2)}
+    out["fold"] = fold_rows
+    out["pool_engine"] = pool.engine
+
+    # -- score: sequential per-tenant loop vs one batched pass ------------
+    svcs = tuple(f"s{i}" for i in range(cfg.n_services))
+    w_us = cfg.window_us
+    n_stream_w = 14
+
+    def _stream(t, seed):
+        """One tenant's seeded 14-window span stream, REAL data path:
+        healthy traffic everywhere, a 25x latency fault on service 0
+        from window 8 for every 8th tenant (serve-like alert density)."""
+        from anomod.schemas import SpanBatch
+        r = np.random.default_rng(seed + t)
+        per_w = 24
+        rows = n_stream_w * per_w
+        start = np.sort(np.repeat(np.arange(n_stream_w, dtype=np.int64),
+                                  per_w) * w_us
+                        + r.integers(0, w_us, rows))
+        dur = r.integers(900, 1100, rows).astype(np.int64)
+        if t % 8 == 0:
+            svc0_late = (start // w_us >= 8)
+            dur = np.where(svc0_late, dur * 25, dur)
+        return SpanBatch(
+            trace=np.arange(rows, dtype=np.int32) % 9,
+            parent=np.full(rows, -1, np.int32),
+            service=r.integers(0, cfg.n_services, rows).astype(np.int32),
+            endpoint=np.zeros(rows, np.int32), start_us=start,
+            duration_us=dur, is_error=r.random(rows) < 0.02,
+            status=np.full(rows, 200, np.int16),
+            kind=np.zeros(rows, np.int8), services=svcs,
+            endpoints=("ep",),
+            trace_ids=tuple(f"t{i}" for i in range(9))).validate()
+
+    def mk_dets(T, seed):
+        dets = []
+        for t in range(T):
+            det = OnlineDetector(svcs, cfg, 0,
+                                 replay=StreamReplay(cfg, 0),
+                                 baseline_windows=4, z_threshold=4.0)
+            w = det.replay.push(_stream(t, seed))
+            det._max_seen = w
+            dets.append(det)
+        return dets
+
+    def reset(det):
+        det._scored_through = -1
+        det._streak[:] = 0
+        det._cusum[:] = 0.0
+        det._cusum_k[:] = 0
+
+    score_rows = {}
+    for T in (8, 32, 128):
+        seq = mk_dets(T, 100)
+        bat = mk_dets(T, 100)
+        pool = TenantStatePool(cfg, capacity=T)
+        slots = [pool.acquire() for _ in range(T)]
+        for s, d in zip(slots, bat):
+            pool.put(s, d.replay.get_state())
+        pool.warm()
+        through = n_stream_w - 2
+
+        def seq_score():
+            for d in seq:
+                reset(d)
+                d.alerts.clear()
+                d._score_through(through)
+
+        def bat_score():
+            work = []
+            for d in bat:
+                reset(d)
+                d.alerts.clear()
+                work.append((d, d.baseline_windows, through))
+
+            def gather(items):
+                return pool.gather_window(
+                    [slots[i] for i, _ in items],
+                    [c for _, c in items])
+
+            score_closed_windows_batched(work, gather)
+
+        t_seq = _timed(seq_score, reps)
+        t_bat = _timed(bat_score, reps)
+        seq_score()
+        bat_score()
+        a = [[dataclasses.asdict(x) for x in d.alerts] for d in seq]
+        b = [[dataclasses.asdict(x) for x in d.alerts] for d in bat]
+        assert a == b and any(a), \
+            "batched scoring diverged from sequential — not a benchmark"
+        score_rows[str(T)] = {
+            "windows": through - seq[0].baseline_windows + 1,
+            "seq_us": round(t_seq * 1e6, 1),
+            "batched_us": round(t_bat * 1e6, 1),
+            "speedup": round(t_seq / max(t_bat, 1e-9), 2),
+            "alerts": sum(len(x) for x in a)}
+    out["score"] = score_rows
+
+    best_fold = max(r["speedup"] for r in fold_rows.values())
+    best_score = max(r["speedup"] for r in score_rows.values())
+    out["value"] = round(min(best_fold, best_score), 2)
+    rec = capture_record(out["metric"], out["value"], out["unit"],
+                         **{k: v for k, v in out.items()
+                            if k not in ("metric", "value", "unit")})
+    path = write_capture(rec)
+    if path:
+        out["capture_file"] = str(path)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,11 +34,23 @@ on a box without a toolchain) fails with the recorded build reason —
 exit 5, distinct from the generic serve failure, so a driver can tell
 "install g++ or unset ANOMOD_NATIVE" from "the bucket grid is broken".
 
+Serve mode also runs a <5 s tenant-state RESIDENCY parity smoke: the
+same tiny seeded multi-tick run on the device pool
+(``ANOMOD_SERVE_STATE=device``) and on the host seam must be
+byte-equal — per-tenant alert streams, replay states, SLO quantiles
+and shed.  A divergence is a generic serve failure (exit 3: the pool
+broke the bit-parity contract); ``ANOMOD_SERVE_STATE=device`` forced
+on a box whose pool cannot even construct/operate is its own failure
+mode — exit 6, distinct, so a driver can tell "unset
+ANOMOD_SERVE_STATE" from "the fold math is broken".
+
 Exit codes: 0 = ready (warm cache, or --cold / caching disabled is
 explicit, or serve preconditions hold), 1 = cold cache without --cold,
 2 = caching disabled without --cold, 3 = serve precondition failure,
 4 = env contract violation, 5 = ANOMOD_NATIVE requested but the native
-runtime is unusable (compiler missing / build failed).
+runtime is unusable (compiler missing / build failed), 6 =
+ANOMOD_SERVE_STATE=device forced but the device state pool is
+unusable.
 Always prints one JSON line describing the decision (plus the contract
 gate's line).  ``--traces`` must match the bench invocation's span
 count (the cache key includes it).
@@ -97,6 +109,51 @@ def _shard_fanout_smoke() -> dict:
                                "replay state diverges")
     return {"tenants": len(e1._tenant_det),
             "served_spans": r1.served_spans}
+
+
+def _state_parity_smoke() -> dict:
+    """The device-vs-host residency smoke (<5 s): a tiny seeded fused
+    multi-tick run with the device state pool must produce the EXACT
+    decision output of the same run on the host seam — per-tenant alert
+    streams, replay states (bitwise), SLO quantiles and shed fraction.
+    A divergence means the pool's scatter/roll/gather broke the
+    bit-parity contract and a serve capture would compare different
+    computations."""
+    import dataclasses
+
+    import numpy as np
+
+    from anomod.serve.engine import run_power_law
+
+    def go(state):
+        return run_power_law(
+            n_tenants=5, n_services=4, capacity_spans_per_s=1000,
+            overload=2.0, duration_s=16, tick_s=1.0, seed=9,
+            window_s=2.0, baseline_windows=4, fault_tenants=1,
+            buckets=(64, 256), lane_buckets=(1, 2, 4), max_backlog=1500,
+            n_windows=16, shards=1, pipeline=2, state=state)
+
+    eh, rh = go("host")
+    ed, rd = go("device")
+    for tid in eh._tenant_det:
+        if [dataclasses.asdict(a) for a in eh.alerts_for(tid)] != \
+                [dataclasses.asdict(a) for a in ed.alerts_for(tid)]:
+            raise RuntimeError(f"state parity smoke: tenant {tid} alert "
+                               "stream diverges device vs host")
+        s1 = eh._tenant_replay[tid].state
+        s2 = ed._tenant_replay[tid].state
+        if not (np.array_equal(np.asarray(s1.agg), np.asarray(s2.agg))
+                and np.array_equal(np.asarray(s1.hist),
+                                   np.asarray(s2.hist))):
+            raise RuntimeError(f"state parity smoke: tenant {tid} "
+                               "replay state diverges device vs host")
+    if rh.latency != rd.latency or rh.shed_fraction != rd.shed_fraction:
+        raise RuntimeError("state parity smoke: SLO/shed diverge "
+                           "device vs host")
+    return {"tenants": len(eh._tenant_det),
+            "pool_engine": ed.runner.pool.engine,
+            "alerts": sum(len(ed.alerts_for(t))
+                          for t in ed._tenant_det)}
 
 
 def _native_smoke() -> dict:
@@ -163,6 +220,28 @@ def check_serve() -> int:
             out["native"]["smoke"] = _native_smoke()
         from anomod.serve.batcher import BucketRunner
         from anomod.serve.engine import serve_plane_cfg
+        # tenant-state residency: a FORCED device pool that cannot even
+        # construct/operate on this box is its own failure mode (exit
+        # 6 — "unset ANOMOD_SERVE_STATE", not "the grid is broken");
+        # auto silently serves whatever engine the backend supports
+        out["serve_state"] = cfg.serve_state
+        if cfg.serve_state == "device":
+            try:
+                from anomod.replay import TenantStatePool
+                probe = TenantStatePool(serve_plane_cfg(), capacity=1)
+                slot = probe.acquire()
+                probe.put(slot, probe.zero_state())
+                probe.gather(slot)
+            except Exception as e:
+                out["status"] = "serve-state-unusable"
+                print(json.dumps(out))
+                print("pre_bench_check: ANOMOD_SERVE_STATE=device but "
+                      f"the device state pool is unusable: "
+                      f"{type(e).__name__}: {e} — unset "
+                      "ANOMOD_SERVE_STATE (auto picks the backend's "
+                      "engine) or serve the host seam",
+                      file=sys.stderr)
+                return 6
         # the serve bench's plane shape (ONE definition with bench.py's
         # serve path): compile every bucket width once so the capture's
         # compile_s is warm-path bookkeeping, not a mid-capture stall.
@@ -191,6 +270,9 @@ def check_serve() -> int:
                        lane_compile_s=round(lane_compile_s, 3))
             # determinism gate for the bench's shard-scaling legs
             out["shard_smoke"] = _shard_fanout_smoke()
+        # determinism gate for the bench's serve_state legs: device-vs-
+        # host residency byte-parity over a multi-tick seeded run
+        out["state_smoke"] = _state_parity_smoke()
         # the online-RCA bucket grid (the bench's --rca legs): every
         # (nodes, neighbors) bucket must AOT-compile — a shape miss here
         # would stall the capture's alert→culprit path mid-serve

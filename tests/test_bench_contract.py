@@ -165,25 +165,45 @@ def test_bench_serve_mode_contract(tmp_path):
     assert fd["fused_dispatches"] > 0
     assert fd["lane_buckets"]
     assert 0.0 <= fd["lane_pad_waste"] < 1.0
-    # staging decomposition (ISSUE-7): stage/dispatch/fold/other walls on
-    # the native AND interpreter-staging legs of the same seed, plus the
-    # byte-parity bits the native path is pinned to
+    # staging decomposition (ISSUE-7, five-legged since ISSUE-8):
+    # stage/dispatch/fold/score/other walls on the native AND
+    # interpreter-staging legs of the same seed, plus the byte-parity
+    # bits the native path is pinned to
     st = out["staging"]
     assert st["native_mode"] in ("auto", "on", "off")
     assert st["native_available"] in (True, False)
     for leg in ("wall_s_native", "wall_s_python"):
         walls = st[leg]
-        assert set(walls) == {"stage", "dispatch", "fold", "other",
-                              "serve"}
+        assert set(walls) == {"stage", "dispatch", "fold", "score",
+                              "other", "serve"}
         assert all(v >= 0 for v in walls.values())
         assert walls["stage"] + walls["dispatch"] + walls["fold"] \
-            <= walls["serve"] + 1e-6
+            + walls["score"] <= walls["serve"] + 1e-6
     assert st["spans_per_sec_native"] > 0
     assert st["spans_per_sec_python"] > 0
     if st["native_available"] and st["native_mode"] != "off":
         assert st["native_staging_headline"] is True
         assert st["native_staged_dispatches"] > 0
     par = st["parity"]
+    assert par["alerts_identical"] is True
+    assert par["states_identical"] is True
+    assert par["p99_identical"] is True
+    assert par["shed_identical"] is True
+    # tenant-state residency (ISSUE-8): the device-pool headline vs the
+    # host-seam reference on the same seed, five-leg decompositions, the
+    # fold+score+other share, and the pool's byte-parity bits
+    ss = out["serve_state"]
+    assert ss["headline"] == "device"
+    assert ss["pool_engine"] in ("numpy", "jax")
+    for leg in ("wall_s_device", "wall_s_host_seam"):
+        assert set(ss[leg]) == {"stage", "dispatch", "fold", "score",
+                                "other", "serve"}
+    for share in ("fold_score_other_share_device",
+                  "fold_score_other_share_host_seam"):
+        assert 0.0 <= ss[share] <= 1.0
+    assert ss["spans_per_sec_device"] > 0
+    assert ss["spans_per_sec_host_seam"] > 0
+    par = ss["parity"]
     assert par["alerts_identical"] is True
     assert par["states_identical"] is True
     assert par["p99_identical"] is True

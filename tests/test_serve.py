@@ -369,7 +369,7 @@ def test_overload_shedding_is_priority_ordered_and_deterministic():
 
     wall = ("serve_wall_s", "sustained_spans_per_sec", "compile_s",
             "lane_compile_s", "stage_wall_s", "dispatch_wall_s",
-            "fold_wall_s")
+            "fold_wall_s", "score_wall_s")
     a = {k: v for k, v in _overload_report(5).to_dict().items()
          if k not in wall}
     b = {k: v for k, v in _overload_report(5).to_dict().items()
@@ -1301,12 +1301,12 @@ def test_serve_report_carries_wall_decomposition():
     assert rep.dispatch_wall_s > 0
     assert rep.fold_wall_s > 0
     assert rep.stage_wall_s + rep.dispatch_wall_s + rep.fold_wall_s \
-        <= rep.serve_wall_s + 1e-6
+        + rep.score_wall_s <= rep.serve_wall_s + 1e-6
     # decomposition fields are wall measurements: excluded from the
     # shard-determinism comparison by the ONE shared list
     from anomod.serve.engine import SHARD_VARIANT_REPORT_FIELDS
     for f in ("stage_wall_s", "dispatch_wall_s", "fold_wall_s",
-              "native_staged_dispatches"):
+              "score_wall_s", "native_staged_dispatches"):
         assert f in SHARD_VARIANT_REPORT_FIELDS
 
 
