@@ -412,10 +412,14 @@ def main(argv=None) -> int:
                             "its own telemetry)")
     p_obs.add_argument("--out", default=None,
                        help="export: output file path (required)")
-    p_obs.add_argument("--format", choices=["json", "prom", "tt-csv"],
+    p_obs.add_argument("--format", choices=["json", "prom", "tt-csv",
+                                            "chrome", "jaeger"],
                        default=None,
                        help="snapshot: json (default) or prom; "
-                            "export: tt-csv (default) or prom")
+                            "export: tt-csv (default), prom, or the "
+                            "self-exercise engine's own SPAN trace as "
+                            "chrome (trace-event array, loads in "
+                            "chrome://tracing / Perfetto) or jaeger")
     p_obs.add_argument("--serve-seconds", type=float, default=20.0,
                        help="virtual seconds of the seeded self-exercise "
                             "serve run that populates the registry")
@@ -427,6 +431,67 @@ def main(argv=None) -> int:
                        help="score: detector window width")
     p_obs.add_argument("--baseline-windows", type=int, default=4)
     p_obs.add_argument("--threshold", type=float, default=4.0)
+
+    p_audit = sub.add_parser(
+        "audit", help="black-box flight-recorder forensics (anomod.obs."
+        "flight): `record` runs seeded traffic with the tick journal on "
+        "and dumps it, `replay` re-executes a journal from its header's "
+        "seed+config (optionally at a different shard count / pipeline "
+        "depth / state residency — the determinism contracts under "
+        "test), `diff` compares two journals tick-aligned and reports "
+        "the first divergent tick and which plane (admission / dispatch "
+        "/ fold / score / rca) diverged, exiting nonzero")
+    p_audit.add_argument("action", choices=["record", "replay", "diff"])
+    p_audit.add_argument("journals", nargs="*",
+                         help="replay: the journal to re-execute; diff: "
+                              "the two journals to compare")
+    p_audit.add_argument("--out", default=None,
+                         help="record/replay: journal output path "
+                              "(required)")
+    # record-run shape flags default to None so the replay/diff branches
+    # can tell "passed" from "absent" without a second copy of the
+    # defaults; the record branch resolves the real defaults below
+    p_audit.add_argument("--tenants", type=int, default=None,
+                         help="record only (default 24)")
+    p_audit.add_argument("--services", type=int, default=None,
+                         help="record only (default 8)")
+    p_audit.add_argument("--duration", type=float, default=None,
+                         help="record: virtual seconds to serve "
+                              "(default 30)")
+    p_audit.add_argument("--tick", type=float, default=None,
+                         help="record only (default 0.5)")
+    p_audit.add_argument("--capacity", type=float, default=None,
+                         help="record only (default 4000)")
+    p_audit.add_argument("--overload", type=float, default=None,
+                         help="record only (default 1.5)")
+    p_audit.add_argument("--seed", type=int, default=None,
+                         help="record only (default 0)")
+    p_audit.add_argument("--window-seconds", type=float, default=None,
+                         help="record only (default 5.0)")
+    p_audit.add_argument("--baseline-windows", type=int, default=None,
+                         help="record only (default 2)")
+    p_audit.add_argument("--threshold", type=float, default=None,
+                         help="record only (default 4.0)")
+    p_audit.add_argument("--fault-tenants", type=int, default=None,
+                         help="record only (default 1)")
+    p_audit.add_argument("--rca", action="store_true",
+                         help="record: journal the online-RCA verdict "
+                              "plane too")
+    p_audit.add_argument("--digest-every", type=int, default=None,
+                         help="record: tenant-state digest cadence in "
+                              "ticks (default: ANOMOD_FLIGHT_DIGEST_"
+                              "EVERY)")
+    p_audit.add_argument("--shards", type=int, default=None,
+                         help="record: engine shard count; replay: "
+                              "OVERRIDE the recorded shard count (the "
+                              "N-way-pinned-to-1-way forensic replay)")
+    p_audit.add_argument("--pipeline", type=int, default=None,
+                         help="record: dispatch pipeline depth; replay: "
+                              "override the recorded depth")
+    p_audit.add_argument("--state", choices=["auto", "host", "device"],
+                         default=None,
+                         help="record: tenant-state residency; replay: "
+                              "override the recorded residency")
 
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
@@ -675,12 +740,17 @@ def main(argv=None) -> int:
             parser.error("obs export needs --out")
         if args.action != "score" and args.from_path:
             parser.error("--from applies to obs score")
-        if args.action == "snapshot" and args.format == "tt-csv":
+        if args.action == "snapshot" and args.format in ("tt-csv", "chrome",
+                                                         "jaeger"):
             parser.error("snapshot prints point-in-time state; the time "
-                         "series export is `obs export` (tt-csv)")
+                         "series export is `obs export` (tt-csv), the "
+                         "span trace is `obs export --format "
+                         "chrome|jaeger`")
         if args.action == "export" and args.format == "json":
-            parser.error("obs export writes prom or tt-csv; `obs "
-                         "snapshot` is the JSON view")
+            parser.error("obs export writes prom, tt-csv, chrome or "
+                         "jaeger; `obs snapshot` is the JSON view")
+        if args.action == "score" and args.format in ("chrome", "jaeger"):
+            parser.error("--format chrome/jaeger applies to obs export")
         from anomod.obs.selfscrape import score_self_scrape
         if args.action == "score" and args.from_path:
             # scoring an existing capture needs jax (the detector stack)
@@ -693,10 +763,25 @@ def main(argv=None) -> int:
             return 0
         _probe_backend(args)
         from anomod.obs.selfscrape import self_exercise
+        tracer = None
+        if args.action == "export" and args.format in ("chrome", "jaeger"):
+            # the span exporters dump the self-exercise ENGINE's own
+            # trace (the Tracer rides the run), not the metric registry
+            from anomod.utils.tracing import Tracer
+            tracer = Tracer("anomod-serve")
         reg = self_exercise(duration_s=args.serve_seconds,
                             n_tenants=args.tenants,
                             capacity_spans_per_s=args.capacity,
-                            seed=args.seed)
+                            seed=args.seed, tracer=tracer)
+        if tracer is not None:
+            from pathlib import Path as _P
+            if args.format == "chrome":
+                tracer.dump_chrome(_P(args.out))
+            else:
+                tracer.dump(_P(args.out))
+            print(json.dumps({"out": args.out, "format": args.format,
+                              "spans": tracer.n_spans}))
+            return 0
         if args.action == "snapshot":
             if args.format == "prom":
                 from anomod.obs.export import to_prometheus_text
@@ -801,6 +886,125 @@ def main(argv=None) -> int:
             from pathlib import Path as _P
             tracer.dump(_P(args.trace_out))
         print(json.dumps(report.to_dict(), indent=2))
+        return 0
+
+    if args.cmd == "audit":
+        from anomod.obs.flight import diff_journals, load_journal
+        # record-only flags must not be silently ignored by replay/diff
+        # (replay takes its run from the journal header; an operator
+        # passing --seed or --duration there would draw forensic
+        # conclusions from a run they did not ask for)
+        if args.action != "record":
+            _record_only = (("--tenants", args.tenants),
+                            ("--services", args.services),
+                            ("--duration", args.duration),
+                            ("--tick", args.tick),
+                            ("--capacity", args.capacity),
+                            ("--overload", args.overload),
+                            ("--seed", args.seed),
+                            ("--window-seconds", args.window_seconds),
+                            ("--baseline-windows", args.baseline_windows),
+                            ("--threshold", args.threshold),
+                            ("--fault-tenants", args.fault_tenants),
+                            ("--rca", args.rca or None))
+            for flag, got in _record_only:
+                if got is not None:
+                    parser.error(
+                        f"{flag} applies to audit record; "
+                        f"{args.action} takes its run from the journal "
+                        "header" + (" (--shards/--pipeline/--state/"
+                                    "--digest-every override)"
+                                    if args.action == "replay" else ""))
+        if args.action == "diff":
+            for flag, val in (("--shards", args.shards),
+                              ("--pipeline", args.pipeline),
+                              ("--state", args.state),
+                              ("--digest-every", args.digest_every)):
+                if val is not None:
+                    parser.error(f"{flag} applies to audit record/replay")
+            if len(args.journals) != 2:
+                parser.error("audit diff takes exactly two journal paths")
+            if args.out:
+                parser.error("--out applies to audit record/replay")
+            a = load_journal(args.journals[0])
+            b = load_journal(args.journals[1])
+            d = diff_journals(a, b)
+            out = {"action": "diff",
+                   "a": args.journals[0], "b": args.journals[1],
+                   "ticks_a": len(a["ticks"]), "ticks_b": len(b["ticks"]),
+                   "identical": d is None}
+            if d is not None:
+                out["divergence"] = d
+            print(json.dumps(out, indent=2))
+            if d is not None:
+                print(f"audit diff: first divergence at tick "
+                      f"{d['tick']} in the {d['plane']} plane",
+                      file=sys.stderr)
+                return 1
+            return 0
+        if not args.out:
+            parser.error(f"audit {args.action} needs --out")
+        if args.action == "record":
+            if args.journals:
+                parser.error("audit record takes no journal arguments")
+
+            def _or(v, default):
+                return default if v is None else v
+
+            kw = dict(n_tenants=_or(args.tenants, 24),
+                      n_services=_or(args.services, 8),
+                      capacity_spans_per_s=_or(args.capacity, 4000.0),
+                      overload=_or(args.overload, 1.5),
+                      duration_s=_or(args.duration, 30.0),
+                      tick_s=_or(args.tick, 0.5),
+                      seed=_or(args.seed, 0),
+                      window_s=_or(args.window_seconds, 5.0),
+                      baseline_windows=_or(args.baseline_windows, 2),
+                      z_threshold=_or(args.threshold, 4.0),
+                      fault_tenants=_or(args.fault_tenants, 1),
+                      shards=args.shards, pipeline=args.pipeline,
+                      state=args.state,
+                      rca=True if args.rca else None,
+                      flight=True,
+                      flight_digest_every=args.digest_every)
+        else:
+            if len(args.journals) != 1:
+                parser.error("audit replay takes exactly one journal path")
+            header = load_journal(args.journals[0]).get("header", {})
+            run = header.get("run")
+            if not run:
+                parser.error("journal header carries no run parameters "
+                             "(not recorded through `anomod audit "
+                             "record` / run_power_law) — cannot replay")
+            kw = dict(run)
+            kw["buckets"] = tuple(kw["buckets"]) if kw.get("buckets") \
+                else None
+            kw["lane_buckets"] = tuple(kw["lane_buckets"]) \
+                if kw.get("lane_buckets") else None
+            # the forensic overrides: replay the SAME decisions at a
+            # different shard count / pipeline depth / residency — diff
+            # against the original is the determinism contract's probe
+            for name, val in (("shards", args.shards),
+                              ("pipeline", args.pipeline),
+                              ("state", args.state),
+                              ("flight_digest_every", args.digest_every)):
+                if val is not None:
+                    kw[name] = val
+            kw["flight"] = True
+        _probe_backend(args)
+        from anomod.serve.engine import run_power_law
+        eng, rep = run_power_law(**kw)
+        doc = eng.flight_recorder.dump(args.out)
+        print(json.dumps({
+            "action": args.action, "out": args.out,
+            "ticks": doc["n_recorded"], "dropped": doc["n_dropped"],
+            "seed": doc["header"]["run"]["seed"],
+            "shards": doc["header"]["engine"]["shards"],
+            "serve_state": doc["header"]["engine"]["serve_state"],
+            "digest_every": doc["header"]["digest_every"],
+            "served_spans": rep.served_spans,
+            "n_alerts": rep.n_alerts,
+        }))
         return 0
 
     if args.cmd == "quality":

@@ -368,6 +368,77 @@ def _serve_rca_windows_env() -> int:
     return _serve_rca_int_env("ANOMOD_SERVE_RCA_WINDOWS", "8", 2, 128)
 
 
+def _flight_env() -> bool:
+    """ANOMOD_FLIGHT: the serve plane's black-box flight recorder
+    (anomod.obs.flight).
+
+    Default ON — the recorder is the always-on tick journal every
+    determinism contract replays against (bounded ring, bounded
+    per-tick cost; the serve bench gates its overhead at <= 5% like
+    telemetry) — "0"/"false"/"off" disables it end to end.
+    """
+    return _env("ANOMOD_FLIGHT", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+def _flight_digest_every_env() -> int:
+    """ANOMOD_FLIGHT_DIGEST_EVERY: tenant-state digest cadence (ticks).
+
+    Every Nth tick the flight recorder folds a crc32 over every live
+    tenant's replay state (through the ``get_state``/pool-gather seam)
+    into the tick record's fold plane — the cheap end-state parity
+    anchor ``anomod audit diff`` bisects state divergence with.  Small
+    values localize tighter; 1 digests every tick.  Validated here so a
+    typo fails loudly at config construction.
+    """
+    raw = _env("ANOMOD_FLIGHT_DIGEST_EVERY", "16")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_FLIGHT_DIGEST_EVERY must be a positive integer, "
+            f"got {raw!r}")
+    if not 1 <= n <= 1_000_000:
+        raise ValueError(
+            f"ANOMOD_FLIGHT_DIGEST_EVERY must be in [1, 1000000], got {n}")
+    return n
+
+
+def _flight_max_ticks_env() -> int:
+    """ANOMOD_FLIGHT_MAX_TICKS: flight-recorder ring capacity (ticks).
+
+    The journal is a bounded ring — oldest tick records drop past this
+    (counted, never silent: ``anomod_flight_dropped_ticks_total``), so
+    an unbounded serve run cannot grow host memory without bound.
+    """
+    raw = _env("ANOMOD_FLIGHT_MAX_TICKS", "65536")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_FLIGHT_MAX_TICKS must be a positive integer, "
+            f"got {raw!r}")
+    if not 1 <= n <= 10_000_000:
+        raise ValueError(
+            f"ANOMOD_FLIGHT_MAX_TICKS must be in [1, 10000000], got {n}")
+    return n
+
+
+def _flight_dump_dir_env() -> Optional[Path]:
+    """ANOMOD_FLIGHT_DUMP_DIR: alert-triggered forensic-dump directory.
+
+    When set, the first serve tick that raises a new detector alert
+    publishes ONE forensic bundle there (flight ring + registry scrape +
+    tracer spans, atomically — anomod.obs.flight.forensic_bundle).
+    Unset (the default) disables the dump; the in-memory ring and the
+    ``anomod audit`` dump path are unaffected.
+    """
+    raw = _env("ANOMOD_FLIGHT_DUMP_DIR", "")
+    if not raw or raw.lower() in _CACHE_OFF:
+        return None
+    return Path(raw).expanduser()
+
+
 def _native_env() -> str:
     """ANOMOD_NATIVE: the C++ native runtime switch (anomod.io.native) —
     ingest scanning AND the serving plane's GIL-free lane staging.
@@ -528,6 +599,21 @@ class Config:
     # extractor (also bounds the per-tenant RCA span buffer).
     serve_rca_windows: int = dataclasses.field(
         default_factory=_serve_rca_windows_env)
+    # ANOMOD_FLIGHT — serve-plane black-box flight recorder switch
+    # (anomod.obs.flight; off = no tick journal, no audit surface).
+    flight: bool = dataclasses.field(default_factory=_flight_env)
+    # ANOMOD_FLIGHT_DIGEST_EVERY — tenant-state digest cadence in ticks
+    # (anomod.obs.flight; crc32 over the get_state/pool-gather bytes).
+    flight_digest_every: int = dataclasses.field(
+        default_factory=_flight_digest_every_env)
+    # ANOMOD_FLIGHT_MAX_TICKS — flight-journal ring capacity in ticks
+    # (oldest records drop past it, counted in the registry).
+    flight_max_ticks: int = dataclasses.field(
+        default_factory=_flight_max_ticks_env)
+    # ANOMOD_FLIGHT_DUMP_DIR — alert-triggered forensic-bundle directory
+    # (anomod.obs.flight.forensic_bundle; None = dumps off).
+    flight_dump_dir: Optional[Path] = dataclasses.field(
+        default_factory=_flight_dump_dir_env)
     # ANOMOD_NATIVE — C++ native runtime switch: auto (use when the .so
     # loads), on (required, fail loud with the build reason), off
     # (pure-Python paths; anomod.io.native).

@@ -27,7 +27,7 @@ from typing import Dict, List
 
 import numpy as np
 
-from anomod.obs.registry import Registry, render_labels, subsystem_of
+from anomod.obs.registry import Registry, subsystem_of
 
 
 def _fmt(v: float) -> str:
@@ -35,25 +35,73 @@ def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
 
+def escape_label_value(value: str) -> str:
+    """Label-value escaping per the exposition-format grammar: inside
+    the double quotes, backslash, double-quote and line-feed must render
+    as ``\\\\``, ``\\"`` and ``\\n`` — in that order (escaping the
+    escape character first, or a value containing ``\\n`` literally
+    would round-trip as a newline)."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def escape_help_text(text: str) -> str:
+    """HELP-line escaping: only backslash and line-feed (the grammar
+    leaves double quotes alone outside label position)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def render_prom_labels(labels: Dict[str, str]) -> str:
+    """Labels rendered for the exposition format — the escaping twin of
+    :func:`anomod.obs.registry.render_labels` (which stays unescaped on
+    purpose: its output is the registry's internal series key and the
+    TT-CSV export's label string, where a ``\\n`` is just a character).
+    Only the text format has a grammar that ``\\``/``"``/newline can
+    break out of, so only this renderer escapes."""
+    return ",".join(f'{k}="{escape_label_value(v)}"'
+                    for k, v in sorted(labels.items()))
+
+
+def _help_for(m) -> str:
+    """One HELP line per metric family: the subsystem token plus the
+    kind — derived, so every family (including ones added after this
+    writing) gets a parseable, truthful HELP line without a hand-kept
+    catalog that would rot."""
+    return escape_help_text(
+        f"anomod {subsystem_of(m.name)}-subsystem {m.kind}")
+
+
 def to_prometheus_text(registry: Registry) -> str:
-    """Point-in-time registry state in the Prometheus text format."""
+    """Point-in-time registry state in the Prometheus text format
+    (``# HELP`` + ``# TYPE`` per family, label values escaped per the
+    exposition-format grammar — pinned by an adversarial-label
+    round-trip test in tests/test_obs.py)."""
     lines: List[str] = []
-    for m in sorted(registry.metrics(), key=lambda m: m.name):
-        base = render_labels(m.labels)
+    seen: set = set()
+    for m in sorted(registry.metrics(),
+                    key=lambda m: (m.name, render_prom_labels(m.labels))):
+        base = render_prom_labels(m.labels)
         brace = f"{{{base}}}" if base else ""
+        # HELP/TYPE are once per metric FAMILY (the grammar allows one
+        # each per name): label variants of one name — e.g. the
+        # shard-labeled gauge twins — share the header their sorted
+        # grouping puts first
+        if m.name not in seen:
+            seen.add(m.name)
+            lines.append(f"# HELP {m.name} {_help_for(m)}")
+            lines.append(f"# TYPE {m.name} "
+                         f"{'summary' if m.kind == 'histogram' else m.kind}")
         if m.kind == "histogram":
             # t-digest histograms export as Prometheus SUMMARIES: the
             # sketch stores quantiles, not cumulative bucket counts
-            lines.append(f"# TYPE {m.name} summary")
             p50 = m.quantile(0.5)
             if p50 is not None:
                 for q, v in (("0.5", p50), ("0.99", m.quantile(0.99))):
-                    ql = render_labels({**m.labels, "quantile": q})
+                    ql = render_prom_labels({**m.labels, "quantile": q})
                     lines.append(f"{m.name}{{{ql}}} {_fmt(v)}")
             lines.append(f"{m.name}_sum{brace} {_fmt(m.sum)}")
             lines.append(f"{m.name}_count{brace} {_fmt(m.count)}")
         else:
-            lines.append(f"# TYPE {m.name} {m.kind}")
             lines.append(f"{m.name}{brace} {_fmt(m.value)}")
     return "\n".join(lines) + ("\n" if lines else "")
 

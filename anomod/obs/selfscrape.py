@@ -174,12 +174,14 @@ def score_self_scrape(source, window_s: float = 5.0,
 
 def self_exercise(duration_s: float = 20.0, n_tenants: int = 24,
                   capacity_spans_per_s: float = 4000.0, seed: int = 0,
-                  registry=None):
+                  registry=None, tracer=None):
     """Drive a short seeded serve run with telemetry on and return the
     registry that observed it — the ``anomod obs`` CLI's way to produce a
     meaningful snapshot/export from a fresh process.  Swaps the given (or
     a fresh, force-enabled) registry in as the process default for the
-    run, then restores the previous one."""
+    run, then restores the previous one.  ``tracer`` (when given) rides
+    the engine so the same exercise can feed the span exporters
+    (``anomod obs export --format chrome``/``jaeger``)."""
     from anomod.obs.registry import Registry, set_registry
     reg = registry if registry is not None else Registry(enabled=True)
     prev = set_registry(reg)
@@ -189,7 +191,7 @@ def self_exercise(duration_s: float = 20.0, n_tenants: int = 24,
                       capacity_spans_per_s=capacity_spans_per_s,
                       overload=1.5, duration_s=duration_s, tick_s=0.5,
                       seed=seed, window_s=5.0, baseline_windows=2,
-                      fault_tenants=1)
+                      fault_tenants=1, tracer=tracer)
     finally:
         set_registry(prev)
     return reg

@@ -709,6 +709,25 @@ class BucketRunner:
     def inflight_dispatches(self) -> int:
         return len(self._inflight)
 
+    def leg_walls(self) -> dict:
+        """Cumulative flight-leg snapshot of this runner's wall/dispatch
+        book — what the flight recorder (anomod.obs.flight) deltas per
+        tick.  ``by_width`` (staged chunks per width) is the canonical
+        dispatch-plane content: ``stage_plan`` is the ONE staging
+        definition, so the counts are identical under every execution
+        strategy (fused/unfused, any shard count, any pipeline depth).
+        The walls and lane-grouping counts are journal-variant (wall
+        clock / topology).  Read at the tick barrier only — the dicts
+        mutate on this runner's worker thread mid-tick."""
+        return {"stage_s": self.stage_wall_s,
+                "dispatch_s": self.dispatch_wall_s,
+                "fold_s": self.fold_wall_s,
+                "score_s": self.score_wall_s,
+                "chunks": self.n_dispatches,
+                "fused": self.fused_dispatches,
+                "native_staged": self.native_staged,
+                "by_width": dict(self.dispatches_by_width)}
+
     @property
     def lane_pad_waste(self) -> float:
         """Dead-lane fraction of every fused dispatch so far (the lane

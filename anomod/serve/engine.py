@@ -258,6 +258,10 @@ class ServeReport:
     rca_latency: Dict[str, Optional[float]]      # wall p50/p99 per RCA run
     rca_alert_to_culprit_s: Dict[str, Optional[float]]  # virtual queue delay
     rca_wall_s: float                            # total RCA wall
+    flight_enabled: bool                         # black-box recorder on?
+    flight_recorded_ticks: int                   # journal records written
+    flight_dropped_ticks: int                    # ring evictions (0 = no
+    #                                              loss; never silent)
     serve_wall_s: float
     sustained_spans_per_sec: float
 
@@ -308,7 +312,10 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   pipeline: Optional[int] = None,
                   rca: Optional[bool] = None,
                   native: Optional[bool] = None,
-                  state: Optional[str] = None
+                  state: Optional[str] = None,
+                  flight: Optional[bool] = None,
+                  flight_digest_every: Optional[int] = None,
+                  flight_max_ticks: Optional[int] = None
                   ) -> Tuple["ServeEngine", ServeReport]:
     """The canonical seeded serve run shared by ``anomod serve`` and
     ``bench.py --mode serve``: a power-law tenant fleet offering
@@ -337,7 +344,36 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          tracer=tracer, fuse=fuse,
                          lane_buckets=lane_buckets, shards=shards,
                          pipeline=pipeline, rca=rca, native=native,
-                         state=state)
+                         state=state, flight=flight,
+                         flight_digest_every=flight_digest_every,
+                         flight_max_ticks=flight_max_ticks)
+    if engine.flight_recorder is not None:
+        # the header's replay contract: `anomod audit replay` re-executes
+        # this exact invocation from the journal alone.  Every
+        # env-defaulted knob is recorded RESOLVED (what the engine
+        # actually served with), never as the raw None the ctor would
+        # re-resolve from the REPLAY process's env — otherwise a replay
+        # under a different ANOMOD_SERVE_BUCKETS / _MAX_BACKLOG /
+        # _FUSE / _RCA would report env drift as plane divergence.
+        # ``native`` stays raw on purpose: native-vs-python staging is
+        # byte-identical (it cannot move a canonical plane), and a
+        # resolved ``True`` would refuse to replay on a box without the
+        # toolchain for zero forensic benefit.
+        engine.flight_recorder.header["run"] = dict(
+            n_tenants=n_tenants, n_services=n_services,
+            capacity_spans_per_s=capacity_spans_per_s, overload=overload,
+            duration_s=duration_s, tick_s=tick_s, seed=seed, alpha=alpha,
+            window_s=window_s, baseline_windows=baseline_windows,
+            z_threshold=z_threshold,
+            buckets=list(engine.runner.buckets),
+            max_backlog=engine.max_backlog, fault_tenants=fault_tenants,
+            score=score, n_windows=n_windows, fuse=engine.fuse,
+            lane_buckets=list(engine.runner.lane_buckets),
+            shards=engine.shards, pipeline=engine.pipeline,
+            rca=engine.rca, native=native,
+            state=engine.serve_state, flight=True,
+            flight_digest_every=engine.flight_recorder.digest_every,
+            flight_max_ticks=engine.flight_recorder.max_ticks)
     report = engine.run(traffic, duration_s=duration_s)
     return engine, report
 
@@ -365,7 +401,10 @@ class ServeEngine:
                  rca_budget: Optional[int] = None,
                  rca_windows: Optional[int] = None,
                  native: Optional[bool] = None,
-                 state: Optional[str] = None):
+                 state: Optional[str] = None,
+                 flight: Optional[bool] = None,
+                 flight_digest_every: Optional[int] = None,
+                 flight_max_ticks: Optional[int] = None):
         from anomod.config import get_config
         from anomod.utils.platform import enable_jit_cache
         if capacity_spans_per_s <= 0:
@@ -564,6 +603,50 @@ class ServeEngine:
         # detector window at the default 5 s width — plenty for the
         # self-scrape z statistics — at a fraction of the per-tick cost
         self._scrape_every = max(1, int(round(1.0 / self.clock.tick_s)))
+        #: black-box flight recorder (ANOMOD_FLIGHT, anomod.obs.flight):
+        #: every tick journals its admission decisions, staged dispatch
+        #: plan, alert/RCA digests and (at the ANOMOD_FLIGHT_DIGEST_EVERY
+        #: cadence) a crc32 tenant-state digest into a bounded ring — the
+        #: deterministic record `anomod audit` replays and bisects
+        #: against.  A pure read-side consumer: every decision above is
+        #: byte-identical with the recorder on or off.
+        self.flight = bool(app_cfg.flight if flight is None else flight)
+        self.flight_recorder = None
+        self._flight_dump_dir = app_cfg.flight_dump_dir
+        self._flight_dumped = False
+        if self.flight:
+            from anomod.obs.flight import (FlightRecorder, config_snapshot,
+                                           versions)
+            self.flight_recorder = FlightRecorder(
+                {"engine": {
+                    "n_tenants": len(self.specs),
+                    "n_services": len(self.services),
+                    "capacity_spans_per_s": self.capacity_spans_per_s,
+                    "tick_s": self.clock.tick_s,
+                    "max_backlog": self.max_backlog,
+                    "buckets": list(self.runner.buckets),
+                    "lane_buckets": list(self.runner.lane_buckets),
+                    "shards": self.shards,
+                    "pipeline": self.pipeline,
+                    "serve_state": self.serve_state,
+                    "fused": self._fused,
+                    "score": self.score,
+                    "rca": self.rca,
+                    "native_staging": any(r.native_stage
+                                          for r in self._runners),
+                    "multimodal": self.multimodal,
+                 },
+                 "config": config_snapshot(),
+                 "versions": versions()},
+                max_ticks=flight_max_ticks,
+                digest_every=flight_digest_every)
+            self._flight_prev_tot = None
+            self._flight_prev_legs = None
+            self._flight_alert_seen: Dict[int, int] = {}
+            self._flight_alert_total = 0
+            self._flight_score_crc = 0
+            self._flight_rca_seen = 0
+            self._flight_rca_crc = 0
 
     # -- per-tenant plane construction ------------------------------------
 
@@ -741,6 +824,12 @@ class ServeEngine:
                 plane.buffer(qb.tenant_id, qb.spans,
                              keep_window=floor.get(qb.tenant_id))
             self._rca_tick(now)
+        if self.flight_recorder is not None:
+            # the journal entry rides INSIDE the measured wall (the
+            # serve_wall_s accumulation below) — the bench's flight
+            # overhead leg prices the recorder, never hides it
+            self._flight_tick(now, served,
+                              time.perf_counter() - t_wall)
         self.clock.advance()
         # telemetry work stays INSIDE the measured wall: the bench's
         # enabled-vs-off overhead number must price the scrape, not
@@ -869,6 +958,151 @@ class ServeEngine:
         dt = time.perf_counter() - t0
         runner.score_wall_s += dt
         runner._obs_score_s.inc(dt)
+
+    # -- the black-box flight recorder (anomod.obs.flight) ----------------
+
+    def _flight_tick(self, now: float, served: List[QueuedBatch],
+                     tick_wall_s: float, final: bool = False) -> None:
+        """Journal one tick into the flight recorder.
+
+        The CANONICAL planes hold only seed-determined decisions (the
+        parity surface `anomod audit diff` bisects): the admission
+        deltas + a crc32 over the served decision set in drain order,
+        the staged-chunk counts per width (``stage_plan`` is the one
+        staging definition, so the counts are identical at every shard
+        count / pipeline depth / residency), the active-plane census +
+        the cadenced tenant-state digest, and running digests of the
+        alert and RCA-verdict streams.  The VARIANT keys (``walls`` /
+        ``topology``) carry the tick's five-leg wall deltas and the
+        per-shard leg records, folded at the tick barrier in shard
+        order (the ``fold_verdicts`` idiom — every runner's book is
+        quiescent here, after the barrier).  ``final=True`` is the
+        run-end settlement record: finish() alerts and budget-deferred
+        RCA verdicts land in it, and a state digest is forced so every
+        journal ends on a full-state parity anchor."""
+        from anomod.obs.flight import crc_text, state_digest
+        from anomod.serve.shard import fold_leg_records
+        fr = self.flight_recorder
+        t_idx = self.clock.ticks
+        tot = self.admission.totals()
+        prev = self._flight_prev_tot
+
+        def delta(field):
+            return getattr(tot, field) - (getattr(prev, field)
+                                          if prev is not None else 0)
+
+        crc = 0
+        for qb in served:
+            crc = crc_text(f"{qb.tenant_id}:{qb.seq}:{qb.n_spans}:"
+                           f"{qb.priority}:{qb.enqueued_s!r}", crc)
+        admission = {"offered": delta("offered_spans"),
+                     "admitted": delta("admitted_spans"),
+                     "served": delta("served_spans"),
+                     "shed": delta("shed_spans"),
+                     "evicted": delta("evicted_batches"),
+                     "served_batches": delta("served_batches"),
+                     "digest": crc}
+        self._flight_prev_tot = tot
+        legs = [r.leg_walls() for r in self._runners]
+        prev_legs = self._flight_prev_legs or [{} for _ in legs]
+        by_width: Dict[int, int] = {}
+        chunks = 0
+        shard_legs = []
+        stage_s = dispatch_s = fold_s = score_s = 0.0
+        fused_d = native_staged = 0
+        for s, (leg, pleg) in enumerate(zip(legs, prev_legs)):
+            pw = pleg.get("by_width", {})
+            for w, n in leg["by_width"].items():
+                dn = n - pw.get(w, 0)
+                if dn:
+                    by_width[w] = by_width.get(w, 0) + dn
+            dchunks = leg["chunks"] - pleg.get("chunks", 0)
+            dstage = leg["stage_s"] - pleg.get("stage_s", 0.0)
+            ddisp = leg["dispatch_s"] - pleg.get("dispatch_s", 0.0)
+            dfold = leg["fold_s"] - pleg.get("fold_s", 0.0)
+            dscore = leg["score_s"] - pleg.get("score_s", 0.0)
+            dfused = leg["fused"] - pleg.get("fused", 0)
+            dnative = leg["native_staged"] - pleg.get("native_staged", 0)
+            chunks += dchunks
+            stage_s += dstage
+            dispatch_s += ddisp
+            fold_s += dfold
+            score_s += dscore
+            fused_d += dfused
+            native_staged += dnative
+            shard_legs.append({"shard": s, "chunks": dchunks,
+                               "fused": dfused,
+                               "native_staged": dnative,
+                               "stage_s": round(dstage, 6),
+                               "dispatch_s": round(ddisp, 6),
+                               "fold_s": round(dfold, 6),
+                               "score_s": round(dscore, 6)})
+        self._flight_prev_legs = legs
+        fold = {"tenants": len(self._tenant_replay),
+                "state_digest": (state_digest(self._tenant_replay)
+                                 if final or fr.digest_tick(t_idx)
+                                 else None)}
+        new_alerts = 0
+        crc = self._flight_score_crc
+        for tid in sorted(self._tenant_det):
+            alerts = getattr(self._tenant_det[tid], "alerts", ())
+            seen = self._flight_alert_seen.get(tid, 0)
+            for a in alerts[seen:]:
+                crc = crc_text(
+                    f"{tid}:{a.window}:{a.service}:{a.service_name}:"
+                    f"{a.score!r}:{a.z_latency!r}:{a.z_error!r}:"
+                    f"{a.z_drop!r}:{a.z_drop_cum!r}:{a.evidence}", crc)
+                new_alerts += 1
+            self._flight_alert_seen[tid] = len(alerts)
+        self._flight_score_crc = crc
+        self._flight_alert_total += new_alerts
+        score = {"alerts": new_alerts,
+                 "alerts_total": self._flight_alert_total,
+                 "digest": crc}
+        new_verdicts = self.rca_verdicts[self._flight_rca_seen:]
+        crc = self._flight_rca_crc
+        for v in new_verdicts:
+            crc = crc_text(repr(v.to_dict()), crc)
+        self._flight_rca_seen = len(self.rca_verdicts)
+        self._flight_rca_crc = crc
+        rca = {"verdicts": len(new_verdicts),
+               "verdicts_total": self._flight_rca_seen,
+               "digest": crc}
+        rec = {
+            "tick": t_idx, "now_s": now,
+            "admission": admission,
+            "dispatch": {"chunks": chunks,
+                         "by_width": {str(w): by_width[w]
+                                      for w in sorted(by_width)}},
+            "fold": fold, "score": score, "rca": rca,
+            "walls": {"tick_s": round(tick_wall_s, 6),
+                      "stage_s": round(stage_s, 6),
+                      "dispatch_s": round(dispatch_s, 6),
+                      "fold_s": round(fold_s, 6),
+                      "score_s": round(score_s, 6),
+                      "other_s": round(max(0.0, tick_wall_s - stage_s
+                                           - dispatch_s - fold_s
+                                           - score_s), 6)},
+            "topology": {"fused_dispatches": fused_d,
+                         "native_staged": native_staged,
+                         "shard_legs": fold_leg_records(shard_legs)},
+        }
+        if final:
+            rec["final"] = True
+        fr.record(rec)
+        # alert-triggered forensic bundle (ANOMOD_FLIGHT_DUMP_DIR): the
+        # first tick that raises a new alert publishes ONE ring+scrape+
+        # trace bundle — once per run, so a noisy fleet cannot turn the
+        # dump dir into a write amplifier
+        if (self._flight_dump_dir is not None and new_alerts
+                and not self._flight_dumped):
+            self._flight_dumped = True
+            from pathlib import Path as _P
+            fr.forensic(
+                _P(self._flight_dump_dir)
+                / f"flight_forensic_tick{t_idx:06d}.json",
+                registry=self._registry, tracer=self.tracer,
+                reason=f"{new_alerts} new alert(s) at tick {t_idx}")
 
     # -- the sharded (scale-out) score path -------------------------------
 
@@ -1076,6 +1310,13 @@ class ServeEngine:
                 self._rca_tick(self.clock.now_s,
                                budget=len(self._rca_queue))
         self.serve_wall_s += time.perf_counter() - t_wall
+        if self.flight_recorder is not None:
+            # run-end settlement record: finish() alerts + drained RCA
+            # verdicts land here, and the forced state digest gives every
+            # journal a full end-state parity anchor regardless of the
+            # per-tick digest cadence
+            self._flight_tick(self.clock.now_s, [],
+                              time.perf_counter() - t_wall, final=True)
         if self.shards > 1:
             # run-end registry fold: shard histograms (lane counts
             # etc.) DRAIN through the Histogram.merge_digest seam — the
@@ -1289,6 +1530,13 @@ class ServeEngine:
             rca_latency=rca_lat,
             rca_alert_to_culprit_s=rca_delay,
             rca_wall_s=round(self.rca_wall_s, 4),
+            flight_enabled=self.flight,
+            flight_recorded_ticks=(self.flight_recorder.n_recorded
+                                   if self.flight_recorder is not None
+                                   else 0),
+            flight_dropped_ticks=(self.flight_recorder.n_dropped
+                                  if self.flight_recorder is not None
+                                  else 0),
             serve_wall_s=round(self.serve_wall_s, 4),
             sustained_spans_per_sec=round(
                 self.n_spans_served / max(self.serve_wall_s, 1e-9), 1),

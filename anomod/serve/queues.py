@@ -70,6 +70,10 @@ class TenantCounters:
     offered_batches: int = 0
     served_batches: int = 0
     shed_batches: int = 0
+    # evictions are the subset of shed batches destroyed AFTER admission
+    # (displaced by a higher-priority arrival) — counted separately so
+    # the flight recorder's admission plane journals them per tick
+    evicted_batches: int = 0
 
 
 class AdmissionController:
@@ -182,6 +186,7 @@ class AdmissionController:
             vc = self.counters[victim.tenant_id]
             vc.shed_spans += victim.n_spans
             vc.shed_batches += 1
+            vc.evicted_batches += 1
             vc.admitted_spans -= victim.n_spans
             self._obs_shed.inc(victim.n_spans)
             self._obs_evicted.inc()

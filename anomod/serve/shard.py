@@ -162,6 +162,19 @@ def fold_verdicts(parts: Sequence[Sequence[tuple]]) -> List[tuple]:
     return out
 
 
+def fold_leg_records(legs: Sequence[dict]) -> List[dict]:
+    """Barrier fold of per-shard flight-journal leg records: each
+    shard's runner contributes one ``{"shard": s, ...}`` wall/dispatch
+    delta for the tick; merging on the shard id makes the journaled
+    order deterministic regardless of which worker finished first — the
+    :func:`fold_verdicts` idiom, flight-recorder half (the leg contents
+    are wall-clock/topology and ride the journal's VARIANT tier; only
+    their ORDER is part of the record's determinism)."""
+    out = [dict(leg) for leg in legs]
+    out.sort(key=lambda leg: leg["shard"])
+    return out
+
+
 def join_all(workers) -> None:
     """Barrier over submitted workers that COMPLETES before any error
     propagates: raising at the first failed join would leave sibling

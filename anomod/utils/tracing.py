@@ -129,15 +129,43 @@ class Tracer:
                           "processes": {"p0": {"serviceName": self.service}},
                           "spans": spans}]}
 
-    def dump(self, path: Path) -> None:
-        """Atomic publish (tmp + ``os.replace``): a killed run never
-        leaves a truncated trace behind a valid path."""
+    def to_chrome(self) -> List[dict]:
+        """The span list as Chrome trace-event JSON (the array form
+        ``chrome://tracing`` / Perfetto load directly): one complete
+        event (``"ph": "X"``) per span on the microsecond clock domain.
+
+        The trace-event format has no parent references — nesting is
+        inferred from timestamp containment per ``(pid, tid)`` lane — so
+        the EXPLICIT parent index and span id ride in ``args`` alongside
+        the span's tags, which is what lets :func:`spans_from_chrome`
+        round-trip the exact parent links instead of re-guessing them
+        from timestamps (guessing breaks on zero-duration spans)."""
+        with self._lock:
+            recs = [{**s, "tags": dict(s["tags"])} for s in self._spans]
+        events = []
+        for i, s in enumerate(recs):
+            events.append({
+                "name": s["name"], "ph": "X", "cat": self.service,
+                "ts": int(s["start"] * 1e6),
+                "dur": int(s["dur"] * 1e6),
+                "pid": 0, "tid": 0,
+                "args": {**{str(k): str(v)
+                            for k, v in sorted(s["tags"].items())},
+                         "span_id": i,
+                         "parent": -1 if s["parent"] is None
+                         else s["parent"]},
+            })
+        return events
+
+    def _dump_json(self, path: Path, doc) -> None:
+        """The one atomic-publish body behind both dump shapes (tmp +
+        ``os.replace``, the anomod.io.cache idiom)."""
         path = Path(path)
         if path.parent and not path.parent.exists():
             path.parent.mkdir(parents=True, exist_ok=True)
         tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
         try:
-            tmp.write_text(json.dumps(self.to_jaeger()))
+            tmp.write_text(json.dumps(doc))
             os.replace(tmp, path)
         finally:
             if tmp.exists():
@@ -145,6 +173,41 @@ class Tracer:
                     tmp.unlink()
                 except OSError:
                     pass
+
+    def dump_chrome(self, path: Path) -> None:
+        """Atomic publish of :meth:`to_chrome` (same contract as
+        :meth:`dump`)."""
+        self._dump_json(path, self.to_chrome())
+
+    def dump(self, path: Path) -> None:
+        """Atomic publish (tmp + ``os.replace``): a killed run never
+        leaves a truncated trace behind a valid path."""
+        self._dump_json(path, self.to_jaeger())
+
+
+def spans_from_chrome(events: List[dict]) -> List[dict]:
+    """Parse a Chrome trace-event array back into span records
+    (``{"name", "start", "dur", "parent", "tags"}`` — seconds, parent
+    by span index, ``None`` for roots): the round-trip contract of
+    :meth:`Tracer.to_chrome`, the chrome twin of
+    ``anomod.io.sn_traces.spans_from_jaeger``.  Only complete events
+    (``"ph": "X"``) are spans; anything else (metadata, counters some
+    other producer appended) is skipped.  Events are keyed back into
+    index order by the ``args.span_id`` the exporter planted, so a
+    reordered (e.g. Perfetto-sorted) file still parses losslessly."""
+    spans = [e for e in events if e.get("ph") == "X"]
+    spans.sort(key=lambda e: e.get("args", {}).get("span_id", 0))
+    out = []
+    for e in spans:
+        args = dict(e.get("args", {}))
+        parent = args.pop("parent", -1)
+        args.pop("span_id", None)
+        out.append({"name": e.get("name", ""),
+                    "start": e.get("ts", 0) / 1e6,
+                    "dur": e.get("dur", 0) / 1e6,
+                    "parent": None if parent in (-1, None) else int(parent),
+                    "tags": args})
+    return out
 
 
 @contextlib.contextmanager

@@ -160,7 +160,11 @@ def serve_main(probe_fresh=False) -> int:
     ``ANOMOD_SERVE_STATE=host``) isolates the device-resident tenant
     pool the same way: the ``serve_state`` block carries both legs'
     five-way decompositions, the fold+score+other share the residency
-    change attacks, and the pool's byte-parity bits.
+    change attacks, and the pool's byte-parity bits.  A FLIGHT-OFF leg
+    (same seed, ``flight=False``) prices the black-box tick journal
+    (anomod.obs.flight): the ``flight`` block reports the recorder's
+    overhead fraction (bar: <= 5%), its drop counters (zero = the ring
+    never evicted) and the read-side byte-parity bits.
     After the shard-scaling legs,
     two ONLINE-RCA legs (1-shard and 2-shard, ``rca=True``, same seed)
     fill the ``rca`` block: top-k hit-rate (k=1,3,5) against the
@@ -241,6 +245,17 @@ def serve_main(probe_fresh=False) -> int:
             set_registry(Registry(enabled=True))
             eng_hostst, rep_hostst = run_power_law(
                 state="host", shards=1, **run_kw)
+            # the flight-recorder-off reference leg: same seed, the
+            # black-box tick journal (anomod.obs.flight) forced OFF,
+            # telemetry on, own registry, run after the headline legs
+            # so the recorder's measured overhead is an upper bound.
+            # The recorder is a pure read-side consumer, so every
+            # decision metric must match the headline byte-for-byte —
+            # the `flight` block records the parity bits with the
+            # overhead (bar: <= 5%, the telemetry discipline)
+            set_registry(Registry(enabled=True))
+            eng_floff, rep_floff = run_power_law(
+                flight=False, shards=1, **run_kw)
             # the shard-scaling legs (2 and 4 engine workers, same
             # seed), then a FRESH 1-shard reference leg LAST: the
             # reference inherits the most process warmup of the whole
@@ -401,6 +416,34 @@ def serve_main(probe_fresh=False) -> int:
                 == rep.latency.get("p99_latency_s"),
                 "shed_identical":
                     rep_hostst.shed_fraction == rep.shed_fraction,
+            },
+        }
+        # flight recorder (ISSUE-9): the always-on tick journal's
+        # measured overhead on the same seed, its drop counters (zero =
+        # no silent loss — the ring never evicted), and the byte-parity
+        # bits a read-side recorder must hold against the no-recorder
+        # leg
+        _fl_alerts_same, _fl_states_same = _engines_identical(
+            eng_head, eng_floff)
+        out["flight"] = {
+            "enabled_headline": rep.flight_enabled,
+            "recorded_ticks": rep.flight_recorded_ticks,
+            "dropped_ticks": rep.flight_dropped_ticks,
+            "digest_every": (eng_head.flight_recorder.digest_every
+                             if eng_head.flight_recorder is not None
+                             else None),
+            "spans_per_sec_on": rep.sustained_spans_per_sec,
+            "spans_per_sec_off": rep_floff.sustained_spans_per_sec,
+            "overhead_fraction": round(max(
+                0.0, 1.0 - rep.sustained_spans_per_sec
+                / max(rep_floff.sustained_spans_per_sec, 1e-9)), 4),
+            "parity": {
+                "alerts_identical": _fl_alerts_same,
+                "states_identical": _fl_states_same,
+                "p99_identical": rep_floff.latency.get("p99_latency_s")
+                == rep.latency.get("p99_latency_s"),
+                "shed_identical":
+                    rep_floff.shed_fraction == rep.shed_fraction,
             },
         }
         # shard scaling on the same seed (1 / 2 / 4 engine workers; the

@@ -44,13 +44,31 @@ on a box whose pool cannot even construct/operate is its own failure
 mode — exit 6, distinct, so a driver can tell "unset
 ANOMOD_SERVE_STATE" from "the fold math is broken".
 
-Exit codes: 0 = ready (warm cache, or --cold / caching disabled is
-explicit, or serve preconditions hold), 1 = cold cache without --cold,
-2 = caching disabled without --cold, 3 = serve precondition failure,
-4 = env contract violation, 5 = ANOMOD_NATIVE requested but the native
-runtime is unusable (compiler missing / build failed), 6 =
-ANOMOD_SERVE_STATE=device forced but the device state pool is
-unusable.
+Serve mode also runs a <5 s flight-recorder record→replay→diff smoke
+(anomod.obs.flight): the same tiny seeded run journaled twice — once at
+1 shard, once at 2 — must produce byte-identical canonical journals;
+``diff_journals`` bisecting a divergence fails the gate with its own
+exit code (7), distinct from the generic serve failure, so a driver can
+tell "the tick journal broke determinism" from "the grid is broken".
+
+Exit codes (the ``EXIT_*`` constants below are the one definition — the
+uniqueness test in tests/test_bench_contract.py collects them by prefix
+and the table in docs/BENCHMARKS.md mirrors them):
+
+- ``EXIT_READY`` (0): ready — warm cache, or --cold / caching disabled
+  is explicit, or serve preconditions hold
+- ``EXIT_COLD_CACHE`` (1): cold ingest cache without --cold
+- ``EXIT_CACHE_DISABLED`` (2): caching disabled without --cold
+- ``EXIT_SERVE_PRECONDITION`` (3): serve precondition failure (env
+  knobs, bucket-grid compile, shard fan-out / state-residency parity)
+- ``EXIT_ENV_CONTRACT`` (4): undocumented ``ANOMOD_*`` env read
+- ``EXIT_NATIVE_UNUSABLE`` (5): ANOMOD_NATIVE requested but the native
+  runtime is unusable (compiler missing / build failed)
+- ``EXIT_STATE_POOL_UNUSABLE`` (6): ANOMOD_SERVE_STATE=device forced
+  but the device state pool is unusable
+- ``EXIT_FLIGHT_DIVERGENCE`` (7): the flight-journal record→replay→diff
+  smoke found a divergent tick/plane
+
 Always prints one JSON line describing the decision (plus the contract
 gate's line).  ``--traces`` must match the bench invocation's span
 count (the cache key includes it).
@@ -62,6 +80,18 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: the gate's exit-code contract, accreted one failure mode per PR —
+#: named in ONE place so drivers, docs/BENCHMARKS.md and the uniqueness
+#: test cannot drift apart
+EXIT_READY = 0
+EXIT_COLD_CACHE = 1
+EXIT_CACHE_DISABLED = 2
+EXIT_SERVE_PRECONDITION = 3
+EXIT_ENV_CONTRACT = 4
+EXIT_NATIVE_UNUSABLE = 5
+EXIT_STATE_POOL_UNUSABLE = 6
+EXIT_FLIGHT_DIVERGENCE = 7
 
 
 def _shard_fanout_smoke() -> dict:
@@ -184,6 +214,34 @@ def _native_smoke() -> dict:
     return {"status": "ok", "cols": len(scratch)}
 
 
+def _flight_smoke():
+    """The flight-recorder record→replay→diff smoke (<5 s): the same
+    tiny seeded run journaled at 1 shard (record) and re-executed at 2
+    shards (the forensic replay) must produce canonical journals
+    ``diff_journals`` finds identical — every plane, every tick.  A
+    divergence means the tick journal broke the determinism contract
+    and every audit trail a capture leaves would be unusable.  Returns
+    ``(info, divergence_or_None)``."""
+    from anomod.obs.flight import diff_journals
+    from anomod.serve.engine import run_power_law
+
+    def go(n_shards):
+        eng, _ = run_power_law(
+            n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+            overload=2.0, duration_s=20, tick_s=1.0, seed=5,
+            window_s=5.0, baseline_windows=4, fault_tenants=0,
+            buckets=(64, 256), lane_buckets=(1, 2, 4), max_backlog=1500,
+            n_windows=16, shards=n_shards, pipeline=2, flight=True,
+            flight_digest_every=4)
+        return eng.flight_recorder
+
+    rec = go(1)
+    rep = go(2)
+    info = {"ticks": rec.n_recorded, "dropped": rec.n_dropped,
+            "digest_every": rec.digest_every}
+    return info, diff_journals(rec.journal(), rep.journal())
+
+
 def check_serve() -> int:
     """Serve-bench preconditions: env contract parses, bucket set
     compiles, the shard fan-out reproduces the 1-shard output, and the
@@ -215,7 +273,7 @@ def check_serve() -> int:
                   "install g++ and `make -C native smoke`, or unset "
                   "ANOMOD_NATIVE to serve the pure-Python path",
                   file=sys.stderr)
-            return 5
+            return EXIT_NATIVE_UNUSABLE
         if out["native"]["staging"]:
             out["native"]["smoke"] = _native_smoke()
         from anomod.serve.batcher import BucketRunner
@@ -241,7 +299,7 @@ def check_serve() -> int:
                       "ANOMOD_SERVE_STATE (auto picks the backend's "
                       "engine) or serve the host seam",
                       file=sys.stderr)
-                return 6
+                return EXIT_STATE_POOL_UNUSABLE
         # the serve bench's plane shape (ONE definition with bench.py's
         # serve path): compile every bucket width once so the capture's
         # compile_s is warm-path bookkeeping, not a mid-capture stall.
@@ -283,15 +341,30 @@ def check_serve() -> int:
         rca_compile_s = rca_runner.warm()
         out.update(rca_buckets=[list(b) for b in rca_runner.buckets],
                    rca_compile_s=round(rca_compile_s, 3))
+        # the flight-recorder record→replay→diff smoke: a capture whose
+        # tick journal cannot replay clean leaves no usable audit trail
+        # — its own exit code, distinct from the generic serve failure
+        flight_info, divergence = _flight_smoke()
+        out["flight_smoke"] = flight_info
+        if divergence is not None:
+            out["status"] = "flight-divergence"
+            out["divergence"] = divergence
+            print(json.dumps(out))
+            print(f"pre_bench_check: flight-journal smoke diverged at "
+                  f"tick {divergence['tick']} in the "
+                  f"{divergence['plane']} plane — the tick journal broke "
+                  "the determinism contract and a capture's audit trail "
+                  "would be unusable", file=sys.stderr)
+            return EXIT_FLIGHT_DIVERGENCE
         print(json.dumps(out))
-        return 0
+        return EXIT_READY
     except Exception as e:
         out.update(status="serve-precondition-failed",
                    error=f"{type(e).__name__}: {e}")
         print(json.dumps(out))
         print(f"pre_bench_check: serve preconditions failed: {e}",
               file=sys.stderr)
-        return 3
+        return EXIT_SERVE_PRECONDITION
 
 
 def main(argv=None) -> int:
@@ -323,7 +396,7 @@ def main(argv=None) -> int:
         print("pre_bench_check: env contract violated — run "
               "scripts/check_env_contract.py and fix the listed ANOMOD_* "
               "vars (Config or docs) before capturing", file=sys.stderr)
-        return 4
+        return EXIT_ENV_CONTRACT
 
     if args.mode == "serve":
         return check_serve()
@@ -340,23 +413,23 @@ def main(argv=None) -> int:
         out["status"] = "caching-disabled"
         print(json.dumps(out))
         if args.cold:
-            return 0
+            return EXIT_READY
         print("pre_bench_check: ANOMOD_CACHE_DIR is disabled — captures "
               "would re-synthesize the corpus every run; pass --cold to "
               "record one anyway", file=sys.stderr)
-        return 2
+        return EXIT_CACHE_DISABLED
     present, total = bench_cache_status(args.testbed, args.traces)
     out.update(entries_present=present, entries_total=total,
                status="warm" if present == total else "cold")
     print(json.dumps(out))
     if present == total or args.cold:
-        return 0
+        return EXIT_READY
     print(f"pre_bench_check: ingest cache at {root} is cold for the "
           f"{args.testbed}/{args.traces}-trace bench corpus — run "
           f"`anomod ingest --warm-cache --bench-traces {args.traces}` "
           "first, or pass --cold to capture an ingest-bound number on "
           "purpose", file=sys.stderr)
-    return 1
+    return EXIT_COLD_CACHE
 
 
 if __name__ == "__main__":

@@ -233,6 +233,51 @@ def test_bench_serve_mode_contract(tmp_path):
     assert par["p99_identical_to_rca_off"] is True
     assert par["shed_identical_to_rca_off"] is True
     assert par["verdicts_identical_1_vs_2_shards"] is True
+    # flight-recorder block (ISSUE-9): the always-on tick journal's
+    # overhead leg, zero ring drops (no silent loss), and the read-side
+    # byte-parity bits against the no-recorder leg
+    fl = out["flight"]
+    assert fl["enabled_headline"] is True
+    assert fl["recorded_ticks"] > 0
+    assert fl["dropped_ticks"] == 0
+    assert fl["digest_every"] >= 1
+    assert fl["spans_per_sec_on"] == out["value"]
+    assert fl["spans_per_sec_off"] > 0
+    assert 0.0 <= fl["overhead_fraction"] < 1.0
+    par = fl["parity"]
+    assert par["alerts_identical"] is True
+    assert par["states_identical"] is True
+    assert par["p99_identical"] is True
+    assert par["shed_identical"] is True
+
+
+def test_pre_bench_exit_codes_named_and_unique():
+    """The gate's exit-code table (accreted 3/4/5/6/7 across PRs 5–9)
+    lives as named EXIT_* constants in ONE place; the constants are
+    collected by prefix (a new one joins the pin automatically), every
+    code is distinct, and the documented values are pinned so drivers
+    parsing return codes never see a silent renumbering."""
+    import sys as _sys
+    _sys.path.insert(0, str(Path(__file__).parent.parent / "scripts"))
+    try:
+        import pre_bench_check as pbc
+    finally:
+        _sys.path.pop(0)
+    codes = {name: getattr(pbc, name) for name in dir(pbc)
+             if name.startswith("EXIT_")}
+    assert len(set(codes.values())) == len(codes)
+    assert codes == {
+        "EXIT_READY": 0, "EXIT_COLD_CACHE": 1, "EXIT_CACHE_DISABLED": 2,
+        "EXIT_SERVE_PRECONDITION": 3, "EXIT_ENV_CONTRACT": 4,
+        "EXIT_NATIVE_UNUSABLE": 5, "EXIT_STATE_POOL_UNUSABLE": 6,
+        "EXIT_FLIGHT_DIVERGENCE": 7,
+    }
+    # every literal return in the gate's source goes through a constant
+    src = (Path(__file__).parent.parent / "scripts"
+           / "pre_bench_check.py").read_text()
+    import re
+    assert not re.search(r"return [0-9]", src), \
+        "pre_bench_check must return named EXIT_* constants, not literals"
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,325 @@
+"""Black-box flight recorder (anomod.obs.flight) + `anomod audit`.
+
+The acceptance-critical pins: same seed ⇒ BYTE-identical canonical
+journals across reruns, 1-vs-2 shards, host-vs-device tenant state and
+pipeline depths 1–3; a deliberately-injected divergence bisects to the
+correct first tick AND plane through ``audit diff`` (nonzero exit); ring
+drops are counted, never silent; the alert-triggered forensic bundle
+publishes atomically; and the recorder is a pure read-side consumer
+(identical engine decisions with the journal on or off).
+"""
+
+import copy
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from anomod.obs.flight import (FLIGHT_FORMAT, FLIGHT_VARIANT_KEYS, PLANES,
+                               canonical_ticks, diff_journals, load_journal)
+from anomod.serve.engine import run_power_law
+
+#: the shared tiny seeded run: small enough for tier-1, long enough past
+#: the fault onset (12 virtual s at window 2.0 / baseline 4) that the
+#: score AND rca planes carry live digests — a determinism pin over
+#: all-zero planes would prove nothing
+RUN_KW = dict(n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+              overload=2.0, duration_s=24, tick_s=1.0, seed=5,
+              window_s=2.0, baseline_windows=4, fault_tenants=1,
+              buckets=(64, 256), lane_buckets=(1, 2, 4), max_backlog=1500,
+              n_windows=16, flight=True, flight_digest_every=4)
+
+
+def _run(**overrides):
+    kw = {**RUN_KW, **overrides}
+    return run_power_law(**kw)
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """One reference run (1 shard, device state, pipeline default,
+    RCA on) every variant below diffs against."""
+    eng, rep = _run(rca=True)
+    return eng, rep
+
+
+# ---------------------------------------------------------------------------
+# journal determinism: the byte-parity surface
+# ---------------------------------------------------------------------------
+
+def test_rerun_byte_identical(baseline):
+    eng, rep = baseline
+    eng2, rep2 = _run(rca=True)
+    assert eng.flight_recorder.canonical_bytes() \
+        == eng2.flight_recorder.canonical_bytes()
+    # the journal covered every tick plus the run-end settlement record
+    assert eng.flight_recorder.n_recorded == rep.ticks + 1
+    assert rep.flight_enabled and rep.flight_recorded_ticks == rep.ticks + 1
+    assert rep.flight_dropped_ticks == 0
+    # the planes under pin are LIVE (alerts fired, verdicts ran, state
+    # digests landed) — an all-zero journal would vacuously match
+    recs = eng.flight_recorder.records()
+    assert any(t["score"]["digest"] for t in recs)
+    assert any(t["rca"]["digest"] for t in recs)
+    assert any(t["fold"]["state_digest"] is not None for t in recs)
+    assert recs[-1].get("final") is True
+    assert recs[-1]["fold"]["state_digest"] is not None
+
+
+@pytest.mark.parametrize("overrides", [
+    dict(shards=2),
+    dict(state="host"),
+    dict(pipeline=1),
+    dict(pipeline=3),
+], ids=["2-shards", "host-state", "pipeline-1", "pipeline-3"])
+def test_variant_journals_pinned_identical(baseline, overrides):
+    """The determinism contracts as runnable forensics: an N-shard /
+    host-seam / any-pipeline-depth run's canonical journal is
+    byte-identical to the baseline's — diff finds nothing, and the raw
+    canonical bytes match too."""
+    eng, _ = baseline
+    eng2, _ = _run(rca=True, **overrides)
+    assert diff_journals(eng.flight_recorder.journal(),
+                         eng2.flight_recorder.journal()) is None
+    assert eng.flight_recorder.canonical_bytes() \
+        == eng2.flight_recorder.canonical_bytes()
+
+
+def test_flight_off_is_read_side_only(baseline):
+    """The recorder must never influence a decision: the same seed with
+    flight OFF produces identical alerts, states and report decisions."""
+    import dataclasses
+
+    from anomod.serve.engine import SHARD_VARIANT_REPORT_FIELDS
+    eng, rep = baseline
+    eng2, rep2 = _run(rca=True, flight=False)
+    assert eng2.flight_recorder is None and rep2.flight_enabled is False
+    for tid in eng._tenant_det:
+        assert [dataclasses.asdict(a) for a in eng.alerts_for(tid)] \
+            == [dataclasses.asdict(a) for a in eng2.alerts_for(tid)]
+        s1, s2 = eng._tenant_replay[tid].state, eng2._tenant_replay[tid].state
+        assert np.array_equal(np.asarray(s1.agg), np.asarray(s2.agg))
+        assert np.array_equal(np.asarray(s1.hist), np.asarray(s2.hist))
+    skip = set(SHARD_VARIANT_REPORT_FIELDS) | {
+        "flight_enabled", "flight_recorded_ticks", "flight_dropped_ticks"}
+    a = {k: v for k, v in rep.to_dict().items() if k not in skip}
+    b = {k: v for k, v in rep2.to_dict().items() if k not in skip}
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# divergence bisection
+# ---------------------------------------------------------------------------
+
+def test_injected_divergence_bisects_to_tick_and_plane(baseline):
+    eng, _ = baseline
+    a = eng.flight_recorder.journal()
+    # one tampered plane at one tick → exactly that (tick, plane)
+    for plane, key in (("admission", "digest"), ("score", "digest"),
+                       ("rca", "digest"), ("dispatch", "chunks")):
+        b = copy.deepcopy(a)
+        b["ticks"][15][plane][key] = (b["ticks"][15][plane][key] or 0) + 1
+        d = diff_journals(a, b)
+        assert d is not None
+        assert (d["tick"], d["plane"]) == (15, plane), d
+    # fold tampering must land on a DIGEST tick to be visible — pick one
+    b = copy.deepcopy(a)
+    digest_ticks = [i for i, t in enumerate(b["ticks"])
+                    if t["fold"]["state_digest"] is not None]
+    b["ticks"][digest_ticks[1]]["fold"]["state_digest"] ^= 0xFF
+    d = diff_journals(a, b)
+    assert d is not None and d["plane"] == "fold"
+    assert d["tick"] == b["ticks"][digest_ticks[1]]["tick"]
+    # two tampered planes in one tick → the CAUSALLY earliest is named
+    b = copy.deepcopy(a)
+    b["ticks"][10]["score"]["digest"] += 1
+    b["ticks"][10]["admission"]["digest"] += 1
+    d = diff_journals(a, b)
+    assert (d["tick"], d["plane"]) == (10, "admission")
+    # truncation is length divergence, never silence
+    b = copy.deepcopy(a)
+    b["ticks"] = b["ticks"][:12]
+    d = diff_journals(a, b)
+    assert d["plane"] == "length" and d["index"] == 12
+
+
+def test_real_perturbation_diverges_early(baseline):
+    """A genuinely different run (different seed) must diverge — and at
+    the first tick the seeded arrivals differ, in the admission plane
+    (the causally-first decision), not in some downstream echo."""
+    eng, _ = baseline
+    eng2, _ = _run(rca=True, seed=6)
+    d = diff_journals(eng.flight_recorder.journal(),
+                      eng2.flight_recorder.journal())
+    assert d is not None
+    assert d["plane"] == "admission"
+    assert d["tick"] == 0       # power-law arrivals differ from tick one
+
+
+def test_variant_keys_excluded_from_canonical(baseline):
+    """Wall clocks and shard/lane topology are journal-variant: present
+    in the dump for forensics, absent from the parity surface."""
+    eng, _ = baseline
+    recs = eng.flight_recorder.records()
+    assert all(set(FLIGHT_VARIANT_KEYS) <= set(r) for r in recs)
+    for rec in canonical_ticks(recs):
+        assert not set(FLIGHT_VARIANT_KEYS) & set(rec)
+        assert set(PLANES) <= set(rec)
+    # per-shard legs fold in shard order at the barrier
+    eng2, _ = _run(shards=2)
+    for rec in eng2.flight_recorder.records():
+        legs = rec["topology"]["shard_legs"]
+        assert [leg["shard"] for leg in legs] == sorted(
+            leg["shard"] for leg in legs)
+
+
+# ---------------------------------------------------------------------------
+# ring bounding: loss is counted, never silent
+# ---------------------------------------------------------------------------
+
+def test_ring_drops_are_counted():
+    eng, rep = _run(flight_max_ticks=4)
+    fr = eng.flight_recorder
+    assert len(fr.records()) == 4
+    assert fr.n_recorded == rep.ticks + 1
+    assert fr.n_dropped == fr.n_recorded - 4
+    assert rep.flight_dropped_ticks == fr.n_dropped > 0
+    # the ring keeps the NEWEST ticks (the forensically useful end)
+    assert fr.records()[-1].get("final") is True
+
+
+def test_recorder_validation():
+    from anomod.obs.flight import FlightRecorder
+    with pytest.raises(ValueError):
+        FlightRecorder({}, max_ticks=0)
+    with pytest.raises(ValueError):
+        FlightRecorder({}, digest_every=0)
+
+
+def test_flight_knobs_validated(monkeypatch):
+    from anomod.config import Config
+    monkeypatch.setenv("ANOMOD_FLIGHT", "0")
+    monkeypatch.setenv("ANOMOD_FLIGHT_DIGEST_EVERY", "32")
+    monkeypatch.setenv("ANOMOD_FLIGHT_MAX_TICKS", "128")
+    cfg = Config()
+    assert cfg.flight is False
+    assert cfg.flight_digest_every == 32
+    assert cfg.flight_max_ticks == 128
+    assert cfg.flight_dump_dir is None
+    monkeypatch.setenv("ANOMOD_FLIGHT_DUMP_DIR", "/tmp/fd")
+    assert Config().flight_dump_dir == Path("/tmp/fd")
+    for var, bad in (("ANOMOD_FLIGHT_DIGEST_EVERY", "0"),
+                     ("ANOMOD_FLIGHT_DIGEST_EVERY", "banana"),
+                     ("ANOMOD_FLIGHT_MAX_TICKS", "-1"),
+                     ("ANOMOD_FLIGHT_MAX_TICKS", "many")):
+        monkeypatch.setenv(var, bad)
+        with pytest.raises(ValueError):
+            Config()
+        monkeypatch.delenv(var)
+
+
+def test_flight_knobs_env_contract_covered():
+    """Every new ANOMOD_FLIGHT* knob is in the validated Config contract
+    (check_env_contract green — the CI gate's clause of the issue)."""
+    import sys as _sys
+    _sys.path.insert(0, str(Path(__file__).parent.parent / "scripts"))
+    try:
+        import check_env_contract as cec
+    finally:
+        _sys.path.pop(0)
+    refs = cec.referenced_vars(Path(cec.ROOT))
+    corpus = cec.covered_vars(Path(cec.ROOT))
+    for knob in ("ANOMOD_FLIGHT", "ANOMOD_FLIGHT_DIGEST_EVERY",
+                 "ANOMOD_FLIGHT_MAX_TICKS", "ANOMOD_FLIGHT_DUMP_DIR"):
+        assert knob in refs and knob in corpus
+
+
+# ---------------------------------------------------------------------------
+# header + dump + audit CLI
+# ---------------------------------------------------------------------------
+
+def test_header_is_self_describing(baseline):
+    eng, _ = baseline
+    h = eng.flight_recorder.header
+    assert h["flight_format"] == FLIGHT_FORMAT
+    assert h["run"]["seed"] == RUN_KW["seed"]
+    assert h["engine"]["n_tenants"] == RUN_KW["n_tenants"]
+    assert h["engine"]["serve_state"] in ("host", "device")
+    assert h["config"]["flight_digest_every"] >= 1
+    assert "jax" in h["versions"] and "numpy" in h["versions"]
+    assert h["digest_every"] == 4
+    # every env-defaulted knob that can move a canonical plane is
+    # recorded RESOLVED, never as the raw None the replay process would
+    # re-resolve from ITS env (env drift must not read as divergence)
+    run = h["run"]
+    assert run["buckets"] == list(RUN_KW["buckets"])
+    assert run["lane_buckets"] == list(RUN_KW["lane_buckets"])
+    assert run["max_backlog"] == RUN_KW["max_backlog"]
+    assert run["fuse"] is True and run["rca"] is True
+    assert run["shards"] == 1 and run["pipeline"] >= 1
+    assert run["state"] in ("host", "device")
+
+
+def test_dump_atomic_and_loadable(tmp_path, baseline):
+    eng, _ = baseline
+    path = tmp_path / "flight.json"
+    path.write_text('{"stale": true}')
+    doc = eng.flight_recorder.dump(path)
+    assert list(tmp_path.glob("*.tmp")) == []
+    loaded = load_journal(path)
+    assert loaded["n_recorded"] == doc["n_recorded"]
+    assert diff_journals(loaded, eng.flight_recorder.journal()) is None
+    # a non-flight document must refuse to load, not diff as nonsense
+    other = tmp_path / "other.json"
+    other.write_text('{"ticks": "lol"}')
+    with pytest.raises(ValueError):
+        load_journal(other)
+
+
+def test_audit_cli_record_replay_diff(tmp_path):
+    from anomod.cli import main
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    common = ["--tenants", "6", "--services", "4", "--duration", "20",
+              "--capacity", "1000", "--seed", "5", "--tick", "1.0",
+              "--window-seconds", "2.0", "--baseline-windows", "4",
+              "--digest-every", "4"]
+    assert main(["audit", "record", "--out", a] + common) == 0
+    # forensic replay at 2 shards from the journal header alone
+    assert main(["audit", "replay", a, "--out", b, "--shards", "2"]) == 0
+    assert main(["audit", "diff", a, b]) == 0
+    doc = load_journal(b)
+    assert doc["header"]["engine"]["shards"] == 2
+    # a tampered journal diffs nonzero and names tick+plane
+    doc["ticks"][7]["admission"]["digest"] ^= 1
+    c = tmp_path / "c.json"
+    c.write_text(json.dumps(doc))
+    assert main(["audit", "diff", a, str(c)]) == 1
+
+
+def test_forensic_bundle_on_alert(tmp_path, monkeypatch):
+    """ANOMOD_FLIGHT_DUMP_DIR: the first alerting tick publishes ONE
+    ring+registry+trace bundle, atomically."""
+    from anomod.config import Config, get_config, set_config
+    from anomod.obs.registry import Registry, set_registry
+    monkeypatch.setenv("ANOMOD_FLIGHT_DUMP_DIR", str(tmp_path / "dumps"))
+    prev_cfg = get_config()
+    reg = Registry(enabled=True)
+    prev_reg = set_registry(reg)
+    set_config(Config())
+    try:
+        eng, rep = _run()
+    finally:
+        set_config(prev_cfg)
+        set_registry(prev_reg)
+    assert rep.n_alerts > 0
+    dumps = sorted((tmp_path / "dumps").glob("flight_forensic_*.json"))
+    assert len(dumps) == 1                      # once per run, bounded
+    assert not list((tmp_path / "dumps").glob("*.tmp"))
+    doc = json.loads(dumps[0].read_text())
+    assert doc["bundle"] == "anomod-flight-forensic"
+    assert "alert" in doc["reason"]
+    assert doc["flight"]["ticks"]
+    assert doc["registry"]["snapshot"]
+    assert doc["trace"]["data"][0]["spans"]     # tracer rode the engine
+    assert reg.counter("anomod_flight_dumps_total").value == 1

@@ -231,11 +231,81 @@ def test_prometheus_text_format(registry):
     for v in np.linspace(0.01, 0.02, 300):
         h.observe(float(v))
     text = obs_export.to_prometheus_text(registry)
+    assert "# HELP anomod_ingest_cache_hits_total " in text
     assert "# TYPE anomod_ingest_cache_hits_total counter" in text
     assert "anomod_ingest_cache_hits_total 3" in text
+    assert "# HELP anomod_serve_tick_seconds " in text
     assert "# TYPE anomod_serve_tick_seconds summary" in text
     assert 'anomod_serve_tick_seconds{quantile="0.99"}' in text
     assert "anomod_serve_tick_seconds_count 300" in text
+
+
+def _parse_prom(text):
+    """A tiny exposition-format parser (unescaping label values per the
+    grammar) — what the adversarial-label pin re-reads the export with."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, value = line.rsplit(" ", 1)
+        labels = {}
+        if "{" in head:
+            name, rest = head.split("{", 1)
+            body = rest[:rest.rindex("}")]
+            i = 0
+            while i < len(body):
+                eq = body.index("=", i)
+                key = body[i:eq]
+                assert body[eq + 1] == '"'
+                j = eq + 2
+                val = []
+                while body[j] != '"':
+                    if body[j] == "\\":
+                        val.append({"\\": "\\", '"': '"',
+                                    "n": "\n"}[body[j + 1]])
+                        j += 2
+                    else:
+                        val.append(body[j])
+                        j += 1
+                labels[key] = "".join(val)
+                i = j + 1
+                if i < len(body) and body[i] == ",":
+                    i += 1
+            head = name
+        samples[(head, tuple(sorted(labels.items())))] = float(value)
+    return samples
+
+
+def test_prometheus_escaping_adversarial_labels(registry):
+    """Exposition-format hardening: backslash, double-quote and newline
+    in label values must escape per the grammar and round-trip through a
+    parser; HELP lines appear exactly once per metric family even with
+    label variants (the shard-labeled gauge shape)."""
+    evil = 'C:\\temp\n"quoted",comma'
+    registry.gauge("anomod_test_evil", path=evil).set(7)
+    registry.gauge("anomod_test_evil", path="plain").set(8)
+    registry.counter("anomod_test_total", reason="a\\b").inc(2)
+    text = obs_export.to_prometheus_text(registry)
+    # raw control characters never leak into the wire format
+    for line in text.splitlines():
+        assert "\r" not in line
+    assert '\\n' in text and '\\"' in text and "\\\\" in text
+    samples = _parse_prom(text)
+    assert samples[("anomod_test_evil",
+                    (("path", evil),))] == 7
+    assert samples[("anomod_test_evil",
+                    (("path", "plain"),))] == 8
+    assert samples[("anomod_test_total",
+                    (("reason", "a\\b"),))] == 2
+    # one HELP + one TYPE per family, label variants notwithstanding
+    assert text.count("# HELP anomod_test_evil ") == 1
+    assert text.count("# TYPE anomod_test_evil ") == 1
+    # every family has a HELP line
+    names = {line.split(" ", 3)[2] for line in text.splitlines()
+             if line.startswith("# TYPE ")}
+    helped = {line.split(" ", 3)[2] for line in text.splitlines()
+              if line.startswith("# HELP ")}
+    assert names == helped
 
 
 # ---------------------------------------------------------------------------
@@ -493,6 +563,59 @@ def test_tracer_jaeger_roundtrip_parents_and_durations(tmp_path):
     assert {"key": "phase", "value": "bench"} in root_span["tags"]
     detect_span = next(s for s in doc if s["operationName"] == "detect")
     assert detect_span["logs"] and detect_span["logs"][0]["fields"]
+
+
+def test_tracer_chrome_roundtrip(tmp_path):
+    """Chrome trace-event exporter: the event array loads as plain JSON
+    (the chrome://tracing / Perfetto shape — complete "X" events on the
+    microsecond clock) and round-trips through spans_from_chrome with
+    names, parents, durations and tags intact."""
+    import time
+
+    from anomod.utils.tracing import spans_from_chrome
+    tr = Tracer("anomod-test")
+    with tr.span("pipeline", phase="bench"):
+        with tr.span("load"):
+            time.sleep(0.01)
+        with tr.span("detect"):
+            pass
+    events = tr.to_chrome()
+    assert all(e["ph"] == "X" for e in events)
+    assert all(isinstance(e["ts"], int) and isinstance(e["dur"], int)
+               for e in events)
+    # foreign events (another producer's metadata rows) are skipped, and
+    # a Perfetto-style re-sort by timestamp still parses losslessly
+    shuffled = sorted(events, key=lambda e: e["ts"], reverse=True)
+    spans = spans_from_chrome(
+        [{"ph": "M", "name": "process_name"}] + shuffled)
+    assert [s["name"] for s in spans] == ["pipeline", "load", "detect"]
+    root = spans[0]
+    assert root["parent"] is None
+    assert spans[1]["parent"] == 0 and spans[2]["parent"] == 0
+    assert spans[1]["dur"] >= 0.01
+    assert root["tags"] == {"phase": "bench"}
+    # atomic publish, same contract as the jaeger dump
+    path = tmp_path / "trace_chrome.json"
+    path.write_text("[]")
+    tr.dump_chrome(path)
+    assert list(tmp_path.glob("*.tmp")) == []
+    assert json.loads(path.read_text()) == events
+
+
+def test_obs_export_chrome_cli(tmp_path):
+    """`anomod obs export --format chrome`: the self-exercise engine's
+    own trace lands as a loadable trace-event array."""
+    from anomod.cli import main
+    from anomod.utils.tracing import spans_from_chrome
+    out = tmp_path / "serve_trace.json"
+    rc = main(["obs", "export", "--format", "chrome", "--out", str(out),
+               "--serve-seconds", "4", "--tenants", "4",
+               "--capacity", "1000"])
+    assert rc == 0
+    events = json.loads(out.read_text())
+    spans = spans_from_chrome(events)
+    names = {s["name"] for s in spans}
+    assert "serve.run" in names and "serve.admit" in names
 
 
 def test_tracer_dump_atomic(tmp_path):
