@@ -391,6 +391,16 @@ def main(argv=None) -> int:
     p_serve.add_argument("--no-score", action="store_true",
                          help="replay-plane only (skip per-tenant window "
                               "scoring) — isolates the serving overhead")
+    p_serve.add_argument("--chaos", default=None,
+                         help="scripted serve-plane fault injection, "
+                              "e.g. 'crash@5:shard=1;stall@8:ms=20' "
+                              "(anomod.serve.chaos; default: "
+                              "ANOMOD_SERVE_CHAOS, empty = off)")
+    p_serve.add_argument("--ckpt-every", type=int, default=None,
+                         help="shard-checkpoint cadence in ticks for "
+                              "supervised no-score-gap recovery "
+                              "(default: ANOMOD_SERVE_CKPT_EVERY; "
+                              "0 disables supervision)")
     p_serve.add_argument("--devices", type=int, default=0,
                          help="serve over an N-device mesh plane "
                               "(ShardedStreamReplay per tenant; use "
@@ -835,6 +845,28 @@ def main(argv=None) -> int:
         if args.rca and args.no_score:
             parser.error("--rca consumes the detectors' alert stream; "
                          "it cannot combine with --no-score")
+        if args.ckpt_every is not None and args.ckpt_every < 0:
+            parser.error("--ckpt-every must be >= 0 (0 = supervision "
+                         "off)")
+        if args.devices and args.ckpt_every:
+            parser.error("shard supervision cannot checkpoint the mesh "
+                         "plane's sharded state; --devices runs with "
+                         "--ckpt-every 0")
+        if args.chaos:
+            from anomod.config import get_config, validate_chaos_script
+            try:
+                faults = validate_chaos_script(args.chaos)
+            except ValueError as e:
+                parser.error(f"--chaos: {e}")
+            n_sh = (args.shards if args.shards is not None
+                    else get_config().serve_shards)
+            bad = sorted({f["shard"] for f in faults
+                          if f["shard"] >= n_sh})
+            if bad:
+                parser.error(
+                    f"--chaos targets shard(s) {bad} but the run has "
+                    f"{n_sh} shard(s) (ids 0..{n_sh - 1}) — the "
+                    "fault(s) could never fire")
         _probe_backend(args)
         from anomod.serve.batcher import validate_buckets
         from anomod.serve.engine import run_power_law
@@ -877,7 +909,8 @@ def main(argv=None) -> int:
             lane_buckets=lane_buckets, shards=args.shards,
             pipeline=args.pipeline,
             native=False if args.no_native else None,
-            state=args.state,
+            state=args.state, chaos=args.chaos,
+            ckpt_every=args.ckpt_every,
             # --no-score forces RCA off even when ANOMOD_SERVE_RCA=1
             # (the explicit CLI ask wins over the env default; the
             # --rca + --no-score combination already parser.error'd)

@@ -439,6 +439,195 @@ def _flight_dump_dir_env() -> Optional[Path]:
     return Path(raw).expanduser()
 
 
+#: serve-chaos fault taxonomy (anomod.serve.chaos — the framework analog
+#: of the paper's injected-fault campaigns, aimed at the serve plane
+#: itself): ``crash`` kills the shard WORKER THREAD mid-tick, ``except``
+#: raises a plain exception at a score-path phase, ``stall`` sleeps
+#: (slow-shard), ``poolput`` fails the state-pool fold.  Phases are the
+#: score path's five injection points.
+CHAOS_KINDS = ("crash", "except", "stall", "poolput")
+CHAOS_PHASES = ("stage", "dispatch", "fold", "score", "commit")
+_CHAOS_DEFAULT_PHASE = {"crash": "dispatch", "except": "dispatch",
+                        "stall": "stage", "poolput": "fold"}
+
+
+def validate_chaos_script(script: str) -> list:
+    """Parse/validate an ``ANOMOD_SERVE_CHAOS`` fault script.
+
+    Grammar: semicolon-separated ``KIND@TICK[:key=value]*`` items, e.g.
+    ``crash@5:shard=1;stall@8:ms=20;except@12:phase=score:repeat=2``.
+    Keys: ``shard`` (default 0), ``phase`` (one of
+    :data:`CHAOS_PHASES`; per-kind default), ``ms`` (stall wall
+    milliseconds, default 10), ``repeat`` (how many ATTEMPTS of that
+    tick's slice the fault fires on — 1 by default so a recovery retry
+    succeeds; ``-1`` = every attempt forever, the quarantine probe).
+    Returns the parsed fault dicts; raises ``ValueError`` with the
+    offending item on any malformed script — the same fail-loud contract
+    as every other serve knob.  Lives HERE (pure string parsing) so
+    Config() never pays the serve import chain.
+    """
+    faults = []
+    for item in (p.strip() for p in str(script).split(";") if p.strip()):
+        head, _, tail = item.partition(":")
+        kind, at, tick = head.partition("@")
+        kind = kind.strip().lower()
+        if kind not in CHAOS_KINDS or not at:
+            raise ValueError(
+                f"chaos item {item!r}: expected KIND@TICK with KIND in "
+                f"{'/'.join(CHAOS_KINDS)}")
+        try:
+            tick_i = int(tick)
+        except ValueError:
+            raise ValueError(f"chaos item {item!r}: tick must be an "
+                             f"integer, got {tick!r}")
+        if tick_i < 0:
+            raise ValueError(f"chaos item {item!r}: tick must be >= 0")
+        fault = {"kind": kind, "tick": tick_i, "shard": 0,
+                 "phase": _CHAOS_DEFAULT_PHASE[kind], "ms": 10.0,
+                 "repeat": 1}
+        for kv in (p.strip() for p in tail.split(":") if p.strip()):
+            key, eq, val = kv.partition("=")
+            key = key.strip().lower()
+            if not eq or key not in ("shard", "phase", "ms", "repeat"):
+                raise ValueError(
+                    f"chaos item {item!r}: unknown key {kv!r} (want "
+                    "shard=/phase=/ms=/repeat=)")
+            try:
+                if key == "phase":
+                    val = val.strip().lower()
+                    if val not in CHAOS_PHASES:
+                        raise ValueError
+                    fault["phase"] = val
+                elif key == "ms":
+                    fault["ms"] = float(val)
+                    # capped like the backoff knob: a stall is a fault
+                    # INJECTION, not a way to park the scoring thread
+                    # for minutes inside the measured wall
+                    if not 0 <= fault["ms"] <= 10_000:
+                        raise ValueError
+                else:
+                    fault[key] = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"chaos item {item!r}: bad value for {key!r}: {val!r}")
+        if fault["shard"] < 0:
+            raise ValueError(f"chaos item {item!r}: shard must be >= 0")
+        if fault["repeat"] < -1 or fault["repeat"] == 0:
+            raise ValueError(f"chaos item {item!r}: repeat must be a "
+                             "positive count or -1 (forever)")
+        faults.append(fault)
+    return faults
+
+
+def _serve_chaos_env() -> str:
+    """ANOMOD_SERVE_CHAOS: scripted fault injection aimed at the serve
+    plane ITSELF (anomod.serve.chaos) — the framework analog of the
+    paper's chaos campaigns, behind the supervised engine's
+    checkpoint/restore recovery (anomod.serve.supervise).
+
+    Empty (the default) = off.  Otherwise a semicolon-separated fault
+    script (``crash@5:shard=1;stall@8:ms=20`` — see
+    :func:`validate_chaos_script` for the grammar), validated here so a
+    typo fails loudly at config construction instead of silently
+    injecting nothing.
+    """
+    raw = _env("ANOMOD_SERVE_CHAOS", "").strip()
+    if raw:
+        validate_chaos_script(raw)
+    return raw
+
+
+def _serve_ckpt_every_env() -> int:
+    """ANOMOD_SERVE_CKPT_EVERY: shard-checkpoint cadence in ticks
+    (anomod.serve.supervise) — the flight-digest cadence idiom, at
+    twice the digest period (the snapshot is ~10x a digest's cost:
+    state copies + detector bookkeeping, not one crc sweep).
+
+    Every Nth tick each shard snapshots its tenants' detector/replay
+    state through the ``get_state``/pool-gather seam (plus the runner's
+    dispatch book), and the coordinator retains the ticks' served-batch
+    slices since the last snapshot — together that makes any mid-tick
+    shard failure recoverable with NO score gap: restore the checkpoint,
+    re-execute the retained slices deterministically, and the recovered
+    run's states/alerts/SLO/shed are byte-identical to a fault-free run
+    of the same seed.  ``0`` disables supervision entirely (a shard
+    fault fails the tick, the pre-supervision behavior).  Snapshots are
+    pure reads, so the cadence only trades recovery-log memory against
+    snapshot wall — decisions are byte-identical at every value.
+    """
+    raw = _env("ANOMOD_SERVE_CKPT_EVERY", "32")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_CKPT_EVERY must be a non-negative integer "
+            f"(0 = supervision off), got {raw!r}")
+    if not 0 <= n <= 1_000_000:
+        raise ValueError(
+            f"ANOMOD_SERVE_CKPT_EVERY must be in [0, 1000000], got {n}")
+    return n
+
+
+def _serve_retries_env() -> int:
+    """ANOMOD_SERVE_RETRIES: consecutive recovery failures of ONE tick
+    slice before that slice is QUARANTINED (anomod.serve.supervise).
+
+    A batch that kills its shard K consecutive times is dropped from the
+    recovery log (counted + journaled, never retried forever) and the
+    shard recovers without it — bounded unavailability over livelock.
+    """
+    raw = _env("ANOMOD_SERVE_RETRIES", "3")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_RETRIES must be a positive integer, got {raw!r}")
+    if not 1 <= n <= 64:
+        raise ValueError(
+            f"ANOMOD_SERVE_RETRIES must be in [1, 64], got {n}")
+    return n
+
+
+def _serve_retry_backoff_s_env() -> float:
+    """ANOMOD_SERVE_RETRY_BACKOFF_S: wall-clock backoff before each
+    recovery attempt, doubling per consecutive attempt (capped 5 s).
+
+    ``0`` (the default) retries immediately — recovery stays
+    deterministic either way (backoff is wall time, never virtual
+    time); a positive value spaces respawn storms on a genuinely sick
+    host the way the paper's recovery controllers do.
+    """
+    raw = _env("ANOMOD_SERVE_RETRY_BACKOFF_S", "0")
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_RETRY_BACKOFF_S must be a number, got {raw!r}")
+    if not 0 <= v <= 60:
+        raise ValueError(
+            f"ANOMOD_SERVE_RETRY_BACKOFF_S must be in [0, 60], got {v}")
+    return v
+
+
+def _serve_max_respawns_env() -> int:
+    """ANOMOD_SERVE_MAX_RESPAWNS: per-shard worker respawns per run
+    before the shard is declared DEAD and its tenants migrate to the
+    surviving shards through the ``set_state`` seam
+    (anomod.serve.supervise — the elastic-tenancy migration step).
+    """
+    raw = _env("ANOMOD_SERVE_MAX_RESPAWNS", "8")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_MAX_RESPAWNS must be a non-negative integer, "
+            f"got {raw!r}")
+    if not 0 <= n <= 4096:
+        raise ValueError(
+            f"ANOMOD_SERVE_MAX_RESPAWNS must be in [0, 4096], got {n}")
+    return n
+
+
 def _native_env() -> str:
     """ANOMOD_NATIVE: the C++ native runtime switch (anomod.io.native) —
     ingest scanning AND the serving plane's GIL-free lane staging.
@@ -599,6 +788,26 @@ class Config:
     # extractor (also bounds the per-tenant RCA span buffer).
     serve_rca_windows: int = dataclasses.field(
         default_factory=_serve_rca_windows_env)
+    # ANOMOD_SERVE_CHAOS — scripted serve-plane fault injection
+    # (anomod.serve.chaos; "" = off, else a validated fault script).
+    serve_chaos: str = dataclasses.field(default_factory=_serve_chaos_env)
+    # ANOMOD_SERVE_CKPT_EVERY — shard-checkpoint cadence in ticks
+    # (anomod.serve.supervise; 0 = supervision off, faults fail the
+    # tick as before).
+    serve_ckpt_every: int = dataclasses.field(
+        default_factory=_serve_ckpt_every_env)
+    # ANOMOD_SERVE_RETRIES — consecutive failures of one tick slice
+    # before it is quarantined (anomod.serve.supervise).
+    serve_retries: int = dataclasses.field(
+        default_factory=_serve_retries_env)
+    # ANOMOD_SERVE_RETRY_BACKOFF_S — wall backoff between recovery
+    # attempts (0 = immediate; doubling, capped 5 s).
+    serve_retry_backoff_s: float = dataclasses.field(
+        default_factory=_serve_retry_backoff_s_env)
+    # ANOMOD_SERVE_MAX_RESPAWNS — per-shard worker respawn budget per
+    # run; past it the shard's tenants migrate to survivors.
+    serve_max_respawns: int = dataclasses.field(
+        default_factory=_serve_max_respawns_env)
     # ANOMOD_FLIGHT — serve-plane black-box flight recorder switch
     # (anomod.obs.flight; off = no tick journal, no audit surface).
     flight: bool = dataclasses.field(default_factory=_flight_env)

@@ -17,21 +17,29 @@ identical to the 1-shard engine on the same seed.  Online RCA
 (ANOMOD_SERVE_RCA): a tenant's detector firing queues incremental GNN
 culprit inference over that tenant's live service graph in a fixed
 AOT-compiled (nodes, neighbors) bucket grid (rca), verdicts deterministic
-per seed and identical at every shard count.
+per seed and identical at every shard count.  Fault tolerance
+(ANOMOD_SERVE_CKPT_EVERY, on by default): supervised shard workers with
+cadenced checkpoint/restore through the get_state seam and deterministic
+re-execution (supervise) — a mid-tick shard crash recovers with NO score
+gap, byte-identical to fault-free — proven against scripted chaos aimed
+at the serve plane itself (chaos, ANOMOD_SERVE_CHAOS).
 """
 
 from anomod.serve.batcher import (BucketedStreamReplay, BucketRunner,
                                   split_plan)
 from anomod.serve.engine import ServeEngine, ServeReport, VirtualClock
 from anomod.serve.queues import AdmissionController, QueuedBatch, TenantSpec
+from anomod.serve.chaos import ChaosFault, ChaosWorkerCrash, ServeChaos
 from anomod.serve.rca import OnlineRCA, RCAVerdict, RcaRunner
 from anomod.serve.shard import ShardWorker, plan_shards, rendezvous_shard
+from anomod.serve.supervise import ShardSupervisor
 from anomod.serve.traffic import PowerLawTraffic, ScriptedTraffic
 
 __all__ = [
     "AdmissionController", "BucketRunner", "BucketedStreamReplay",
-    "OnlineRCA", "PowerLawTraffic", "QueuedBatch", "RCAVerdict",
-    "RcaRunner", "ScriptedTraffic", "ServeEngine", "ServeReport",
+    "ChaosFault", "ChaosWorkerCrash", "OnlineRCA", "PowerLawTraffic",
+    "QueuedBatch", "RCAVerdict", "RcaRunner", "ScriptedTraffic",
+    "ServeChaos", "ServeEngine", "ServeReport", "ShardSupervisor",
     "ShardWorker", "TenantSpec", "VirtualClock", "plan_shards",
     "rendezvous_shard", "split_plan",
 ]

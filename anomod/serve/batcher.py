@@ -728,6 +728,33 @@ class BucketRunner:
                 "native_staged": self.native_staged,
                 "by_width": dict(self.dispatches_by_width)}
 
+    def book_snapshot(self) -> dict:
+        """The runner's cumulative dispatch-COUNT book — what the shard
+        supervisor (anomod.serve.supervise) checkpoints and restores
+        around a recovery re-execution, so re-executed slices cannot
+        double-count the flight journal's canonical dispatch plane
+        (``chunks``/``by_width`` deltas) or the ServeReport counters.
+        Walls and compile bookkeeping deliberately stay OUT: recovery
+        wall is real work, reported in its own report leg, and compiles
+        happened regardless of what the counters say."""
+        return {"n_dispatches": self.n_dispatches,
+                "dispatches_by_width": dict(self.dispatches_by_width),
+                "fused_dispatches": self.fused_dispatches,
+                "native_staged": self.native_staged,
+                "staged_lanes": self.staged_lanes,
+                "live_lanes": self.live_lanes,
+                "lanes_by_bucket": dict(self.lanes_by_bucket)}
+
+    def book_restore(self, book: dict) -> None:
+        """Install a :meth:`book_snapshot` (checkpoint restore)."""
+        self.n_dispatches = book["n_dispatches"]
+        self.dispatches_by_width = dict(book["dispatches_by_width"])
+        self.fused_dispatches = book["fused_dispatches"]
+        self.native_staged = book["native_staged"]
+        self.staged_lanes = book["staged_lanes"]
+        self.live_lanes = book["live_lanes"]
+        self.lanes_by_bucket = dict(book["lanes_by_bucket"])
+
     @property
     def lane_pad_waste(self) -> float:
         """Dead-lane fraction of every fused dispatch so far (the lane

@@ -1,0 +1,117 @@
+"""ServeChaos: seeded, scripted fault injection aimed at the serving
+plane ITSELF.
+
+The paper's whole methodology is injecting faults into a running system
+and checking the collection/detection stack survives them (SURVEY §5 —
+ChaosBlade/Chaos Mesh campaigns, modeled for the SUT in
+``anomod.chaos``).  This module turns the same discipline on the
+FRAMEWORK: a validated fault script (``ANOMOD_SERVE_CHAOS``, off by
+default) injects the serve plane's own fault taxonomy — shard-worker
+crashes mid-tick, staging/dispatch exceptions, slow-shard stalls,
+state-pool fold failures — at deterministic (tick, shard, phase)
+points in the score path, so the supervised engine's
+checkpoint/restore recovery (``anomod.serve.supervise``) is testable,
+benchable and CI-gated instead of trusted.
+
+Determinism contract: faults key on the ORIGIN tick of the slice being
+scored (the tick its batches were drained on), not the wall clock — so
+a recovery RE-execution of an older slice never re-trips a fault
+scripted for a newer tick, and a fault's ``repeat`` budget counts
+attempts at its own tick's slice.  With ``repeat=1`` (the default) the
+first recovery retry runs clean; ``repeat=-1`` fails every attempt —
+the quarantine/migration probe.
+
+The script grammar and validation live in :func:`anomod.config.
+validate_chaos_script` (the knob must validate without importing the
+serve chain); this module owns the runtime behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List
+
+from anomod import obs
+from anomod.config import (CHAOS_KINDS, CHAOS_PHASES,
+                           validate_chaos_script)
+
+__all__ = ["CHAOS_KINDS", "CHAOS_PHASES", "ChaosFault",
+           "ChaosWorkerCrash", "ServeChaos"]
+
+
+class ChaosFault(RuntimeError):
+    """An injected serve-plane fault (a plain score-path exception: the
+    shard worker survives, the tick fails at the barrier)."""
+    #: duck-typed by ShardWorker._loop: a True value makes the worker
+    #: THREAD exit after reporting the error — the crash taxonomy —
+    #: without shard.py importing this module
+    kills_worker = False
+
+
+class ChaosWorkerCrash(ChaosFault):
+    """An injected shard-worker crash: the error propagates at the
+    barrier AND the worker thread dies (respawn is the supervisor's
+    job)."""
+    kills_worker = True
+
+
+class _Fault:
+    __slots__ = ("kind", "tick", "shard", "phase", "ms", "repeat",
+                 "fired")
+
+    def __init__(self, spec: dict):
+        self.kind = spec["kind"]
+        self.tick = spec["tick"]
+        self.shard = spec["shard"]
+        self.phase = spec["phase"]
+        self.ms = spec["ms"]
+        self.repeat = spec["repeat"]
+        self.fired = 0
+
+
+class ServeChaos:
+    """The scripted injector the engine consults at every score-path
+    phase boundary (``hit``).  Thread-safe: shard workers hit
+    concurrently; the fired-count bookkeeping is locked so a fault's
+    ``repeat`` budget is exact under any interleaving."""
+
+    def __init__(self, script: str):
+        self.script = str(script).strip()
+        self.faults: List[_Fault] = [
+            _Fault(spec) for spec in validate_chaos_script(self.script)]
+        self._lock = threading.Lock()
+        self.n_injected = 0
+        self.n_stalls = 0
+        self._obs_injected = obs.counter(
+            "anomod_serve_chaos_injected_total")
+        self._obs_stalls = obs.counter("anomod_serve_chaos_stalls_total")
+
+    def hit(self, phase: str, tick: int, shard: int) -> None:
+        """One score-path phase boundary on one shard's slice of one
+        ORIGIN tick.  Raises (or stalls) per the script; a no-op when
+        nothing matches — the engine calls this unconditionally on the
+        hot path only when a script is configured."""
+        for f in self.faults:
+            if f.tick != tick or f.shard != shard or f.phase != phase:
+                continue
+            with self._lock:
+                if 0 <= f.repeat <= f.fired:
+                    continue
+                f.fired += 1
+                self.n_injected += 1
+                self._obs_injected.inc()
+                if f.kind == "stall":
+                    self.n_stalls += 1
+                    self._obs_stalls.inc()
+            where = (f"@tick {tick} shard {shard} phase {phase} "
+                     f"(attempt {f.fired})")
+            if f.kind == "stall":
+                time.sleep(f.ms / 1000.0)
+            elif f.kind == "crash":
+                raise ChaosWorkerCrash(f"chaos: shard-worker crash "
+                                       f"{where}")
+            elif f.kind == "poolput":
+                raise ChaosFault(f"chaos: state-pool put failure {where}")
+            else:
+                raise ChaosFault(f"chaos: injected exception {where}")

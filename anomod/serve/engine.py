@@ -151,7 +151,10 @@ SHARD_VARIANT_REPORT_FIELDS = (
     # tick-wall decomposition: wall measurements, and the native-staged
     # dispatch count follows the fused-dispatch grouping topology
     "stage_wall_s", "dispatch_wall_s", "fold_wall_s", "score_wall_s",
-    "native_staged_dispatches")
+    "native_staged_dispatches",
+    # supervision wall legs: snapshot and recovery time are wall
+    # measurements (the decisions they protect are pinned identical)
+    "ckpt_wall_s", "recovery_wall_s")
 
 
 def _plane_col_gather(work):
@@ -258,6 +261,17 @@ class ServeReport:
     rca_latency: Dict[str, Optional[float]]      # wall p50/p99 per RCA run
     rca_alert_to_culprit_s: Dict[str, Optional[float]]  # virtual queue delay
     rca_wall_s: float                            # total RCA wall
+    supervised: bool                             # checkpoint/recovery on?
+    ckpt_every: int                              # snapshot cadence (ticks)
+    n_checkpoints: int                           # snapshots taken
+    ckpt_wall_s: float                           # snapshot wall
+    n_shard_crashes: int                         # tick-barrier failures
+    n_respawns: int                              # worker threads respawned
+    n_restored_ticks: int                        # slices re-executed
+    n_quarantined: int                           # batches dropped after K
+    #                                              consecutive kill loops
+    n_migrated_tenants: int                      # moved off dead shards
+    recovery_wall_s: float                       # restore + re-exec wall
     flight_enabled: bool                         # black-box recorder on?
     flight_recorded_ticks: int                   # journal records written
     flight_dropped_ticks: int                    # ring evictions (0 = no
@@ -315,7 +329,12 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   state: Optional[str] = None,
                   flight: Optional[bool] = None,
                   flight_digest_every: Optional[int] = None,
-                  flight_max_ticks: Optional[int] = None
+                  flight_max_ticks: Optional[int] = None,
+                  chaos: Optional[str] = None,
+                  ckpt_every: Optional[int] = None,
+                  retries: Optional[int] = None,
+                  retry_backoff_s: Optional[float] = None,
+                  max_respawns: Optional[int] = None
                   ) -> Tuple["ServeEngine", ServeReport]:
     """The canonical seeded serve run shared by ``anomod serve`` and
     ``bench.py --mode serve``: a power-law tenant fleet offering
@@ -346,7 +365,11 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          pipeline=pipeline, rca=rca, native=native,
                          state=state, flight=flight,
                          flight_digest_every=flight_digest_every,
-                         flight_max_ticks=flight_max_ticks)
+                         flight_max_ticks=flight_max_ticks,
+                         chaos=chaos, ckpt_every=ckpt_every,
+                         retries=retries,
+                         retry_backoff_s=retry_backoff_s,
+                         max_respawns=max_respawns)
     if engine.flight_recorder is not None:
         # the header's replay contract: `anomod audit replay` re-executes
         # this exact invocation from the journal alone.  Every
@@ -373,7 +396,17 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
             rca=engine.rca, native=native,
             state=engine.serve_state, flight=True,
             flight_digest_every=engine.flight_recorder.digest_every,
-            flight_max_ticks=engine.flight_recorder.max_ticks)
+            flight_max_ticks=engine.flight_recorder.max_ticks,
+            # the fault-tolerance knobs, RESOLVED: an audit replay of a
+            # chaos run re-injects the same script and re-recovers —
+            # its canonical journal must equal the original's (the
+            # no-score-gap contract makes both equal the fault-free
+            # journal)
+            chaos=(engine._chaos.script
+                   if engine._chaos is not None else ""),
+            ckpt_every=engine.ckpt_every, retries=engine.retries,
+            retry_backoff_s=engine.retry_backoff_s,
+            max_respawns=engine.max_respawns)
     report = engine.run(traffic, duration_s=duration_s)
     return engine, report
 
@@ -404,7 +437,12 @@ class ServeEngine:
                  state: Optional[str] = None,
                  flight: Optional[bool] = None,
                  flight_digest_every: Optional[int] = None,
-                 flight_max_ticks: Optional[int] = None):
+                 flight_max_ticks: Optional[int] = None,
+                 chaos: Optional[object] = None,
+                 ckpt_every: Optional[int] = None,
+                 retries: Optional[int] = None,
+                 retry_backoff_s: Optional[float] = None,
+                 max_respawns: Optional[int] = None):
         from anomod.config import get_config
         from anomod.utils.platform import enable_jit_cache
         if capacity_spans_per_s <= 0:
@@ -647,6 +685,84 @@ class ServeEngine:
             self._flight_score_crc = 0
             self._flight_rca_seen = 0
             self._flight_rca_crc = 0
+        #: scripted serve-plane fault injection (ANOMOD_SERVE_CHAOS,
+        #: anomod.serve.chaos) — off by default; a script string or a
+        #: prebuilt ServeChaos aims the paper's fault taxonomy at the
+        #: framework itself (worker crashes, score-path exceptions,
+        #: stalls, pool-put failures) at deterministic (tick, shard,
+        #: phase) points.
+        _chaos = app_cfg.serve_chaos if chaos is None else chaos
+        if isinstance(_chaos, str):
+            if _chaos.strip():
+                from anomod.serve.chaos import ServeChaos
+                _chaos = ServeChaos(_chaos)
+            else:
+                _chaos = None
+        self._chaos = _chaos
+        if self._chaos is not None:
+            # a fault aimed at a shard this engine doesn't have can
+            # never inject — WARN loud (the never-a-silent-no-op
+            # contract), but do not refuse: `anomod audit replay
+            # --shards 1` deliberately re-executes a 2-shard chaos
+            # journal at 1 shard, where the extra faults are inert and
+            # the canonical journal still matches (the no-score-gap
+            # contract makes every leg equal fault-free).  The CLI's
+            # `anomod serve --chaos` validates the range HARD — a typo
+            # there is a user error, not a forensic override.
+            bad = sorted({f.shard for f in self._chaos.faults
+                          if f.shard >= self.shards})
+            if bad:
+                import warnings
+                warnings.warn(
+                    f"chaos script targets shard(s) {bad} but the "
+                    f"engine has {self.shards} shard(s) (ids 0.."
+                    f"{self.shards - 1}); those faults will never "
+                    "fire", RuntimeWarning, stacklevel=2)
+        #: shard supervision (ANOMOD_SERVE_CKPT_EVERY > 0, the default;
+        #: anomod.serve.supervise): cadenced tenant-state checkpoints
+        #: through the get_state/pool-gather seam + a served-batch
+        #: recovery log make any mid-tick shard failure recoverable
+        #: with NO score gap — restore, re-execute, byte-identical to
+        #: fault-free.  Snapshots are pure reads: a chaos-off
+        #: supervised run's decisions are byte-identical to the
+        #: unsupervised engine (pinned).  The mesh and multimodal
+        #: planes keep state outside the snapshot seams, so supervision
+        #: auto-disables there (and an explicit request is refused).
+        self.ckpt_every = int(app_cfg.serve_ckpt_every
+                              if ckpt_every is None else ckpt_every)
+        if self.ckpt_every < 0:
+            raise ValueError("ckpt_every must be >= 0 (0 = supervision "
+                             "off)")
+        if (mesh is not None or self.multimodal) and self.ckpt_every:
+            if ckpt_every is not None:
+                raise ValueError(
+                    "shard supervision cannot checkpoint the "
+                    + ("mesh plane's sharded" if mesh is not None
+                       else "multimodal sidecar") +
+                    " state; run with ckpt_every=0 "
+                    "(ANOMOD_SERVE_CKPT_EVERY=0)")
+            self.ckpt_every = 0
+        self.retries = int(app_cfg.serve_retries if retries is None
+                           else retries)
+        if self.retries < 1:
+            raise ValueError("retries must be >= 1")
+        self.retry_backoff_s = float(app_cfg.serve_retry_backoff_s
+                                     if retry_backoff_s is None
+                                     else retry_backoff_s)
+        if self.retry_backoff_s < 0:
+            raise ValueError("retry_backoff_s must be >= 0")
+        self.max_respawns = int(app_cfg.serve_max_respawns
+                                if max_respawns is None else max_respawns)
+        if self.max_respawns < 0:
+            raise ValueError("max_respawns must be >= 0")
+        self._supervisor = None
+        if self.ckpt_every:
+            from anomod.serve.supervise import ShardSupervisor
+            self._supervisor = ShardSupervisor(
+                self, ckpt_every=self.ckpt_every, retries=self.retries,
+                backoff_s=self.retry_backoff_s,
+                max_respawns=self.max_respawns)
+        self._last_failures = None
 
     # -- per-tenant plane construction ------------------------------------
 
@@ -779,19 +895,43 @@ class ServeEngine:
         if -1e-9 < self._credit < 1e-9:
             self._credit = 0.0
         if served:
-            if self.shards > 1:
-                with self._span("serve.score_sharded"):
-                    self._score_sharded(served)
-            elif self._fused:
-                with self._span("serve.score_fused"):
-                    self._score_fused(served)
-            else:
-                for qb in served:
-                    with self._span("serve.score"):
-                        if self.score:
-                            self._detector_for(qb.tenant_id).push(qb.spans)
-                        else:
-                            self._replay_for(qb.tenant_id).push(qb.spans)
+            sup = self._supervisor
+            if sup is not None:
+                # the recovery log must hold this tick's slices BEFORE
+                # scoring: a mid-tick shard failure re-executes them
+                sup.begin_tick(served)
+            self._last_failures = None
+            try:
+                if self.shards > 1:
+                    with self._span("serve.score_sharded"):
+                        self._score_sharded(served)
+                elif self._fused:
+                    with self._span("serve.score_fused"):
+                        self._score_fused(served)
+                else:
+                    # ONE unfused definition (chaos injection ordering
+                    # included): _score_shard's unfused branch — the
+                    # same unification _score_fused got, so original
+                    # execution and recovery re-execution can never
+                    # inject or score differently
+                    self._score_shard(0, served)
+            except BaseException as e:
+                failures = self._last_failures or [(0, e)]
+                self._last_failures = None
+                if sup is None or not isinstance(e, Exception):
+                    # KeyboardInterrupt / SystemExit are the OPERATOR
+                    # stopping the run, not a shard fault — recovery
+                    # must never absorb them (re-executing ticks after
+                    # a Ctrl-C would make the process uninterruptible)
+                    raise
+                # supervised recovery: respawn + checkpoint restore +
+                # deterministic re-execution — the tick completes as if
+                # the fault never happened (or degrades loudly:
+                # quarantine / migration / propagation)
+                with self._span("serve.recover"):
+                    sup.recover(failures)
+        if self._supervisor is not None:
+            self._supervisor.end_tick()
         # per-batch SLO accounting is DEFERRED past scoring in both paths
         # (the latency samples depend only on admission times and the
         # tick clock, so fused and unfused runs record identical values
@@ -860,12 +1000,15 @@ class ServeEngine:
         3. COMMIT (host): per tenant, the detector's post-replay half
            (``note_pushed``) scores newly closed windows exactly as a
            sequential push of the coalesced batch would.
-        """
-        pending = self._stage_pending(served)
-        self._dispatch_rounds(pending, self.runner)
-        self._commit_pending(pending, self.runner)
 
-    def _dispatch_rounds(self, pending: list, runner) -> None:
+        One definition with the sharded path: this IS ``_score_shard``
+        on shard 0 (same phases, same chaos injection points), so the
+        inline and sharded engines can never drift apart.
+        """
+        self._score_shard(0, served)
+
+    def _dispatch_rounds(self, pending: list, runner,
+                         chaos_hook=None) -> None:
         """Phase 2 of fused scoring (STACK + DISPATCH), shared by the
         inline and sharded paths: per chunk round, same-width staged
         chunks lane-stack into fused dispatches through the runner's
@@ -892,6 +1035,12 @@ class ServeEngine:
                         width, [(pending[i][1], pending[i][4][rnd][1])
                                 for i in groups[width]])
                 rnd += 1
+            if chaos_hook is not None:
+                # the DISPATCH injection point: submits issued, up to
+                # pipeline-1 dispatches in flight — a fault here
+                # exercises the abort path below with live in-flight
+                # work, the nastiest partial-tick state
+                chaos_hook("dispatch")
             runner.drain_lanes()         # tick-end barrier: folds land
         except BaseException:
             # a failed tick must not park its issued dispatches in the
@@ -926,7 +1075,8 @@ class ServeEngine:
             pending.append((det, replay, batch.n_spans, w_ret, plan))
         return pending
 
-    def _commit_pending(self, pending: list, runner) -> None:
+    def _commit_pending(self, pending: list, runner,
+                        chaos_hook=None) -> None:
         """Phase 3 of fused scoring (COMMIT), shared by the inline and
         sharded paths: per tenant, the detector's post-replay half
         scores newly closed windows exactly as a sequential push would —
@@ -953,6 +1103,10 @@ class ServeEngine:
                     work.append((det, rng[0], rng[1]))
             else:
                 det.note_pushed(n_in, w_ret)
+        if chaos_hook is not None:
+            # the SCORE injection point: replay folds committed and
+            # window bookkeeping advanced, batched scoring not yet run
+            chaos_hook("score")
         if work:
             score_closed_windows_batched(work, _plane_col_gather(work))
         dt = time.perf_counter() - t0
@@ -1087,6 +1241,16 @@ class ServeEngine:
                          "native_staged": native_staged,
                          "shard_legs": fold_leg_records(shard_legs)},
         }
+        # recovery events ride the journal's VARIANT tier (the
+        # "recovery" key is in FLIGHT_VARIANT_KEYS): what crashed,
+        # respawned, quarantined or migrated this tick is forensic
+        # topology — the canonical planes above must stay equal to a
+        # fault-free run's (the no-score-gap pin), so they never carry
+        # recovery marks.  The key is ALWAYS present (usually empty) so
+        # every record carries every tier — the self-describing-shape
+        # contract the variant-key tests pin.
+        rec["recovery"] = (self._supervisor.drain_events()
+                           if self._supervisor is not None else [])
         if final:
             rec["final"] = True
         fr.record(rec)
@@ -1110,18 +1274,34 @@ class ServeEngine:
         if self._workers is None or not all(w.alive
                                             for w in self._workers):
             from anomod.serve.shard import ShardWorker
+            errs = []
             if self._workers is not None:
                 for w in self._workers:   # no leaked threads on respawn
-                    w.close()
+                    try:
+                        w.close()
+                    except BaseException as e:  # noqa: BLE001
+                        errs.append(e)
             self._workers = [ShardWorker(s) for s in range(self.shards)]
+            if errs:
+                # close() re-raises a deferred (never-joined) task
+                # error; every sibling still closed before it surfaces
+                raise errs[0]
 
     def close(self) -> None:
         """Stop the shard worker threads (idempotent; the engine remains
-        usable — the next sharded tick respawns them)."""
+        usable — the next sharded tick respawns them).  Every worker
+        closes before a deferred task error propagates (the join_all
+        discipline)."""
         if self._workers is not None:
+            errs = []
             for w in self._workers:
-                w.close()
+                try:
+                    w.close()
+                except BaseException as e:      # noqa: BLE001
+                    errs.append(e)
             self._workers = None
+            if errs:
+                raise errs[0]
 
     def _score_sharded(self, served: List[QueuedBatch]) -> None:
         """Fan one tick's drained batches out to the shard workers by
@@ -1142,43 +1322,94 @@ class ServeEngine:
         for qb in served:
             parts[self.shard_of[qb.tenant_id]].append(qb)
         self._ensure_workers()
+        failures = self._submit_parts(parts)
+        if failures:
+            # attribution for the supervisor (which shards failed);
+            # unsupervised engines keep the historical contract — the
+            # barrier completed, registries folded, first error raises
+            self._last_failures = failures
+            raise failures[0][1]
+
+    def _submit_parts(self, parts: List[List[QueuedBatch]],
+                      origin_tick: Optional[int] = None) -> list:
+        """Fan per-shard slices out to the workers and join at the
+        barrier.  The barrier COMPLETES before anything propagates
+        (raising at the first failed join would desynchronize sibling
+        done-events — the join_all contract) and the shard registries
+        fold either way (counters fold by delta, so folding what the
+        shards did record is correct whether or not the tick
+        succeeded).  Returns ``[(shard_id, exc), ...]`` in shard
+        order."""
         from functools import partial
         submitted = []
         for s, worker in enumerate(self._workers):
             if parts[s]:
-                worker.submit(partial(self._score_shard, s, parts[s]))
-                submitted.append(worker)
-        from anomod.serve.shard import join_all
-        try:
-            join_all(submitted)
-        finally:
-            # counters fold by delta, so folding what the shards did
-            # record is correct whether or not the tick succeeded
-            for s in range(self.shards):
-                self._proc_registry.fold_from(self._shard_regs[s],
-                                              self._fold_state[s],
-                                              shard=str(s))
+                worker.submit(partial(self._score_shard, s, parts[s],
+                                      origin_tick))
+                submitted.append((s, worker))
+        failures = []
+        for s, worker in submitted:
+            try:
+                worker.join()
+            except BaseException as e:    # noqa: BLE001 — re-raised
+                failures.append((s, e))
+        for s in range(self.shards):
+            self._proc_registry.fold_from(self._shard_regs[s],
+                                          self._fold_state[s],
+                                          shard=str(s))
+        return failures
 
-    def _score_shard(self, shard_id: int,
-                     served: List[QueuedBatch]) -> None:
-        """One shard's slice of the tick, on that shard's worker thread.
+    def _score_shard(self, shard_id: int, served: List[QueuedBatch],
+                     origin_tick: Optional[int] = None) -> None:
+        """One shard's slice of one tick's served batches — on that
+        shard's worker thread in the sharded engine, inline on the
+        1-shard fused engine, and during supervised recovery the
+        re-execution entry point (``origin_tick`` then names the tick
+        the slice was drained on, which is what the chaos injector keys
+        on — a re-execution of an older slice must not re-trip a fault
+        scripted for the current tick).
 
-        Fused: coalesce + plan (identical to the inline path), then
+        Fused: coalesce + plan (identical at every shard count), then
         pipelined lane-stacked dispatches through the shard's runner
         (``submit_lanes`` — readback and state folds defer behind the
         in-flight window), drained before window scoring.  Unfused: one
         detector/replay push per batch, in served order."""
         runner = self._runners[shard_id]
+        chaos = self._chaos
+        if chaos is not None:
+            tick = self.clock.ticks if origin_tick is None else origin_tick
+            hook = lambda phase: chaos.hit(phase, tick, shard_id)  # noqa: E731
+        else:
+            hook = None
+        if hook is not None:
+            hook("stage")
         if self._fused:
             pending = self._stage_pending(served)
-            self._dispatch_rounds(pending, runner)
-            self._commit_pending(pending, runner)
+            self._dispatch_rounds(pending, runner, chaos_hook=hook)
+            if hook is not None:
+                hook("fold")
+            self._commit_pending(pending, runner, chaos_hook=hook)
+            if hook is not None:
+                hook("commit")
         else:
+            # the unfused path has no phase structure, but every
+            # scripted fault must still FIRE somewhere (a silently
+            # never-injected fault reads as "the engine survived"):
+            # the remaining phases collapse onto the slice's two real
+            # boundaries — dispatch before the pushes, fold/score/
+            # commit after them (post-mutation, the harder case)
+            if hook is not None:
+                hook("dispatch")
             for qb in served:
-                if self.score:
-                    self._detector_for(qb.tenant_id).push(qb.spans)
-                else:
-                    self._replay_for(qb.tenant_id).push(qb.spans)
+                with self._span("serve.score"):
+                    if self.score:
+                        self._detector_for(qb.tenant_id).push(qb.spans)
+                    else:
+                        self._replay_for(qb.tenant_id).push(qb.spans)
+            if hook is not None:
+                hook("fold")
+                hook("score")
+                hook("commit")
 
     # -- the online alert→culprit pass (anomod.serve.rca) -----------------
 
@@ -1530,6 +1761,26 @@ class ServeEngine:
             rca_latency=rca_lat,
             rca_alert_to_culprit_s=rca_delay,
             rca_wall_s=round(self.rca_wall_s, 4),
+            supervised=self._supervisor is not None,
+            ckpt_every=self.ckpt_every,
+            n_checkpoints=(self._supervisor.n_checkpoints
+                           if self._supervisor is not None else 0),
+            ckpt_wall_s=round(self._supervisor.ckpt_wall_s
+                              if self._supervisor is not None else 0.0,
+                              4),
+            n_shard_crashes=(self._supervisor.n_crashes
+                             if self._supervisor is not None else 0),
+            n_respawns=(self._supervisor.n_respawns
+                        if self._supervisor is not None else 0),
+            n_restored_ticks=(self._supervisor.n_restored_ticks
+                              if self._supervisor is not None else 0),
+            n_quarantined=(self._supervisor.n_quarantined
+                           if self._supervisor is not None else 0),
+            n_migrated_tenants=(self._supervisor.n_migrated
+                                if self._supervisor is not None else 0),
+            recovery_wall_s=round(self._supervisor.recovery_wall_s
+                                  if self._supervisor is not None
+                                  else 0.0, 4),
             flight_enabled=self.flight,
             flight_recorded_ticks=(self.flight_recorder.n_recorded
                                    if self.flight_recorder is not None

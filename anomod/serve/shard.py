@@ -36,19 +36,26 @@ from __future__ import annotations
 import queue
 import threading
 import zlib
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from anomod.serve.queues import TenantSpec
 
 
-def rendezvous_shard(tenant_id: int, n_shards: int) -> int:
+def rendezvous_shard(tenant_id: int, n_shards: int,
+                     candidates: Optional[Sequence[int]] = None) -> int:
     """Highest-random-weight shard for one tenant (crc32 — stable across
-    processes and Python hash seeds)."""
-    best, best_score = 0, -1
-    for s in range(n_shards):
+    processes and Python hash seeds).  ``candidates`` restricts the
+    draw to a subset of shard ids (the dead-shard migration case: the
+    ONE key definition must serve initial placement and migration
+    alike, or the two could silently disagree)."""
+    pool = range(n_shards) if candidates is None else candidates
+    best, best_score = -1, -1
+    for s in pool:
         score = zlib.crc32(f"{tenant_id}/{s}".encode())
         if score > best_score:
             best, best_score = s, score
+    if best < 0:
+        raise ValueError("rendezvous needs at least one candidate shard")
     return best
 
 
@@ -206,6 +213,7 @@ class ShardWorker:
         self._q: "queue.Queue" = queue.Queue()
         self._done = threading.Event()
         self._exc: BaseException | None = None
+        self._dying = False
         self._thread = threading.Thread(
             target=self._loop, name=f"{name}-{shard_id}", daemon=True)
         self._thread.start()
@@ -215,12 +223,27 @@ class ShardWorker:
             fn = self._q.get()
             if fn is None:
                 return
+            die = False
             try:
                 fn()
             except BaseException as e:       # noqa: BLE001 — re-raised at join
                 self._exc = e
+                # an injected worker CRASH (anomod.serve.chaos, duck-
+                # typed so this module stays import-free) reports its
+                # error at the barrier like any failure, then the
+                # thread itself dies — respawning is the supervisor's
+                # job, exactly like the paper's force-delete-and-respawn.
+                # ``_dying`` flips BEFORE the done event: the joiner
+                # wakes strictly after ``alive`` reads False, so a
+                # respawn check can never race the thread's last
+                # instructions and submit to a queue nobody drains.
+                die = bool(getattr(e, "kills_worker", False))
+                if die:
+                    self._dying = True
             finally:
                 self._done.set()
+            if die:
+                return
 
     def submit(self, fn) -> None:
         """Queue one task; pair every submit with a :meth:`join`."""
@@ -235,9 +258,29 @@ class ShardWorker:
             raise exc
 
     def close(self) -> None:
+        """Stop the worker thread and settle its books.
+
+        A worker still parked mid-task past the join timeout cannot be
+        force-killed in-process — but abandoning it SILENTLY hid two
+        failure modes: the hang itself (now counted,
+        ``anomod_serve_shard_close_timeout_total``, and warned) and any
+        task error nobody joined (now re-raised here instead of dying
+        with the thread)."""
         self._q.put(None)
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            import warnings
+
+            from anomod import obs
+            obs.counter("anomod_serve_shard_close_timeout_total").inc()
+            warnings.warn(
+                f"shard worker {self.shard_id} still running 5 s after "
+                "close(); abandoning the daemon thread (its task error, "
+                "if any, will be lost)", RuntimeWarning, stacklevel=2)
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
 
     @property
     def alive(self) -> bool:
-        return self._thread.is_alive()
+        return self._thread.is_alive() and not self._dying

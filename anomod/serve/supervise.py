@@ -1,0 +1,566 @@
+"""Shard supervision: deterministic checkpoint/restore and no-score-gap
+recovery for the serving plane.
+
+The reference system's value proposition is staying collectable while
+faults are injected under it (SURVEY §5 — the self-healing layer's
+force-delete-and-respawn, modeled in ``anomod.recovery``); this module
+gives the serve plane the same property.  Three pieces:
+
+- **Checkpoint** (``ANOMOD_SERVE_CKPT_EVERY``, the flight-digest
+  cadence idiom): every Nth tick the supervisor snapshots each shard's
+  tenants — replay state through the ``get_state``/pool-gather seam
+  (pinned byte-exact across residencies) plus the detector's host
+  bookkeeping — and each runner's dispatch-count book.  Between
+  checkpoints the coordinator retains every tick's served-batch slices
+  (it owns admission, so the slices ARE the re-execution input): the
+  admission-plane bookkeeping that makes a tick re-executable.
+- **Recovery**: a shard failure at the tick barrier triggers restore
+  (drop the shard's suspect planes, reinstall the snapshot through
+  ``set_state``) + deterministic RE-execution of the retained slices,
+  including the failed tick's — on the respawned worker when the
+  thread died.  Scoring is a pure function of (state, slices) at every
+  shard count / pipeline depth / residency (the PR-5/8 parity pins),
+  so the recovered run's states, alerts, SLO and shed are
+  BYTE-identical to a fault-free run of the same seed: the
+  "no score gap" contract, verified by equal canonical flight
+  journals (``anomod audit diff``).
+- **Degradation**: a slice that kills its shard ``ANOMOD_SERVE_RETRIES``
+  consecutive times is QUARANTINED (dropped from the log, counted,
+  journaled — never retried forever); a shard whose worker dies past
+  ``ANOMOD_SERVE_MAX_RESPAWNS`` is declared DEAD and its tenants
+  MIGRATE to the survivors through the same ``set_state`` seam — the
+  first real step of the elastic-tenancy roadmap item.
+
+Everything the supervisor does on the happy path is a pure read
+(snapshots) or host bookkeeping (the log), so a chaos-off supervised
+run's decisions are byte-identical to the unsupervised engine —
+pinned in tests/test_serve_supervise.py.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from anomod import obs
+
+__all__ = ["ShardSupervisor", "snapshot_replay", "restore_replay",
+           "snapshot_detector", "restore_detector"]
+
+
+# -- tenant snapshot/restore through the official state seams ------------
+
+def snapshot_replay(rep) -> dict:
+    """One tenant replay plane's full restorable state: the
+    ``get_state`` pytree owned-by-the-checkpoint plus the ring
+    bookkeeping ``plan_push`` advances.  A pool-backed replay's gather
+    is ALWAYS a copy (the :meth:`anomod.replay.TenantStatePool.gather`
+    contract), so its pytree is taken as-is; the host seam hands its
+    LIVE arrays and must be copied here — re-copying the pool gather
+    too would double the checkpoint's memcpy bill at fleet size."""
+    from anomod.serve.batcher import PooledStreamReplay
+    st = rep.get_state()
+    if not isinstance(rep, PooledStreamReplay):
+        st = type(st)(*[None if x is None else np.array(x)
+                        for x in st])
+    return {"state": st,
+            "t0_us": rep.t0_us,
+            "window_offset": rep.window_offset,
+            "n_spans": rep.n_spans}
+
+
+def restore_replay(rep, snap: dict) -> None:
+    """Install a :func:`snapshot_replay` into a FRESH plane.  The host
+    seam's ``set_state`` installs references and the fold mutates
+    through them — sharing with the checkpoint would corrupt it for
+    the next restore, so the arrays are copied on the way in.  A pool
+    put SCATTERS into the pool's own planes (the snapshot is never
+    aliased), so the pooled path skips the extra copy — the same
+    asymmetry as :func:`snapshot_replay`, restore side."""
+    from anomod.serve.batcher import PooledStreamReplay
+    rep.t0_us = snap["t0_us"]
+    rep.window_offset = snap["window_offset"]
+    rep.n_spans = snap["n_spans"]
+    st = snap["state"]
+    if not isinstance(rep, PooledStreamReplay):
+        st = type(st)(*[None if x is None else np.array(x)
+                        for x in st])
+    rep.set_state(st)
+
+
+def _copy_state_val(v):
+    """Structured copy for detector host state: arrays and containers
+    copy (folds mutate them in place), scalars and RECORD objects
+    (dataclass instances — Alert etc., append-only emission records the
+    detector never mutates after creation) share by reference.  A
+    generic ``copy.deepcopy`` of the same graph walks ~60 objects per
+    detector and dominated the checkpoint wall at fleet size; anything
+    this function does not recognize still falls back to deepcopy, so
+    an unknown mutable type degrades to slow-but-safe."""
+    if isinstance(v, np.ndarray):
+        return v.copy()
+    if v is None or isinstance(v, (int, float, bool, str, bytes,
+                                   frozenset)):
+        return v
+    if isinstance(v, tuple):
+        return tuple(_copy_state_val(x) for x in v)
+    if isinstance(v, list):
+        return [_copy_state_val(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _copy_state_val(x) for k, x in v.items()}
+    if isinstance(v, set):
+        return set(v)
+    import dataclasses as _dc
+    if _dc.is_dataclass(v) and not isinstance(v, type) \
+            and v.__dataclass_params__.frozen:
+        return v                      # an immutable record, shareable
+    return copy.deepcopy(v)
+
+
+def snapshot_detector(det) -> dict:
+    """The detector's host bookkeeping (alerts, streaks, CUSUM,
+    calibration, edge/pair accumulators — everything but the replay
+    plane, which snapshots separately through its own seam)."""
+    return {k: _copy_state_val(v) for k, v in det.__dict__.items()
+            if k != "replay"}
+
+
+def restore_detector(det, snap: dict) -> None:
+    det.__dict__.update({k: _copy_state_val(v)
+                         for k, v in snap.items()})
+
+
+class _ReplayFailed(Exception):
+    """Internal: a recovery re-execution failed at one log slice."""
+
+    def __init__(self, tick: int, exc: BaseException):
+        super().__init__(f"re-execution failed at tick {tick}: {exc}")
+        self.tick = tick
+        self.exc = exc
+
+
+class _Checkpoint:
+    __slots__ = ("tick", "tenants", "books")
+
+    def __init__(self, tick: int, tenants: dict, books: list):
+        self.tick = tick
+        self.tenants = tenants          # tid -> (replay_snap, det_snap)
+        self.books = books              # per-runner book_snapshot()
+
+
+class ShardSupervisor:
+    """Owns the checkpoint cadence, the recovery log, the retry/
+    quarantine policy and the dead-shard migration path for one
+    :class:`~anomod.serve.engine.ServeEngine`."""
+
+    def __init__(self, engine, ckpt_every: int, retries: int,
+                 backoff_s: float, max_respawns: int):
+        if ckpt_every < 1:
+            raise ValueError("supervision needs ckpt_every >= 1 "
+                             "(0 disables it at the engine)")
+        self.engine = engine
+        self.ckpt_every = int(ckpt_every)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.max_respawns = int(max_respawns)
+        self._ckpt: Optional[_Checkpoint] = None
+        #: (tick, served) since the last checkpoint — the re-execution
+        #: input; batches are immutable, so retention is reference-cheap
+        self._log: List[Tuple[int, list]] = []
+        self._quarantined_seqs: set = set()
+        #: consecutive recovery failures per (shard, origin tick) slice
+        self._fail_counts: Dict[Tuple[int, int], int] = {}
+        self._respawns: Dict[int, int] = {}
+        self.dead_shards: set = set()
+        #: recovery events for the flight journal's VARIANT tier
+        #: (drained per tick by the engine; canonical planes untouched)
+        self._events: List[dict] = []
+        self.n_checkpoints = 0
+        self.n_crashes = 0
+        self.n_respawns = 0
+        self.n_restored_ticks = 0
+        self.n_quarantined = 0
+        self.quarantined_spans = 0
+        self.n_migrated = 0
+        self.ckpt_wall_s = 0.0
+        self.recovery_wall_s = 0.0
+        self._obs_ckpt = obs.counter("anomod_serve_ckpt_total")
+        self._obs_ckpt_s = obs.counter("anomod_serve_ckpt_seconds_total")
+        self._obs_crashes = obs.counter(
+            "anomod_serve_shard_crashes_total")
+        self._obs_respawns = obs.counter(
+            "anomod_serve_shard_respawns_total")
+        self._obs_restored = obs.counter(
+            "anomod_serve_restored_ticks_total")
+        self._obs_quarantined = obs.counter(
+            "anomod_serve_quarantined_batches_total")
+        self._obs_migrated = obs.counter(
+            "anomod_serve_migrated_tenants_total")
+        self._obs_recovery_s = obs.counter(
+            "anomod_serve_recovery_seconds_total")
+
+    # -- the per-tick protocol (engine.tick drives this) ------------------
+
+    def begin_tick(self, served: list) -> None:
+        """Log this tick's served batches BEFORE scoring runs — the
+        failed tick's slices must already be in the log when recovery
+        re-executes it.  The baseline checkpoint is taken lazily here
+        (post-warm, pre-first-scoring: empty tenants, the runners'
+        warmed-but-unserved books)."""
+        if self._ckpt is None:
+            self._checkpoint()
+        self._log.append((self.engine.clock.ticks, served))
+
+    def end_tick(self) -> None:
+        """Checkpoint at the cadence (the flight-digest tick rule:
+        0-based tick t checkpoints when ``(t + 1) % every == 0``),
+        AFTER the tick's scoring committed."""
+        if (self.engine.clock.ticks + 1) % self.ckpt_every == 0:
+            self._checkpoint()
+        if self.engine.flight_recorder is None and self._events:
+            # no journal to drain into: the counters/report carry the
+            # recovery story, and an unbounded event list must not grow
+            # with a flight-off run's crash count
+            self._events.clear()
+
+    def drain_events(self) -> List[dict]:
+        ev, self._events = self._events, []
+        return ev
+
+    # -- checkpointing -----------------------------------------------------
+
+    def _checkpoint(self) -> None:
+        t0 = time.perf_counter()
+        eng = self.engine
+        tenants = {}
+        for tid, rep in eng._tenant_replay.items():
+            det = eng._tenant_det.get(tid)
+            tenants[tid] = (snapshot_replay(rep),
+                            snapshot_detector(det)
+                            if det is not None else None)
+        books = [r.book_snapshot() for r in eng._runners]
+        self._ckpt = _Checkpoint(eng.clock.ticks, tenants, books)
+        self._log = []
+        self.n_checkpoints += 1
+        self._obs_ckpt.inc()
+        dt = time.perf_counter() - t0
+        self.ckpt_wall_s += dt
+        self._obs_ckpt_s.inc(dt)
+
+    # -- recovery ----------------------------------------------------------
+
+    def recover(self, failures: List[Tuple[int, BaseException]]) -> None:
+        """Recover every shard that failed this tick's barrier.  Raises
+        (the original error) only when recovery is impossible: retry +
+        quarantine exhausted AND no surviving shard to migrate to."""
+        t0 = time.perf_counter()
+        try:
+            for shard_id, exc in failures:
+                if not isinstance(exc, Exception):
+                    raise exc     # operator interrupt, never a fault
+                self._recover_shard(shard_id, exc)
+        finally:
+            dt = time.perf_counter() - t0
+            self.recovery_wall_s += dt
+            self._obs_recovery_s.inc(dt)
+
+    def _recover_shard(self, s: int, exc: BaseException,
+                       origin_tick: Optional[int] = None) -> None:
+        eng = self.engine
+        tick = eng.clock.ticks
+        self.n_crashes += 1
+        self._obs_crashes.inc()
+        event = {"kind": "recovered", "tick": tick, "shard": s,
+                 "error": f"{type(exc).__name__}: {exc}",
+                 "attempts": 0, "respawns": 0, "restored_ticks": 0,
+                 "quarantined": 0}
+        # the live failure is attempt 1 against the slice that actually
+        # failed — the current tick's, unless the migration path hands
+        # in an older origin tick (charging the current tick instead
+        # would quarantine an innocent slice one real failure early)
+        fail_key = (s, tick if origin_tick is None else origin_tick)
+        self._fail_counts[fail_key] = \
+            self._fail_counts.get(fail_key, 0) + 1
+        last = exc
+        attempt = 0
+        while True:
+            if self._worker_dead_past_budget(s):
+                # the shard is dead past its respawn budget — migrate
+                # its tenants to the survivors (or give up loudly when
+                # there are none) BEFORE any quarantine decision: a
+                # fault that follows the SHARD runs clean on the new
+                # owners (no score gap), and a fault that follows the
+                # BATCH still quarantines inside the migration replay
+                self._migrate_dead_shard(s, last)
+                return
+            if self._fail_counts.get(fail_key, 0) >= self.retries:
+                event["quarantined"] += self._quarantine(s, fail_key[1])
+            if self.backoff_s > 0:
+                time.sleep(min(self.backoff_s * (2 ** attempt), 5.0))
+            self._respawn_worker(s, event)
+            try:
+                restored = self._restore_and_replay(s, event)
+            except _ReplayFailed as rf:
+                attempt += 1
+                last = rf.exc
+                fail_key = (s, rf.tick)
+                self._fail_counts[fail_key] = \
+                    self._fail_counts.get(fail_key, 0) + 1
+                continue
+            event["attempts"] = attempt + 1
+            event["restored_ticks"] = restored
+            self._events.append(event)
+            # the incident is OVER: every slice (including the one that
+            # failed) just executed clean, so its failure streak is
+            # broken — quarantine counts CONSECUTIVE failures, and a
+            # stale count would let a later unrelated incident
+            # quarantine a recovered slice one real failure early
+            self._fail_counts = {k: v for k, v in
+                                 self._fail_counts.items() if k[0] != s}
+            return
+
+    def _worker_dead_past_budget(self, s: int) -> bool:
+        eng = self.engine
+        return (eng._workers is not None
+                and not eng._workers[s].alive
+                and self._respawns.get(s, 0) >= self.max_respawns)
+
+    def _respawn_worker(self, s: int, event: dict) -> None:
+        """Respawn shard ``s``'s worker thread if it died (the budget
+        was already checked by the recovery loop).  The inline engine
+        (no worker threads) has nothing to respawn."""
+        eng = self.engine
+        if eng._workers is None:
+            return
+        w = eng._workers[s]
+        if w.alive:
+            return
+        w.close()                    # dead thread: joins immediately
+        from anomod.serve.shard import ShardWorker
+        eng._workers[s] = ShardWorker(s)
+        self._respawns[s] = self._respawns.get(s, 0) + 1
+        self.n_respawns += 1
+        self._obs_respawns.inc()
+        event["respawns"] += 1
+
+    def _drop_shard_planes(self, s: int) -> None:
+        """Discard shard ``s``'s (suspect, possibly mid-fold) tenant
+        planes and any parked dispatches — the restore's teardown
+        half."""
+        eng = self.engine
+        for tid in [t for t, r in list(eng._tenant_replay.items())
+                    if eng.shard_of.get(t, 0) == s]:
+            rep = eng._tenant_replay.pop(tid)
+            eng._tenant_det.pop(tid, None)
+            if hasattr(rep, "release"):
+                rep.release()        # hand the pool slot back
+        eng._runners[s].abort_lanes()
+
+    def _install_tenant(self, tid: int, snap: tuple) -> None:
+        """Recreate one tenant's planes on its (current) owning shard
+        and install the checkpoint snapshot through the state seams."""
+        eng = self.engine
+        rep_snap, det_snap = snap
+        rep = eng._replay_for(tid)
+        restore_replay(rep, rep_snap)
+        if det_snap is not None:
+            det = eng._detector_for(tid)
+            restore_detector(det, det_snap)
+
+    def _restore_and_replay(self, s: int, event: Optional[dict] = None
+                            ) -> int:
+        """Restore shard ``s`` to the checkpoint and re-execute its
+        retained slices (oldest first, quarantined batches excluded).
+        Returns the number of slices re-executed; raises
+        :class:`_ReplayFailed` naming the slice that failed."""
+        eng = self.engine
+        ck = self._ckpt
+        self._drop_shard_planes(s)
+        eng._runners[s].book_restore(ck.books[s])
+        for tid, snap in ck.tenants.items():
+            if eng.shard_of.get(tid, 0) == s:
+                self._install_tenant(tid, snap)
+        restored = 0
+        for tick, served in self._log:
+            slice_ = [qb for qb in served
+                      if eng.shard_of.get(qb.tenant_id, 0) == s
+                      and qb.seq not in self._quarantined_seqs]
+            if not slice_:
+                continue
+            # the respawn is SETUP, outside the try: a thread-creation
+            # failure is infrastructure, not attributable to the slice,
+            # and must propagate raw instead of charging the slice's
+            # quarantine budget for an error its content didn't cause
+            self._ensure_worker_alive(s, event)
+            try:
+                self._exec_slice(s, slice_, tick)
+            except Exception as e:       # interrupts propagate raw
+                raise _ReplayFailed(tick, e)
+            restored += 1
+        self.n_restored_ticks += restored
+        self._obs_restored.inc(restored)
+        return restored
+
+    def _ensure_worker_alive(self, s: int,
+                             event: Optional[dict] = None) -> None:
+        """Respawn shard ``s``'s worker if its thread is dead — a
+        migration can re-execute on a shard whose own barrier failure
+        is still queued behind this one (submitting to a dead thread
+        would wait forever), and a mid-replay kill leaves the thread
+        dead for the next slice.  The respawn lands in the caller's
+        recovery ``event`` (the journaled incident must not
+        under-report what happened) and is counted like any other;
+        every failure path from here returns to a budget-checked
+        loop, so this cannot respawn unboundedly."""
+        eng = self.engine
+        if eng._workers is not None and not eng._workers[s].alive:
+            self._respawn_worker(
+                s, event if event is not None else {"respawns": 0})
+
+    def _exec_slice(self, s: int, slice_: list, tick: int) -> None:
+        """Re-execute one logged slice on shard ``s`` — on its worker
+        thread when workers exist (so a killing fault dies where it
+        would live, and XLA dispatch runs where it normally does),
+        inline on the 1-shard engine.  An exception here is the
+        SLICE's failure (the task raised); callers charge it to the
+        slice's quarantine budget — setup errors belong in
+        :meth:`_ensure_worker_alive`, before the attributable zone."""
+        eng = self.engine
+        if eng._workers is not None:
+            from functools import partial
+            w = eng._workers[s]
+            w.submit(partial(eng._score_shard, s, slice_, tick))
+            w.join()
+        else:
+            eng._score_shard(s, slice_, tick)
+
+    def _quarantine(self, s: int, tick: int) -> int:
+        """Drop shard ``s``'s slice of origin ``tick`` from the log —
+        the batch set that has now failed ``retries`` consecutive
+        recovery attempts.  Counted per batch, never silent."""
+        eng = self.engine
+        dropped = spans = 0
+        for t, served in self._log:
+            if t != tick:
+                continue
+            for qb in served:
+                if eng.shard_of.get(qb.tenant_id, 0) == s \
+                        and qb.seq not in self._quarantined_seqs:
+                    self._quarantined_seqs.add(qb.seq)
+                    self.quarantined_spans += qb.n_spans
+                    spans += qb.n_spans
+                    dropped += 1
+        self.n_quarantined += dropped
+        self._obs_quarantined.inc(dropped)
+        self._events.append({"kind": "quarantine", "tick": tick,
+                             "shard": s, "batches": dropped,
+                             "spans": spans})
+        return dropped
+
+    # -- dead-shard migration (the elastic-tenancy seam) -------------------
+
+    def _migrate_dead_shard(self, s: int,
+                            last: BaseException) -> None:
+        """Shard ``s`` is dead past its respawn budget: move every
+        tenant it owns to the surviving shards through the ``set_state``
+        seam — checkpoint state in, retained slices re-executed on the
+        new owners — and route all future work away from it.  Tenant
+        bits are shard-placement-invariant (the PR-5 contract), so a
+        clean migration keeps the no-score-gap parity."""
+        eng = self.engine
+        tick = eng.clock.ticks
+        survivors = [x for x in range(eng.shards)
+                     if x != s and x not in self.dead_shards]
+        if not survivors:
+            raise last
+        self.dead_shards.add(s)
+        moved = sorted(t for t, sh in eng.shard_of.items() if sh == s)
+        self._drop_shard_planes(s)
+        eng._runners[s].book_restore(self._ckpt.books[s])
+        # park a fresh idle worker in the dead slot so the engine's
+        # all-alive respawn check stays quiet; it never receives work
+        if eng._workers is not None:
+            from anomod.serve.shard import ShardWorker
+            eng._workers[s].close()
+            eng._workers[s] = ShardWorker(s)
+        # rendezvous over the survivors (the SAME key definition as
+        # initial placement — shard.rendezvous_shard): deterministic in
+        # (tenant, survivor set) alone, so a replay of the same chaos
+        # script migrates identically
+        from anomod.serve.shard import rendezvous_shard
+        for tid in moved:
+            eng.shard_of[tid] = rendezvous_shard(tid, eng.shards,
+                                                 candidates=survivors)
+            self.n_migrated += 1
+            self._obs_migrated.inc()
+        # the RCA evidence buffers ride on the owning shard's plane
+        if eng.rca and len(eng._rca_planes) > 1:
+            src = eng._rca_planes[s]
+            for tid in moved:
+                buf = src._buf.pop(tid, None)
+                hi = src._buf_hi.pop(tid, None)
+                dst = eng._rca_planes[eng.shard_of[tid]]
+                if buf is not None:
+                    dst._buf[tid] = buf
+                if hi is not None:
+                    dst._buf_hi[tid] = hi
+        for tid in moved:
+            snap = self._ckpt.tenants.get(tid)
+            if snap is not None:
+                self._install_tenant(tid, snap)
+        moved_set = set(moved)
+        mig_event = {"kind": "migrate", "tick": tick, "shard": s,
+                     "to": survivors, "tenants": len(moved),
+                     "respawns": 0,
+                     "error": f"{type(last).__name__}: {last}"}
+        #: targets whose nested recovery already replayed the WHOLE log
+        #: (shard_of is updated, so their restore included the migrated
+        #: tenants' every slice) — the outer walk must skip them, or
+        #: each later slice would fold twice and silently diverge
+        recovered: set = set()
+        outer_counts: Dict[int, int] = {}
+        for t, served in self._log:
+            by_shard: Dict[int, list] = {}
+            for qb in served:
+                if qb.tenant_id in moved_set \
+                        and qb.seq not in self._quarantined_seqs \
+                        and eng.shard_of[qb.tenant_id] not in recovered:
+                    by_shard.setdefault(
+                        eng.shard_of[qb.tenant_id], []).append(qb)
+            for tgt in sorted(by_shard):
+                self._ensure_worker_alive(tgt, mig_event)
+                try:
+                    self._exec_slice(tgt, by_shard[tgt], t)
+                except Exception as e2:      # interrupts propagate raw
+                    # the fault followed the BATCH onto the new shard:
+                    # quarantine the slice and recover the target
+                    # through the normal path — a poison batch must not
+                    # take the survivor down with the dead shard
+                    for qb in by_shard[tgt]:
+                        self._quarantined_seqs.add(qb.seq)
+                        self.quarantined_spans += qb.n_spans
+                    self.n_quarantined += len(by_shard[tgt])
+                    self._obs_quarantined.inc(len(by_shard[tgt]))
+                    self._events.append(
+                        {"kind": "quarantine", "tick": t, "shard": tgt,
+                         "batches": len(by_shard[tgt]),
+                         "spans": sum(qb.n_spans for qb in by_shard[tgt]),
+                         "during": "migration"})
+                    # the nested recovery restores tgt from checkpoint
+                    # and replays the WHOLE log: the outer walk's
+                    # increments for tgt are superseded, not additional
+                    # (the report's n_restored_ticks — and therefore
+                    # mttr_ticks — must not inflate; the registry
+                    # counter stays a monotone count of slices
+                    # EXECUTED during recovery)
+                    self.n_restored_ticks -= outer_counts.pop(tgt, 0)
+                    self._recover_shard(tgt, e2, origin_tick=t)
+                    recovered.add(tgt)
+                    continue
+                self.n_restored_ticks += 1
+                outer_counts[tgt] = outer_counts.get(tgt, 0) + 1
+                self._obs_restored.inc()
+        self._events.append(mig_event)

@@ -68,6 +68,9 @@ and the table in docs/BENCHMARKS.md mirrors them):
   but the device state pool is unusable
 - ``EXIT_FLIGHT_DIVERGENCE`` (7): the flight-journal record→replay→diff
   smoke found a divergent tick/plane
+- ``EXIT_RECOVERY_DIVERGENCE`` (8): the crash→respawn→audit-diff smoke
+  found a score gap — a recovered run's canonical journal diverged
+  from the fault-free run of the same seed
 
 Always prints one JSON line describing the decision (plus the contract
 gate's line).  ``--traces`` must match the bench invocation's span
@@ -92,6 +95,7 @@ EXIT_ENV_CONTRACT = 4
 EXIT_NATIVE_UNUSABLE = 5
 EXIT_STATE_POOL_UNUSABLE = 6
 EXIT_FLIGHT_DIVERGENCE = 7
+EXIT_RECOVERY_DIVERGENCE = 8
 
 
 def _shard_fanout_smoke() -> dict:
@@ -242,6 +246,39 @@ def _flight_smoke():
     return info, diff_journals(rec.journal(), rep.journal())
 
 
+def _recovery_smoke():
+    """The crash→respawn→audit-diff smoke (<5 s): the same tiny seeded
+    run executed fault-free and again with scripted mid-tick shard
+    crashes (a worker kill + a score-path exception) under supervision
+    (anomod.serve.supervise) must produce canonical flight journals
+    ``diff_journals`` finds identical — the no-score-gap recovery
+    contract.  A divergence means recovery re-execution broke
+    determinism and a chaos campaign's results could not be trusted.
+    Returns ``(info, divergence_or_None)``."""
+    from anomod.obs.flight import diff_journals
+    from anomod.serve.engine import run_power_law
+
+    kw = dict(n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+              overload=2.0, duration_s=20, tick_s=1.0, seed=5,
+              window_s=5.0, baseline_windows=4, fault_tenants=0,
+              buckets=(64, 256), lane_buckets=(1, 2, 4),
+              max_backlog=1500, n_windows=16, shards=2, pipeline=2,
+              flight=True, flight_digest_every=4, ckpt_every=4)
+    eng_ref, _ = run_power_law(**kw)
+    eng_chaos, rep = run_power_law(
+        chaos="crash@6:shard=0:phase=dispatch;"
+              "except@11:shard=1:phase=score", **kw)
+    info = {"crashes": rep.n_shard_crashes, "respawns": rep.n_respawns,
+            "restored_ticks": rep.n_restored_ticks,
+            "quarantined": rep.n_quarantined,
+            "checkpoints": rep.n_checkpoints}
+    if rep.n_shard_crashes < 2 or rep.n_respawns < 1:
+        raise RuntimeError(
+            f"recovery smoke injected faults did not fire: {info}")
+    return info, diff_journals(eng_ref.flight_recorder.journal(),
+                               eng_chaos.flight_recorder.journal())
+
+
 def check_serve() -> int:
     """Serve-bench preconditions: env contract parses, bucket set
     compiles, the shard fan-out reproduces the 1-shard output, and the
@@ -356,6 +393,21 @@ def check_serve() -> int:
                   "the determinism contract and a capture's audit trail "
                   "would be unusable", file=sys.stderr)
             return EXIT_FLIGHT_DIVERGENCE
+        # the crash→respawn→audit-diff smoke: supervised recovery must
+        # leave NO score gap (canonical journal equal to fault-free) —
+        # its own exit code, distinct from a replay-path divergence
+        recovery_info, recovery_div = _recovery_smoke()
+        out["recovery_smoke"] = recovery_info
+        if recovery_div is not None:
+            out["status"] = "recovery-divergence"
+            out["divergence"] = recovery_div
+            print(json.dumps(out))
+            print(f"pre_bench_check: recovery smoke diverged at tick "
+                  f"{recovery_div['tick']} in the "
+                  f"{recovery_div['plane']} plane — a recovered run "
+                  "left a score gap vs the fault-free run of the same "
+                  "seed", file=sys.stderr)
+            return EXIT_RECOVERY_DIVERGENCE
         print(json.dumps(out))
         return EXIT_READY
     except Exception as e:

@@ -249,10 +249,34 @@ def test_bench_serve_mode_contract(tmp_path):
     assert par["states_identical"] is True
     assert par["p99_identical"] is True
     assert par["shed_identical"] is True
+    # recovery block (ISSUE-10): the checkpoint cadence priced in-run
+    # on the headline (ckpt_wall / serve_wall — no A/B leg by design),
+    # the chaos leg's crash/restore counts, and the no-score-gap
+    # parity bits (byte-identical decisions + equal canonical flight
+    # journals)
+    rc = out["recovery"]
+    assert rc["supervised_headline"] is True
+    assert rc["ckpt_every"] >= 1
+    assert rc["n_checkpoints"] >= 1
+    assert rc["ckpt_wall_s"] >= 0
+    assert 0.0 <= rc["ckpt_overhead_fraction"] < 1.0
+    assert rc["chaos_script"]
+    assert rc["n_shard_crashes"] == 3          # the scripted campaign
+    assert rc["n_restored_ticks"] >= rc["n_shard_crashes"]
+    assert rc["n_quarantined"] == 0            # repeat=1 faults recover
+    assert rc["n_migrated_tenants"] == 0
+    assert rc["mttr_ticks"] >= 1
+    assert rc["recovery_wall_s"] >= 0
+    par = rc["parity"]
+    assert par["alerts_identical"] is True
+    assert par["states_identical"] is True
+    assert par["p99_identical"] is True
+    assert par["shed_identical"] is True
+    assert par["journal_canonical_identical"] is True
 
 
 def test_pre_bench_exit_codes_named_and_unique():
-    """The gate's exit-code table (accreted 3/4/5/6/7 across PRs 5–9)
+    """The gate's exit-code table (accreted 3/4/5/6/7/8 across PRs 5–10)
     lives as named EXIT_* constants in ONE place; the constants are
     collected by prefix (a new one joins the pin automatically), every
     code is distinct, and the documented values are pinned so drivers
@@ -270,7 +294,7 @@ def test_pre_bench_exit_codes_named_and_unique():
         "EXIT_READY": 0, "EXIT_COLD_CACHE": 1, "EXIT_CACHE_DISABLED": 2,
         "EXIT_SERVE_PRECONDITION": 3, "EXIT_ENV_CONTRACT": 4,
         "EXIT_NATIVE_UNUSABLE": 5, "EXIT_STATE_POOL_UNUSABLE": 6,
-        "EXIT_FLIGHT_DIVERGENCE": 7,
+        "EXIT_FLIGHT_DIVERGENCE": 7, "EXIT_RECOVERY_DIVERGENCE": 8,
     }
     # every literal return in the gate's source goes through a constant
     src = (Path(__file__).parent.parent / "scripts"

@@ -369,7 +369,8 @@ def test_overload_shedding_is_priority_ordered_and_deterministic():
 
     wall = ("serve_wall_s", "sustained_spans_per_sec", "compile_s",
             "lane_compile_s", "stage_wall_s", "dispatch_wall_s",
-            "fold_wall_s", "score_wall_s")
+            "fold_wall_s", "score_wall_s", "ckpt_wall_s",
+            "recovery_wall_s")
     a = {k: v for k, v in _overload_report(5).to_dict().items()
          if k not in wall}
     b = {k: v for k, v in _overload_report(5).to_dict().items()

@@ -1,0 +1,408 @@
+"""Chaos-hardened serving: shard supervision, deterministic
+checkpoint/restore, and the no-score-gap recovery contract
+(anomod.serve.supervise + anomod.serve.chaos, ISSUE-10).
+
+The central pin: a seeded run with scripted mid-tick shard faults —
+worker crashes, score-path exceptions at every phase, state-pool
+failures — recovers through checkpoint restore + deterministic
+re-execution to states, alerts, SLO and shed BYTE-identical to the
+fault-free run of the same seed, with equal canonical flight journals
+(`anomod audit diff` semantics).  Tier-1 covers every phase and the
+degradation paths on compact configs; the exhaustive
+phase × shards × pipeline × residency cross runs under ``-m slow``.
+"""
+
+import dataclasses
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from anomod.obs.flight import diff_journals
+from anomod.serve.engine import (SHARD_VARIANT_REPORT_FIELDS, ServeEngine,
+                                 run_power_law)
+
+#: the compact seeded scenario every test in this module compares on —
+#: small enough for tier-1, long enough that alerts fire (window 2 s,
+#: fault onset 12 s) and several checkpoints land (cadence 4 over 20
+#: ticks), so every canonical plane is LIVE when recovery re-executes
+KW = dict(n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+          overload=2.0, duration_s=20, tick_s=1.0, seed=5,
+          window_s=2.0, baseline_windows=4, fault_tenants=1,
+          buckets=(64, 256), lane_buckets=(1, 2, 4), max_backlog=1500,
+          n_windows=16, flight_digest_every=4, ckpt_every=4)
+
+#: a script that exercises EVERY score-path phase across both shards of
+#: a 2-shard engine (shard ids clamp to 0 on the inline engine), plus a
+#: stall (output-neutral) — one run, five recoveries
+ALL_PHASE_SCRIPT = ("crash@6:shard=0:phase=dispatch;"
+                    "except@9:shard=1:phase=score;"
+                    "poolput@12:shard=0;"
+                    "except@15:shard=1:phase=commit;"
+                    "crash@17:shard=0:phase=stage;"
+                    "stall@10:shard=0:ms=1")
+
+#: report fields that legitimately differ between a fault-free and a
+#: recovered run (the recovery counters + the wall legs already in the
+#: shard-variant list)
+RECOVERY_REPORT_FIELDS = ("n_shard_crashes", "n_respawns",
+                          "n_restored_ticks", "n_quarantined",
+                          "n_migrated_tenants")
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """ONE fault-free 2-shard reference run: tenant bits, SLO, shed and
+    the canonical journal are pinned shard/pipeline/residency-invariant
+    by PRs 5/8/9, so this single run is the valid reference for every
+    configuration in the module."""
+    eng, rep = run_power_law(shards=2, pipeline=2, **KW)
+    return eng, rep, eng.flight_recorder.journal()
+
+
+def assert_no_score_gap(reference, eng, rep, journal=True,
+                        extra_skip=()):
+    """The no-score-gap contract: byte-identical tenant states + alert
+    streams, identical SLO/shed and report decision fields, equal
+    canonical flight journals.  ``extra_skip`` names report fields the
+    comparison legitimately ignores (e.g. ``serve_state`` when the two
+    legs run different residencies — the decisions are pinned identical
+    anyway)."""
+    ref_eng, ref_rep, ref_journal = reference
+    tids = sorted(set(ref_eng._tenant_det) | set(eng._tenant_det))
+    assert tids == sorted(ref_eng._tenant_det)
+    for tid in tids:
+        assert [dataclasses.asdict(a) for a in ref_eng.alerts_for(tid)] \
+            == [dataclasses.asdict(a) for a in eng.alerts_for(tid)], \
+            f"tenant {tid} alert stream diverges"
+        s1 = ref_eng._tenant_replay[tid].state
+        s2 = eng._tenant_replay[tid].state
+        assert np.array_equal(np.asarray(s1.agg), np.asarray(s2.agg)), \
+            f"tenant {tid} agg plane diverges"
+        assert np.array_equal(np.asarray(s1.hist), np.asarray(s2.hist)), \
+            f"tenant {tid} hist plane diverges"
+    skip = set(SHARD_VARIANT_REPORT_FIELDS) \
+        | set(RECOVERY_REPORT_FIELDS) | set(extra_skip)
+    a = {k: v for k, v in ref_rep.to_dict().items() if k not in skip}
+    b = {k: v for k, v in rep.to_dict().items() if k not in skip}
+    assert a == b, sorted(k for k in a if a[k] != b[k])
+    if journal:
+        d = diff_journals(ref_journal, eng.flight_recorder.journal())
+        assert d is None, d
+
+
+def test_recovery_every_phase_sharded(reference):
+    """Crashes at every phase (stage/dispatch/fold/score/commit — the
+    dispatch one a worker KILL with live in-flight dispatches, the fold
+    one a pool-put failure) spread over both shards of a 2-shard
+    pipelined engine recover with no score gap — and the whole recovery
+    surface lands in the metrics registry (the OBSERVABILITY.md
+    catalog rows)."""
+    from anomod import obs
+    from anomod.obs.registry import Registry, set_registry
+    reg = Registry(enabled=True)
+    prev = set_registry(reg)
+    try:
+        eng, rep = run_power_law(shards=2, pipeline=2,
+                                 chaos=ALL_PHASE_SCRIPT, **KW)
+        for name, want in (
+                ("anomod_serve_chaos_injected_total", 6),  # + the stall
+                ("anomod_serve_chaos_stalls_total", 1),
+                ("anomod_serve_shard_crashes_total", 5),
+                ("anomod_serve_shard_respawns_total", 2),
+                ("anomod_serve_ckpt_total", rep.n_checkpoints),
+                ("anomod_serve_restored_ticks_total",
+                 rep.n_restored_ticks)):
+            assert obs.counter(name).value == want, name
+        assert obs.counter(
+            "anomod_serve_recovery_seconds_total").value > 0
+    finally:
+        set_registry(prev)
+    assert rep.n_shard_crashes == 5          # the stall never crashes
+    assert rep.n_respawns == 2               # exactly the two kills
+    assert rep.n_restored_ticks >= 5
+    assert rep.n_quarantined == 0 and rep.n_migrated_tenants == 0
+    assert_no_score_gap(reference, eng, rep)
+
+
+def test_recovery_every_phase_inline_host_depth1(reference):
+    """The same five-phase campaign on the INLINE 1-shard engine (no
+    worker threads: crashes surface as plain exceptions, recovery
+    restores + re-executes on the coordinator) — run on the HOST state
+    seam at pipeline depth 1, so restore goes through host set_state
+    instead of the pool scatter and re-execution has no in-flight
+    window.  With the sharded/device/depth-2 test above, every
+    matrix axis is covered in tier-1; the full cross runs under
+    ``-m slow``."""
+    eng, rep = run_power_law(shards=1, pipeline=1, state="host",
+                             chaos=ALL_PHASE_SCRIPT.replace("shard=1",
+                                                            "shard=0"),
+                             **KW)
+    assert rep.n_shard_crashes == 5
+    assert rep.n_respawns == 0               # nothing to respawn inline
+    assert_no_score_gap(reference, eng, rep,
+                        extra_skip={"serve_state"})
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("phase", ["stage", "dispatch", "fold", "score",
+                                   "commit"])
+@pytest.mark.parametrize("shards", [1, 2])
+@pytest.mark.parametrize("pipeline", [1, 2, 3])
+@pytest.mark.parametrize("state", ["host", "device"])
+def test_recovery_matrix(reference, phase, shards, pipeline, state):
+    """The exhaustive recovery matrix: a worker kill at every score
+    phase × 1-vs-2 shards × pipeline depths 1–3 × host-vs-device
+    residency ⇒ byte-identical to fault-free (the compact tier-1 tests
+    above cover every axis; this cross pins every combination)."""
+    eng, rep = run_power_law(
+        shards=shards, pipeline=pipeline, state=state,
+        chaos=f"crash@6:shard=0:phase={phase};"
+              f"except@13:shard={shards - 1}:phase={phase}", **KW)
+    assert rep.n_shard_crashes == 2
+    assert_no_score_gap(reference, eng, rep,
+                        extra_skip={"serve_state"} if state == "host"
+                        else ())
+
+
+def test_unfused_engine_fires_and_recovers_every_phase_kind():
+    """The unfused path has no phase structure, but a scripted fault at
+    ANY phase must still fire (collapsed onto the slice boundaries) —
+    a silently never-injected fault would read as 'survived'."""
+    kw = {**KW, "duration_s": 12, "fault_tenants": 0}
+    e0, r0 = run_power_law(shards=1, fuse=False, **kw)
+    eng, rep = run_power_law(
+        shards=1, fuse=False,
+        chaos="crash@4;except@6:phase=fold;poolput@8;"
+              "except@9:phase=commit;stall@5:ms=1", **kw)
+    assert eng._chaos.n_injected == 5
+    assert rep.n_shard_crashes == 4          # all but the stall
+    for tid in e0._tenant_replay:
+        s1 = e0._tenant_replay[tid].state
+        s2 = eng._tenant_replay[tid].state
+        assert np.array_equal(np.asarray(s1.agg), np.asarray(s2.agg))
+    assert diff_journals(e0.flight_recorder.journal(),
+                         eng.flight_recorder.journal()) is None
+
+
+def test_chaos_off_supervised_byte_identical_to_unsupervised(reference):
+    """Supervision is pure reads on the happy path: a chaos-off
+    SUPERVISED run (the new default) is byte-identical to the same run
+    with supervision off (the exact PR-9 engine) — decisions, report
+    and canonical journal."""
+    eng, rep = run_power_law(shards=2, pipeline=2, ckpt_every=0,
+                             **{k: v for k, v in KW.items()
+                                if k != "ckpt_every"})
+    assert rep.supervised is False and rep.n_checkpoints == 0
+    ref_eng, ref_rep, _ = reference
+    assert ref_rep.supervised is True and ref_rep.n_checkpoints > 0
+    assert_no_score_gap(reference, eng, rep,
+                        extra_skip={"supervised", "ckpt_every",
+                                    "n_checkpoints"})
+
+
+def test_unsupervised_chaos_propagates():
+    """ckpt_every=0 disables recovery: the first injected fault fails
+    the tick exactly like any shard error before supervision existed."""
+    from anomod.serve.chaos import ChaosFault
+    with pytest.raises(ChaosFault):
+        run_power_law(shards=1, chaos="except@6:shard=0",
+                      **{**KW, "ckpt_every": 0})
+
+
+def test_quarantine_after_k_consecutive_failures():
+    """A slice that kills its shard ``retries`` consecutive times is
+    QUARANTINED (dropped, counted, journaled in the variant tier) and
+    the shard recovers without it — never retried forever.  The
+    quarantined spans are a real score gap, so the canonical journal
+    must NOT be claimed equal; everything else keeps serving."""
+    eng, rep = run_power_law(
+        shards=2, chaos="except@8:shard=1:phase=dispatch:repeat=-1",
+        retries=2, **KW)
+    assert rep.n_shard_crashes == 1
+    assert rep.n_quarantined > 0
+    assert rep.n_migrated_tenants == 0
+    assert rep.ticks == 20                   # the run completed
+    # the quarantine event rides the flight journal's VARIANT tier
+    evs = [ev for t in eng.flight_recorder.records()
+           for ev in t.get("recovery", ()) if ev["kind"] == "quarantine"]
+    assert evs and evs[0]["batches"] == rep.n_quarantined
+
+
+def test_migration_parity_after_shard_death(reference):
+    """A shard whose worker dies past the respawn budget has its
+    tenants MIGRATED to the survivor through the set_state seam and the
+    retained slices re-executed there — and because tenant bits are
+    placement-invariant, even this degraded path keeps the
+    no-score-gap parity when the fault followed the shard."""
+    eng, rep = run_power_law(
+        shards=2,
+        chaos=";".join(f"crash@{t}:shard=0:phase=stage:repeat=-1"
+                       for t in range(4, 20)),
+        retries=3, max_respawns=2, **KW)
+    assert rep.n_migrated_tenants > 0
+    assert rep.n_respawns == 2
+    assert rep.n_quarantined == 0
+    assert_no_score_gap(reference, eng, rep)
+    evs = [ev for t in eng.flight_recorder.records()
+           for ev in t.get("recovery", ()) if ev["kind"] == "migrate"]
+    assert len(evs) == 1 and evs[0]["tenants"] == rep.n_migrated_tenants
+
+
+@pytest.mark.slow
+def test_batch_bound_fault_during_migration_quarantines_not_doubles():
+    """A poison batch that follows its tenant onto the migration target
+    quarantines THERE — and the nested recovery replaying the target's
+    whole log must not let the outer migration walk re-execute the
+    later slices a second time (a double fold would silently corrupt
+    states).  The span-conservation invariant is the oracle: every
+    served span folds into exactly one replay, minus the quarantined
+    ones."""
+    eng, rep = run_power_law(
+        shards=2,
+        chaos="crash@12:shard=0:phase=stage:repeat=-1;"
+              "except@12:shard=1:phase=dispatch:repeat=-1",
+        retries=2, max_respawns=1, **KW)
+    assert rep.ticks == 20                    # the run completed
+    assert rep.n_migrated_tenants > 0
+    assert rep.n_quarantined > 0
+    sup = eng._supervisor
+    folded = sum(r.n_spans for r in eng._tenant_replay.values())
+    assert folded == rep.served_spans - sup.quarantined_spans
+
+
+@pytest.mark.slow
+def test_migration_with_no_survivor_propagates():
+    """The 1-shard engine has nowhere to migrate: a worker... there is
+    no worker inline, so exhaust the retry path on a 2-shard engine
+    with BOTH shards dead — the original error propagates loudly."""
+    from anomod.serve.chaos import ChaosFault
+    script = ";".join(f"crash@{t}:shard={s}:phase=stage:repeat=-1"
+                      for t in range(4, 8) for s in (0, 1))
+    with pytest.raises(ChaosFault):
+        run_power_law(shards=2, chaos=script, retries=2,
+                      max_respawns=1, **KW)
+
+
+def test_chaos_script_validation():
+    """The ANOMOD_SERVE_CHAOS grammar fails loud on every malformed
+    shape, and round-trips through the Config contract."""
+    from anomod.config import validate_chaos_script
+    good = validate_chaos_script(
+        "crash@5;except@6:shard=1:phase=score;stall@7:ms=2.5;"
+        "poolput@8:repeat=-1")
+    assert [f["kind"] for f in good] == ["crash", "except", "stall",
+                                        "poolput"]
+    assert good[0]["phase"] == "dispatch"     # per-kind default
+    assert good[2]["ms"] == 2.5
+    assert good[3]["phase"] == "fold" and good[3]["repeat"] == -1
+    for bad in ("boom@5", "crash", "crash@x", "crash@-1",
+                "crash@5:phase=nope", "crash@5:repeat=0",
+                "crash@5:shard=-2", "crash@5:frobnicate=1",
+                "stall@5:ms=99999"):
+        with pytest.raises(ValueError):
+            validate_chaos_script(bad)
+
+
+def test_supervision_knobs_validated(monkeypatch):
+    """Every new knob is Config-validated (fail-loud), and the engine
+    refuses nonsense values."""
+    from anomod.config import Config
+    for var, bad in (("ANOMOD_SERVE_CHAOS", "boom@5"),
+                     ("ANOMOD_SERVE_CKPT_EVERY", "-1"),
+                     ("ANOMOD_SERVE_RETRIES", "0"),
+                     ("ANOMOD_SERVE_RETRY_BACKOFF_S", "-0.5"),
+                     ("ANOMOD_SERVE_MAX_RESPAWNS", "-1")):
+        monkeypatch.setenv(var, bad)
+        with pytest.raises(ValueError):
+            Config()
+        monkeypatch.delenv(var)
+    cfg = Config()
+    assert cfg.serve_chaos == "" and cfg.serve_ckpt_every == 32
+    assert cfg.serve_retries == 3 and cfg.serve_retry_backoff_s == 0.0
+    assert cfg.serve_max_respawns == 8
+    from anomod.replay import ReplayConfig
+    with pytest.raises(ValueError):
+        ServeEngine([], ["a"], ReplayConfig(n_services=1), ckpt_every=-1)
+    # a fault aimed at a shard the engine doesn't have can never fire:
+    # WARNED loud at construction (not refused — `audit replay
+    # --shards 1` legitimately re-executes a 2-shard chaos journal
+    # with the extra faults inert); the CLI's --chaos path refuses
+    # the same mistake hard via parser.error
+    with pytest.warns(RuntimeWarning, match="targets shard"):
+        eng = ServeEngine([], ["a"], ReplayConfig(n_services=1),
+                          chaos="crash@5:shard=1", shards=1)
+    eng.close()
+
+
+def test_supervision_refused_with_multimodal_and_mesh():
+    """Supervision cannot checkpoint the multimodal sidecar planes or
+    the mesh plane's sharded state: an explicit request is refused, the
+    env default silently degrades to unsupervised."""
+    from anomod.replay import ReplayConfig
+    from anomod.serve.queues import TenantSpec
+    specs = [TenantSpec(0, "t0", rate_spans_per_s=10.0)]
+    cfg = ReplayConfig(n_services=2, n_windows=8, window_us=1_000_000,
+                      chunk_size=64)
+    with pytest.raises(ValueError, match="multimodal"):
+        ServeEngine(specs, ["a", "b"], cfg, multimodal=True,
+                    ckpt_every=8)
+    eng = ServeEngine(specs, ["a", "b"], cfg, multimodal=True)
+    assert eng._supervisor is None            # env default degrades
+    eng.close()
+
+
+def test_shard_worker_close_timeout_counted_and_error_reraised():
+    """ShardWorker.close() satellites: (1) a worker parked past the
+    join timeout is counted + warned instead of silently abandoned;
+    (2) a deferred task error nobody joined re-raises at close instead
+    of vanishing with the thread."""
+    from anomod import obs
+    from anomod.serve.shard import ShardWorker
+
+    # (2) deferred error: submitted, never joined, must surface at close
+    w = ShardWorker(0)
+    w.submit(lambda: (_ for _ in ()).throw(RuntimeError("unjoined")))
+    w._done.wait()
+    with pytest.raises(RuntimeError, match="unjoined"):
+        w.close()
+    assert not w.alive                        # thread still shut down
+
+    # (1) hung worker: a task that outlives the close timeout
+    release = threading.Event()
+    w2 = ShardWorker(1)
+    before = obs.counter(
+        "anomod_serve_shard_close_timeout_total").value
+    w2.submit(release.wait)
+    # shrink the timeout via a monkey-joined thread? close() uses 5 s —
+    # patch the thread's join so the test never waits that long
+    orig_join = w2._thread.join
+    w2._thread.join = lambda timeout=None: orig_join(timeout=0.05)
+    try:
+        with warnings.catch_warnings(record=True) as got:
+            warnings.simplefilter("always")
+            w2.close()
+        assert any("still running" in str(x.message) for x in got)
+        after = obs.counter(
+            "anomod_serve_shard_close_timeout_total").value
+        assert after == before + 1
+    finally:
+        release.set()
+        orig_join(timeout=5.0)
+
+
+def test_worker_crash_kills_thread_and_reports_at_join():
+    """A kills_worker exception (the chaos crash taxonomy) reports its
+    error at the barrier AND exits the worker thread — the supervisor's
+    respawn trigger."""
+    from anomod.serve.chaos import ChaosWorkerCrash
+    from anomod.serve.shard import ShardWorker
+    w = ShardWorker(0)
+    w.submit(lambda: (_ for _ in ()).throw(ChaosWorkerCrash("boom")))
+    with pytest.raises(ChaosWorkerCrash):
+        w.join()
+    w._thread.join(timeout=5.0)
+    assert not w.alive
+
+
