@@ -179,6 +179,34 @@ def main(argv=None) -> int:
     p_val.add_argument("--traces", type=int, default=60)
     p_val.add_argument("--from-data", action="store_true")
 
+    p_lint = sub.add_parser(
+        "lint", help="contract-checking static analysis "
+        "(anomod.analysis): AST lint of the determinism / env-contract "
+        "/ seam / lock contracts plus the parity-surface audit "
+        "(ServeReport fields and flight-record keys vs their declared "
+        "variant lists).  Pure stdlib ast — never touches the backend. "
+        "Catalog: docs/CONTRACTS.md")
+    p_lint.add_argument("--root", default=None,
+                        help="repo root to scan (default: this checkout)")
+    p_lint.add_argument("--json", action="store_true",
+                        help="machine output only (one JSON document, "
+                             "findings inlined)")
+    p_lint.add_argument("--baseline", default=None,
+                        help="baseline file (default: "
+                             "scripts/lint_baseline.json)")
+    p_lint.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to exactly the "
+                             "current findings (the ratchet only "
+                             "shrinks unless you run this)")
+    p_lint.add_argument("--no-parity", action="store_true",
+                        help="skip the parity-surface audit (AST rule "
+                             "families only)")
+    p_lint.add_argument("--show-suppressed", action="store_true",
+                        help="also list suppressed findings with their "
+                             "reasons")
+    p_lint.add_argument("--rules", action="store_true",
+                        help="print the rule catalog and exit")
+
     p_chaos = sub.add_parser(
         "chaos", help="render the fault-injection plan for an experiment "
         "(Chaos Mesh CRD YAML / ChaosBlade argv / docker argv)")
@@ -535,6 +563,46 @@ def main(argv=None) -> int:
                      help="emit one JSON object per sweep point")
 
     args = parser.parse_args(argv)
+
+    if args.cmd == "lint":
+        # backend-free by design (pure ast over source): the contract
+        # gate must run in milliseconds and can never hang on a dead
+        # device tunnel, so no _probe_backend here
+        import dataclasses as _dc
+
+        from anomod.analysis import lint as _lint
+        if args.rules:
+            print(json.dumps({rid: _dc.asdict(r) for rid, r
+                              in sorted(_lint.RULES.items())}, indent=2))
+            return 0
+        root = _lint.repo_root() if args.root is None else args.root
+        bpath = args.baseline or _lint.baseline_path(root)
+        doc, findings = _lint.run_gate(
+            root, include_parity=not args.no_parity,
+            baseline_file=bpath)
+        if args.update_baseline:
+            _lint.save_baseline(
+                bpath, [f.key for f in findings if not f.suppressed])
+            doc, findings = _lint.run_gate(
+                root, include_parity=not args.no_parity,
+                baseline_file=bpath)
+        if args.json:
+            if args.show_suppressed:
+                doc["suppressed_findings"] = [
+                    {"finding": f.render(), "reason": f.reason}
+                    for f in findings if f.suppressed]
+            print(json.dumps(doc))
+        else:
+            for line in doc["new"]:
+                print(line, file=sys.stderr)
+            if args.show_suppressed:
+                for f in findings:
+                    if f.suppressed:
+                        print(f"{f.render()} [suppressed: {f.reason}]",
+                              file=sys.stderr)
+            print(json.dumps({k: v for k, v in doc.items()
+                              if k != "new"}))
+        return 0 if doc["status"] == "ok" else 1
 
     if args.cmd == "list":
         from anomod import labels
@@ -1279,6 +1347,13 @@ def main(argv=None) -> int:
         # the recorded build-failure reason when the .so is unusable
         from anomod.io import native as native_io
         summary["native"] = native_io.status()
+        # contract health rides the validation document too (the
+        # static-analysis twin of the native block): rule inventory,
+        # live finding counts and baseline size — an operator sees a
+        # violated determinism/parity contract next to an unusable
+        # native runtime, not in a separate tool
+        from anomod.analysis import status_block as _lint_status
+        summary["lint"] = _lint_status()
         print(json.dumps(summary, indent=2))
         return 0
 

@@ -360,14 +360,21 @@ class Registry:
         if now_s is None:
             import time
             now_s = time.time()
-        n = 0
+        rows = []
         for m in self.metrics():
             series = render_labels(m.labels)
             for sname, val in m.samples():
-                self._journal.append((float(now_s), sname, series,
-                                      float(val)))
-                n += 1
-        return n
+                rows.append((float(now_s), sname, series, float(val)))
+        # journal mutation belongs under the registry lock (the L501
+        # lock-discipline contract): appending row-by-row unlocked let
+        # a concurrent scrape interleave its rows into this one's block
+        # — same torn-read family as the pre-PR-5 Histogram.samples.
+        # Rows are built FIRST (each m.samples() takes its own metric
+        # lock; never nested with ours) so the critical section is one
+        # extend.
+        with self._lock:
+            self._journal.extend(rows)
+        return len(rows)
 
     @property
     def n_samples(self) -> int:

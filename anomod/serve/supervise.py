@@ -299,6 +299,7 @@ class ShardSupervisor:
             if self._fail_counts.get(fail_key, 0) >= self.retries:
                 event["quarantined"] += self._quarantine(s, fail_key[1])
             if self.backoff_s > 0:
+                # anomod-lint: disable=D101 — respawn backoff is wall-side supervision policy (off by default); the replayed DECISIONS stay pinned byte-identical
                 time.sleep(min(self.backoff_s * (2 ** attempt), 5.0))
             self._respawn_worker(s, event)
             try:

@@ -1,0 +1,173 @@
+// Sanitizer hammer for the GIL-free staging path (PR 7).
+//
+// The hardest-to-review code in the repo is anomod_stage_lanes /
+// anomod_stage_lanes_mat: the GIL is released, pointer/stride fills land in
+// pinned scratch, and multiple shard workers stage CONCURRENTLY through one
+// shared Runtime pool (its task queue, completion Latch and thread-local
+// read buffers are the race surface).  This driver reproduces the Python
+// StagePlan fill pattern — each worker owns `depth` pinned scratch slots
+// (the pipeline-slot discipline: a slot refills only after its dispatch
+// materialized) while ALL workers share the Runtime — as a standalone
+// binary so `make tsan` / `make asan` can compile the whole native layer
+// with -fsanitize=thread/address and run it.  (A TSan-instrumented .so
+// cannot be dlopen'd into an uninstrumented CPython, so the hammer drives
+// the same extern "C" entry points natively; the byte-parity oracle below
+// is the same fill contract tests/test_native.py pins from Python.)
+//
+// Exit codes: 0 = clean, 2 = byte-parity mismatch (the fill produced wrong
+// bytes), anything else = sanitizer abort (TSAN_OPTIONS/ASAN_OPTIONS
+// exitcode).
+//
+// Build + run: make -C native tsan   (or: make -C native asan)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <atomic>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* anomod_rt_create(int32_t n_threads);
+void anomod_rt_destroy(void* rt);
+int64_t anomod_stage_lanes(void* rt_ptr, void* const* dst,
+                           const void* const* src, const int64_t* n_rows,
+                           const uint32_t* fills, int32_t n_cols,
+                           int32_t n_live, int64_t lanes, int64_t width);
+int64_t anomod_stage_lanes_mat(void* rt_ptr, void* const* dst,
+                               const void* const* bases,
+                               const int64_t* strides, const int64_t* n_rows,
+                               const uint32_t* fills, int32_t n_cols,
+                               int32_t n_live, int64_t lanes, int64_t width);
+}
+
+namespace {
+
+constexpr int kCols = 7;        // STAGE_KEYS: the serve plane's 7 columns
+
+// deterministic per-thread source data (no global RNG: the hammer itself
+// honors the determinism contract it guards)
+inline uint32_t lcg(uint32_t& s) { return s = s * 1664525u + 1013904223u; }
+
+struct Slot {
+    // one pinned scratch slot: kCols column buffers of [lanes, width]
+    std::vector<std::vector<uint32_t>> cols;
+    explicit Slot(int64_t lanes, int64_t width)
+        : cols(kCols, std::vector<uint32_t>((size_t)(lanes * width),
+                                            0xdeadbeefu)) {}
+};
+
+// the fill contract (tests/test_native.py's Python oracle, restated):
+// live rows byte-copied, row tails + dead lanes = the column fill
+bool verify(const Slot& slot, const std::vector<std::vector<uint32_t>>& src,
+            const std::vector<int64_t>& n_rows, const uint32_t* fills,
+            int32_t n_live, int64_t lanes, int64_t width) {
+    for (int c = 0; c < kCols; ++c) {
+        const uint32_t* d = slot.cols[c].data();
+        for (int64_t i = 0; i < lanes; ++i) {
+            const int64_t m = i < n_live ? n_rows[i] : 0;
+            const uint32_t* row = d + i * width;
+            if (m > 0 && std::memcmp(row, src[(size_t)(c * n_live + i)]
+                                              .data(),
+                                     (size_t)m * 4) != 0)
+                return false;
+            for (int64_t j = m; j < width; ++j)
+                if (row[j] != fills[c]) return false;
+        }
+    }
+    return true;
+}
+
+std::atomic<int> failures{0};
+
+void worker(void* rt, int tid, int iters, int depth, int32_t n_live,
+            int64_t lanes, int64_t width) {
+    uint32_t seed = 0x9e3779b9u * (uint32_t)(tid + 1);
+    std::vector<Slot> slots;
+    for (int d = 0; d < depth; ++d) slots.emplace_back(lanes, width);
+    // column-major source slices: src[c * n_live + i] = lane i, column c
+    std::vector<std::vector<uint32_t>> src((size_t)(kCols * n_live));
+    std::vector<int64_t> n_rows((size_t)n_live);
+    uint32_t fills[kCols];
+    for (int it = 0; it < iters; ++it) {
+        for (int c = 0; c < kCols; ++c) fills[c] = lcg(seed);
+        for (int32_t i = 0; i < n_live; ++i) {
+            n_rows[(size_t)i] = (int64_t)(lcg(seed) % (uint32_t)(width + 1));
+            for (int c = 0; c < kCols; ++c) {
+                auto& s = src[(size_t)(c * n_live + i)];
+                s.resize((size_t)n_rows[(size_t)i]);
+                for (auto& v : s) v = lcg(seed);
+            }
+        }
+        Slot& slot = slots[(size_t)(it % depth)];
+        std::vector<void*> dst(kCols);
+        for (int c = 0; c < kCols; ++c) dst[c] = slot.cols[c].data();
+        std::vector<const void*> sp((size_t)(kCols * n_live));
+        for (size_t k = 0; k < sp.size(); ++k) sp[k] = src[k].data();
+        const int64_t got = anomod_stage_lanes(
+            rt, dst.data(), sp.data(), n_rows.data(), fills, kCols,
+            n_live, lanes, width);
+        if (got != (int64_t)kCols * lanes * width ||
+            !verify(slot, src, n_rows, fills, n_live, lanes, width))
+            ++failures;
+        // matrix-carrier twin: lane i's columns as rows of ONE matrix
+        // (the stage_columns_fused layout), strides = width of the lane
+        std::vector<std::vector<uint32_t>> mats((size_t)n_live);
+        std::vector<const void*> bases((size_t)n_live);
+        std::vector<int64_t> strides((size_t)n_live);
+        for (int32_t i = 0; i < n_live; ++i) {
+            const int64_t m = n_rows[(size_t)i];
+            auto& mat = mats[(size_t)i];
+            mat.resize((size_t)(kCols * (m > 0 ? m : 1)));
+            strides[(size_t)i] = m > 0 ? m : 1;
+            for (int c = 0; c < kCols; ++c)
+                for (int64_t j = 0; j < m; ++j)
+                    mat[(size_t)(c * strides[(size_t)i] + j)] =
+                        src[(size_t)(c * n_live + i)][(size_t)j];
+            bases[(size_t)i] = mat.data();
+        }
+        Slot& slot2 = slots[(size_t)((it + 1) % depth)];
+        for (int c = 0; c < kCols; ++c) dst[c] = slot2.cols[c].data();
+        const int64_t got2 = anomod_stage_lanes_mat(
+            rt, dst.data(), bases.data(), strides.data(), n_rows.data(),
+            fills, kCols, n_live, lanes, width);
+        if (got2 != (int64_t)kCols * lanes * width ||
+            !verify(slot2, src, n_rows, fills, n_live, lanes, width))
+            ++failures;
+    }
+}
+
+int hammer(int n_workers, int iters, int depth, int32_t n_live,
+           int64_t lanes, int64_t width) {
+    void* rt = anomod_rt_create(2);     // shared pool: the race surface
+    std::vector<std::thread> ts;
+    for (int t = 0; t < n_workers; ++t)
+        ts.emplace_back(worker, rt, t, iters, depth, n_live, lanes, width);
+    for (auto& t : ts) t.join();
+    anomod_rt_destroy(rt);
+    return failures.load();
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    const int n_workers = argc > 1 ? std::atoi(argv[1]) : 4;
+    const int iters = argc > 2 ? std::atoi(argv[2]) : 40;
+    // small slots: the calling-thread fill path, many concurrent callers
+    hammer(n_workers, iters, /*depth=*/3, /*n_live=*/3, /*lanes=*/4,
+           /*width=*/64);
+    // big slots (lanes*width >= 1<<16): the Runtime pool fan-out + Latch
+    // path — per-column tasks from MULTIPLE staging calls interleave in
+    // one queue, exactly the overlap the GIL-free path exists for
+    hammer(n_workers, iters / 8 + 1, /*depth=*/2, /*n_live=*/6,
+           /*lanes=*/8, /*width=*/8192);
+    const int f = failures.load();
+    if (f) {
+        std::fprintf(stderr, "sanitize_hammer: %d byte-parity failures\n",
+                     f);
+        return 2;
+    }
+    std::printf("sanitize_hammer ok\n");
+    return 0;
+}

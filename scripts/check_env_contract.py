@@ -17,8 +17,18 @@ silently.  This gate greps the whole package (plus ``bench.py`` and
 uncovered name — including any new ``ANOMOD_OBS_*`` knob someone adds
 without teaching the Config/doc contract about it.
 
-Exit codes: 0 = every referenced var is covered, 1 = violations (listed
-in the JSON line and on stderr).  ``scripts/pre_bench_check.py`` runs
+Since PR 11 the token grep is backed by the AST scanner in
+``anomod.analysis.envscan`` (the E2xx lint rules' engine), which closes
+this script's documented false negative: a DYNAMIC key —
+``os.environ[f"ANOMOD_{name}"]``, ``os.getenv("ANOMOD_" + name)`` —
+contains no complete token for the regex to match but is statically
+provable to read an ``ANOMOD_*`` var.  Dynamic reads are reported as
+violations in their own ``dynamic`` key (they cannot be checked against
+the contract at all; route them through anomod.config).
+
+Exit codes: 0 = every referenced var is covered and no dynamic reads,
+1 = violations (listed in the JSON line and on stderr) — the exit
+contract is unchanged from PR 3.  ``scripts/pre_bench_check.py`` runs
 this before every bench gate.
 """
 
@@ -29,6 +39,9 @@ import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
+
+# the AST scanner lives in the package (shared with `anomod lint`)
+sys.path.insert(0, str(ROOT))
 
 _VAR = re.compile(r"ANOMOD_[A-Z0-9_]+")
 
@@ -54,6 +67,37 @@ def referenced_vars(root: Path) -> dict:
     return out
 
 
+def dynamic_reads(root: Path) -> dict:
+    """AST pass over the same scan set: dynamic ``ANOMOD_*`` env reads
+    (f-string/concat keys) the token grep cannot see — file ->
+    [(line, static_prefix)].  ``anomod/config.py`` is exempt: it is the
+    contract's one legitimate home for parameterized reads.  The scan
+    set is ``anomod.analysis.lint.scan_files`` — ONE definition shared
+    with the linter, so the two passes can never cover different
+    trees."""
+    from anomod.analysis.envscan import dynamic_anomod_reads
+    from anomod.analysis.lint import ModuleContext, scan_files
+    out: dict = {}
+    # exactly anomod/config.py — the same exemption the E2xx lint rule
+    # applies; a basename match would also exempt some future
+    # anomod/serve/config.py and let the two gates diverge
+    exempt = (root / "anomod" / "config.py").resolve()
+    for p in scan_files(root):
+        if p.resolve() == exempt:
+            continue
+        rel = str(p.relative_to(root))
+        try:
+            # a full ModuleContext (not a bare ast.parse): its import
+            # table is what resolves `import os as _os` aliased reads
+            ctx = ModuleContext(p.read_text(errors="replace"), rel)
+        except SyntaxError:
+            continue
+        got = dynamic_anomod_reads(ctx.tree, ctx)
+        if got:
+            out[rel] = [[r.line, r.prefix] for r in got]
+    return out
+
+
 def covered_vars(root: Path) -> str:
     """The coverage corpus: the Config module + every markdown doc."""
     parts = []
@@ -74,18 +118,28 @@ def main(argv=None) -> int:
     corpus = covered_vars(root)
     missing = {name: sorted(files) for name, files in sorted(refs.items())
                if name not in corpus}
+    dynamic = dynamic_reads(root)
+    bad = bool(missing or dynamic)
     out = {"check": "env_contract", "n_vars": len(refs),
-           "n_missing": len(missing),
-           "status": "ok" if not missing else "uncovered-env-vars"}
+           "n_missing": len(missing), "n_dynamic": len(dynamic),
+           "status": "ok" if not bad else "uncovered-env-vars"}
     if missing:
         out["missing"] = missing
+    if dynamic:
+        out["dynamic"] = dynamic
     print(json.dumps(out))
-    if missing:
+    if bad:
         for name, files in missing.items():
             print(f"check_env_contract: {name} (read in "
                   f"{', '.join(files)}) is neither in the Config env "
                   "contract (anomod/config.py) nor documented "
                   "(README.md / docs/*.md)", file=sys.stderr)
+        for fname, sites in dynamic.items():
+            for line, prefix in sites:
+                print(f"check_env_contract: {fname}:{line} reads a "
+                      f"DYNAMIC ANOMOD_* env var (key built from "
+                      f"{prefix!r}...) — statically uncheckable; route "
+                      "it through anomod.config", file=sys.stderr)
         return 1
     return 0
 

@@ -33,6 +33,12 @@ byte-for-byte, and a requested-but-unusable runtime (``ANOMOD_NATIVE=1``
 on a box without a toolchain) fails with the recorded build reason —
 exit 5, distinct from the generic serve failure, so a driver can tell
 "install g++ or unset ANOMOD_NATIVE" from "the bucket grid is broken".
+When staging is in play the gate also runs the ThreadSanitizer staging
+smoke (``scripts/native_sanitize_smoke.py``: the whole native layer
+rebuilt ``-fsanitize=thread`` + the concurrent StagePlan-pattern fill
+hammer); a detected race is exit 5 too (a racy staging runtime must
+not serve), and a toolchain without sanitizer support SKIPs with its
+reason recorded in the JSON line.
 
 Serve mode also runs a <5 s tenant-state RESIDENCY parity smoke: the
 same tiny seeded multi-tick run on the device pool
@@ -71,6 +77,11 @@ and the table in docs/BENCHMARKS.md mirrors them):
 - ``EXIT_RECOVERY_DIVERGENCE`` (8): the crash→respawn→audit-diff smoke
   found a score gap — a recovered run's canonical journal diverged
   from the fault-free run of the same seed
+- ``EXIT_LINT`` (9): the contract linter / parity-surface audit
+  (``scripts/check_contracts.py``, docs/CONTRACTS.md) found a new
+  unsuppressed, unbaselined violation — a capture of a tree with a
+  broken determinism or parity contract is not reproducible from its
+  record.  Both modes run this gate right after the env contract.
 
 Always prints one JSON line describing the decision (plus the contract
 gate's line).  ``--traces`` must match the bench invocation's span
@@ -96,6 +107,7 @@ EXIT_NATIVE_UNUSABLE = 5
 EXIT_STATE_POOL_UNUSABLE = 6
 EXIT_FLIGHT_DIVERGENCE = 7
 EXIT_RECOVERY_DIVERGENCE = 8
+EXIT_LINT = 9
 
 
 def _shard_fanout_smoke() -> dict:
@@ -313,6 +325,23 @@ def check_serve() -> int:
             return EXIT_NATIVE_UNUSABLE
         if out["native"]["staging"]:
             out["native"]["smoke"] = _native_smoke()
+            # TSan leg: rebuild the staging layer -fsanitize=thread and
+            # run the concurrent-fill hammer (native/sanitize_hammer.
+            # cpp).  A detected race means the GIL-free staging runtime
+            # must not serve (same exit as unusable); a box whose
+            # toolchain can't build sanitized binaries SKIPs with the
+            # recorded reason — never silently.
+            import native_sanitize_smoke as nss
+            tsan = nss.run("tsan", workers=4, iters=20)
+            out["native"]["tsan"] = tsan
+            if tsan["status"] == "fail":
+                out["status"] = "native-sanitize-failed"
+                print(json.dumps(out))
+                print("pre_bench_check: the native staging sanitize "
+                      f"smoke failed — {tsan.get('reason')} — run "
+                      "`make -C native tsan` for the full report; do "
+                      "not serve this runtime", file=sys.stderr)
+                return EXIT_NATIVE_UNUSABLE
         from anomod.serve.batcher import BucketRunner
         from anomod.serve.engine import serve_plane_cfg
         # tenant-state residency: a FORCED device pool that cannot even
@@ -449,6 +478,20 @@ def main(argv=None) -> int:
               "scripts/check_env_contract.py and fix the listed ANOMOD_* "
               "vars (Config or docs) before capturing", file=sys.stderr)
         return EXIT_ENV_CONTRACT
+
+    # contract lint + parity-surface audit (static AST — milliseconds,
+    # never touches the backend): a capture of a tree violating a
+    # determinism/seam/parity contract is not reproducible from its
+    # record, so both modes gate on it
+    import check_contracts
+    lint_doc = check_contracts.run()
+    if lint_doc["status"] != "ok":
+        print(json.dumps({"check": "pre_bench_contracts", **lint_doc}))
+        print("pre_bench_check: contract lint failed — run `anomod "
+              "lint`, then fix each finding in place, add a reasoned "
+              "inline suppression, or baseline it deliberately "
+              "(docs/CONTRACTS.md)", file=sys.stderr)
+        return EXIT_LINT
 
     if args.mode == "serve":
         return check_serve()

@@ -1,0 +1,15 @@
+"""Must-pass fixture for the D1xx determinism family: seeded RNG,
+wall-leg perf_counter form, sorted set iteration."""
+import time
+
+import numpy as np
+
+
+def decide(seed, tenant, window, tenants):
+    t0 = time.perf_counter()
+    rng = np.random.default_rng((seed, tenant, window))
+    order = sorted(set(tenants))
+    draws = rng.random(len(order))
+    t_wall = time.perf_counter()
+    wall_s = time.perf_counter() - t0
+    return draws, order, wall_s, time.perf_counter() - t_wall

@@ -1,0 +1,26 @@
+"""Must-trip fixture for the D1xx determinism family (linted under a
+pretend canonical path, e.g. anomod/serve/fixture.py)."""
+import random
+import time
+
+import numpy as np
+import numpy.random
+
+
+def dotted_import_is_not_an_alias_hole():
+    # `import numpy.random` binds the root name `numpy`: the unseeded
+    # call below must still resolve to numpy.random.default_rng
+    return numpy.random.default_rng()       # D103: unseeded
+
+
+def decide(tenants):
+    stamp = time.time()                     # D101: wall clock
+    rng = np.random.default_rng()           # D103: unseeded
+    jitter = random.random()                # D103: process-global RNG
+    legacy = np.random.rand(3)              # D103: legacy global API
+    keyed = {id(t): t for t in tenants}     # D104: address-keyed
+    order = list(set(tenants))              # D105: set order
+    deadline = time.perf_counter() + 5.0    # D102: not wall-leg form
+    for t in set(tenants):                  # D105: set iteration
+        stamp += t
+    return stamp, rng, jitter, legacy, keyed, order, deadline

@@ -1,0 +1,8 @@
+"""Must-pass fixture for the E2xx env-contract family: covered ANOMOD_*
+reads (the test hands the linter a corpus naming ANOMOD_KNOWN_KNOB) and
+non-ANOMOD reads, which are out of contract."""
+import os
+
+a = os.environ.get("ANOMOD_KNOWN_KNOB", "")
+b = os.environ.get("PATH", "")
+c = os.getenv("HOME")

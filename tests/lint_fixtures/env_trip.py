@@ -1,0 +1,12 @@
+"""Must-trip fixture for the E2xx env-contract family: an uncovered
+constant read, plus the dynamic f-string/concat reads the old grep
+gate could not see (its documented false negative)."""
+import os
+from os import environ
+
+name = "SHARDS"
+a = os.environ.get("ANOMOD_ROGUE_KNOB")     # E201: uncovered
+b = environ[f"ANOMOD_{name}"]               # E202: dynamic (f-string)
+c = os.getenv("ANOMOD_" + name)             # E202: dynamic (concat)
+env_alias = os.environ
+d = env_alias["ANOMOD_ALIASED_ROGUE"]       # E201: via alias

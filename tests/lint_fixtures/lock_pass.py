@@ -1,0 +1,22 @@
+"""Must-pass fixture for L501: mutations under the lock, reads free,
+and the *_locked caller-holds-lock idiom."""
+import threading
+
+
+class Reg:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+        self.count = 0
+
+    def record(self, v):
+        with self._lock:
+            self._rows.append(v)
+            self.count += 1
+            self._fold_locked()
+
+    def _fold_locked(self):
+        self._rows.clear()
+
+    def peek(self):
+        return self.count

@@ -1,0 +1,20 @@
+"""Must-trip fixture for L501 (linted under a pretend lock-owning path,
+e.g. anomod/obs/registry.py): shared-state mutation outside the lock."""
+import threading
+
+
+class Reg:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+        self.count = 0
+
+    def record(self, v):
+        self._rows.append(v)        # L501: unlocked append
+        self.count += 1             # L501: unlocked increment
+
+    def install(self, key, v):
+        self._rows[0] = (key, v)    # L501: unlocked subscript store
+
+    def reset(self):
+        self._rows, self.count = [], 0   # L501: unlocked tuple unpack

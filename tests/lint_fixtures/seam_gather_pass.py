@@ -1,0 +1,10 @@
+"""Must-pass fixture for S302: gathers that honor always-copy."""
+import numpy as np
+
+
+class Pool:
+    def gather(self, slot):
+        return self.agg[slot].copy()
+
+    def gather_rows(self, slots):
+        return np.asarray(self.hist[slots])

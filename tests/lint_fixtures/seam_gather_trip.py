@@ -1,0 +1,10 @@
+"""Must-trip fixture for S302 (linted under a pretend SEAM path, e.g.
+anomod/replay.py): a gather returning an aliased pool-plane row."""
+
+
+class Pool:
+    def gather(self, slot):
+        return self.agg[slot]                   # S302: aliased row
+
+    def gather_rows(self, slots):
+        return self.hist[slots]                 # S302: aliased rows

@@ -1,0 +1,5 @@
+"""Must-pass fixture for S301: the same work through the seam."""
+
+
+def drain(replays):
+    return [r.get_state() for r in replays]

@@ -1,0 +1,8 @@
+"""Must-trip fixture for S301 (linted under a pretend NON-seam path):
+pool internals reached around the get_state/set_state/gather seam."""
+
+
+def drain(replays, runner):
+    slots = [r._slot for r in replays]          # S301
+    runner._slots.clear()                       # S301
+    return replays[0]._runner, slots            # S301

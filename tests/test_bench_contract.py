@@ -295,6 +295,7 @@ def test_pre_bench_exit_codes_named_and_unique():
         "EXIT_SERVE_PRECONDITION": 3, "EXIT_ENV_CONTRACT": 4,
         "EXIT_NATIVE_UNUSABLE": 5, "EXIT_STATE_POOL_UNUSABLE": 6,
         "EXIT_FLIGHT_DIVERGENCE": 7, "EXIT_RECOVERY_DIVERGENCE": 8,
+        "EXIT_LINT": 9,
     }
     # every literal return in the gate's source goes through a constant
     src = (Path(__file__).parent.parent / "scripts"
