@@ -429,6 +429,29 @@ def main(argv=None) -> int:
                               "supervised no-score-gap recovery "
                               "(default: ANOMOD_SERVE_CKPT_EVERY; "
                               "0 disables supervision)")
+    p_serve.add_argument("--policy", choices=["off", "auto", "script"],
+                         default=None,
+                         help="elastic scaling policy "
+                              "(anomod.serve.policy): auto = signal-fed "
+                              "autoscaler at every tick boundary, "
+                              "script = fixed schedule from "
+                              "--policy-script; scaling episodes are "
+                              "seed-deterministic and leave tenant "
+                              "states/alerts/SLO/shed byte-identical to "
+                              "a static run (default: "
+                              "ANOMOD_SERVE_POLICY)")
+    p_serve.add_argument("--policy-script", default=None,
+                         help="scaling schedule for --policy script, "
+                              "e.g. 'up@10;rebalance@25:k=2;down@40' "
+                              "(default: ANOMOD_SERVE_POLICY_SCRIPT)")
+    p_serve.add_argument("--min-shards", type=int, default=None,
+                         help="elastic scale-down floor (default: "
+                              "ANOMOD_SERVE_POLICY_MIN_SHARDS)")
+    p_serve.add_argument("--max-shards", type=int, default=None,
+                         help="elastic scale-up ceiling (default: "
+                              "ANOMOD_SERVE_POLICY_MAX_SHARDS; past it "
+                              "sustained overload climbs the brownout "
+                              "ladder)")
     p_serve.add_argument("--devices", type=int, default=0,
                          help="serve over an N-device mesh plane "
                               "(ShardedStreamReplay per tenant; use "
@@ -920,21 +943,57 @@ def main(argv=None) -> int:
             parser.error("shard supervision cannot checkpoint the mesh "
                          "plane's sharded state; --devices runs with "
                          "--ckpt-every 0")
+        from anomod.config import get_config
+        policy_mode = (args.policy if args.policy is not None
+                       else get_config().serve_policy)
+        if args.policy_script is not None:
+            from anomod.config import validate_policy_script
+            try:
+                validate_policy_script(args.policy_script)
+            except ValueError as e:
+                parser.error(f"--policy-script: {e}")
+            if policy_mode != "script":
+                parser.error("--policy-script applies to --policy "
+                             "script (it would be silently ignored)")
+        for flag, val in (("--min-shards", args.min_shards),
+                          ("--max-shards", args.max_shards)):
+            if val is not None:
+                if policy_mode == "off":
+                    parser.error(f"{flag} applies to an elastic policy "
+                                 "(--policy auto|script)")
+                if val < 1:
+                    parser.error(f"{flag} must be >= 1")
+        if args.devices and args.policy is not None \
+                and args.policy != "off":
+            # only an EXPLICIT --policy conflicts hard; an env-sourced
+            # ANOMOD_SERVE_POLICY=auto degrades to off at the engine
+            # (the mesh plane is outside the migration seams — the
+            # supervision idiom), so existing --devices workflows keep
+            # working under a globally exported policy
+            parser.error("the elastic policy migrates tenants through "
+                         "the bucket-runner state seams; --devices "
+                         "runs with --policy off")
         if args.chaos:
-            from anomod.config import get_config, validate_chaos_script
+            from anomod.config import validate_chaos_script
             try:
                 faults = validate_chaos_script(args.chaos)
             except ValueError as e:
                 parser.error(f"--chaos: {e}")
             n_sh = (args.shards if args.shards is not None
                     else get_config().serve_shards)
+            if policy_mode != "off":
+                # an elastic run can legitimately target any shard id
+                # the scale-up ceiling makes reachable
+                n_sh = max(n_sh, args.max_shards
+                           if args.max_shards is not None
+                           else get_config().serve_policy_max_shards)
             bad = sorted({f["shard"] for f in faults
-                          if f["shard"] >= n_sh})
+                          if f["kind"] != "surge" and f["shard"] >= n_sh})
             if bad:
                 parser.error(
                     f"--chaos targets shard(s) {bad} but the run has "
-                    f"{n_sh} shard(s) (ids 0..{n_sh - 1}) — the "
-                    "fault(s) could never fire")
+                    f"{n_sh} reachable shard(s) (ids 0..{n_sh - 1}) — "
+                    "the fault(s) could never fire")
         _probe_backend(args)
         from anomod.serve.batcher import validate_buckets
         from anomod.serve.engine import run_power_law
@@ -979,6 +1038,8 @@ def main(argv=None) -> int:
             native=False if args.no_native else None,
             state=args.state, chaos=args.chaos,
             ckpt_every=args.ckpt_every,
+            policy=args.policy, policy_script=args.policy_script,
+            min_shards=args.min_shards, max_shards=args.max_shards,
             # --no-score forces RCA off even when ANOMOD_SERVE_RCA=1
             # (the explicit CLI ask wins over the env default; the
             # --rca + --no-score combination already parser.error'd)

@@ -443,12 +443,16 @@ def _flight_dump_dir_env() -> Optional[Path]:
 #: of the paper's injected-fault campaigns, aimed at the serve plane
 #: itself): ``crash`` kills the shard WORKER THREAD mid-tick, ``except``
 #: raises a plain exception at a score-path phase, ``stall`` sleeps
-#: (slow-shard), ``poolput`` fails the state-pool fold.  Phases are the
-#: score path's five injection points.
-CHAOS_KINDS = ("crash", "except", "stall", "poolput")
+#: (slow-shard), ``poolput`` fails the state-pool fold, ``surge``
+#: multiplies the fleet's offered arrivals for a window of ticks (the
+#: load-shift taxonomy — what forces elastic-policy scaling episodes).
+#: Phases are the score path's five injection points; a surge has no
+#: phase (it acts on admission input, before the score path exists).
+CHAOS_KINDS = ("crash", "except", "stall", "poolput", "surge")
 CHAOS_PHASES = ("stage", "dispatch", "fold", "score", "commit")
 _CHAOS_DEFAULT_PHASE = {"crash": "dispatch", "except": "dispatch",
-                        "stall": "stage", "poolput": "fold"}
+                        "stall": "stage", "poolput": "fold",
+                        "surge": "stage"}
 
 
 def validate_chaos_script(script: str) -> list:
@@ -461,6 +465,13 @@ def validate_chaos_script(script: str) -> list:
     milliseconds, default 10), ``repeat`` (how many ATTEMPTS of that
     tick's slice the fault fires on — 1 by default so a recovery retry
     succeeds; ``-1`` = every attempt forever, the quarantine probe).
+    A ``surge`` item instead takes ``factor`` (arrival multiplier,
+    default 4) and ``ticks`` (duration, default 10): from its origin
+    tick, every tenant's offered arrivals are replicated ``factor``×
+    for ``ticks`` ticks — a deterministic fleet-wide load shift (the
+    elastic-policy episode probe).  Score-path keys on a surge (and
+    surge keys on a score-path fault) are refused: a silently-inert
+    knob is worse than an error.
     Returns the parsed fault dicts; raises ``ValueError`` with the
     offending item on any malformed script — the same fail-loud contract
     as every other serve knob.  Lives HERE (pure string parsing) so
@@ -484,14 +495,16 @@ def validate_chaos_script(script: str) -> list:
             raise ValueError(f"chaos item {item!r}: tick must be >= 0")
         fault = {"kind": kind, "tick": tick_i, "shard": 0,
                  "phase": _CHAOS_DEFAULT_PHASE[kind], "ms": 10.0,
-                 "repeat": 1}
+                 "repeat": 1, "factor": 4, "ticks": 10}
+        allowed = (("factor", "ticks") if kind == "surge"
+                   else ("shard", "phase", "ms", "repeat"))
         for kv in (p.strip() for p in tail.split(":") if p.strip()):
             key, eq, val = kv.partition("=")
             key = key.strip().lower()
-            if not eq or key not in ("shard", "phase", "ms", "repeat"):
+            if not eq or key not in allowed:
                 raise ValueError(
                     f"chaos item {item!r}: unknown key {kv!r} (want "
-                    "shard=/phase=/ms=/repeat=)")
+                    + "/".join(f"{k}=" for k in allowed) + ")")
             try:
                 if key == "phase":
                     val = val.strip().lower()
@@ -515,6 +528,12 @@ def validate_chaos_script(script: str) -> list:
         if fault["repeat"] < -1 or fault["repeat"] == 0:
             raise ValueError(f"chaos item {item!r}: repeat must be a "
                              "positive count or -1 (forever)")
+        if not 2 <= fault["factor"] <= 64:
+            raise ValueError(f"chaos item {item!r}: surge factor must "
+                             f"be in [2, 64], got {fault['factor']}")
+        if not 1 <= fault["ticks"] <= 1_000_000:
+            raise ValueError(f"chaos item {item!r}: surge ticks must "
+                             f"be in [1, 1000000], got {fault['ticks']}")
         faults.append(fault)
     return faults
 
@@ -535,6 +554,169 @@ def _serve_chaos_env() -> str:
     if raw:
         validate_chaos_script(raw)
     return raw
+
+
+#: elastic-policy decision taxonomy (anomod.serve.policy): ``up`` grows
+#: the shard set by one worker, ``down`` drains and retires the highest
+#: shard, ``rebalance`` moves the top-K hottest tenants off the most-
+#: loaded shard, ``brownout`` forces a degradation-ladder level.
+POLICY_ACTIONS = ("up", "down", "rebalance", "brownout")
+
+
+def validate_policy_script(script: str) -> list:
+    """Parse/validate an ``ANOMOD_SERVE_POLICY_SCRIPT`` scaling script.
+
+    Grammar: semicolon-separated ``ACTION@TICK[:key=value]`` items with
+    ACTION in :data:`POLICY_ACTIONS`, e.g.
+    ``up@10;rebalance@25:k=2;down@40;brownout@50:level=1``.  Keys:
+    ``k`` (rebalance move count, default 1), ``level`` (brownout ladder
+    level 0..2, default 1); any key on the wrong action is refused (a
+    silently-inert knob is worse than an error).  The engine executes
+    each action at its tick (clamped by the min/max-shards envelope,
+    journaled either way).  Same fail-loud contract as the chaos
+    grammar; lives HERE (pure string parsing) so Config() never pays
+    the serve import chain.
+    """
+    actions = []
+    for item in (p.strip() for p in str(script).split(";") if p.strip()):
+        head, _, tail = item.partition(":")
+        act, at, tick = head.partition("@")
+        act = act.strip().lower()
+        if act not in POLICY_ACTIONS or not at:
+            raise ValueError(
+                f"policy item {item!r}: expected ACTION@TICK with "
+                f"ACTION in {'/'.join(POLICY_ACTIONS)}")
+        try:
+            tick_i = int(tick)
+        except ValueError:
+            raise ValueError(f"policy item {item!r}: tick must be an "
+                             f"integer, got {tick!r}")
+        if tick_i < 0:
+            raise ValueError(f"policy item {item!r}: tick must be >= 0")
+        entry = {"action": act, "tick": tick_i, "k": 1, "level": 1}
+        allowed = {"rebalance": ("k",), "brownout": ("level",)} \
+            .get(act, ())
+        for kv in (p.strip() for p in tail.split(":") if p.strip()):
+            key, eq, val = kv.partition("=")
+            key = key.strip().lower()
+            if not eq or key not in allowed:
+                raise ValueError(
+                    f"policy item {item!r}: unknown key {kv!r}"
+                    + (f" (want {'/'.join(f'{k}=' for k in allowed)})"
+                       if allowed else f" ({act} takes no keys)"))
+            try:
+                entry[key] = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"policy item {item!r}: bad value for {key!r}: "
+                    f"{val!r}")
+        if not 1 <= entry["k"] <= 1024:
+            raise ValueError(f"policy item {item!r}: k must be in "
+                             f"[1, 1024], got {entry['k']}")
+        if not 0 <= entry["level"] <= 2:
+            raise ValueError(f"policy item {item!r}: level must be in "
+                             f"[0, 2], got {entry['level']}")
+        actions.append(entry)
+    return actions
+
+
+def _serve_policy_env() -> str:
+    """ANOMOD_SERVE_POLICY: the serving plane's elastic scaling policy
+    (anomod.serve.policy).
+
+    ``off`` (the default) is the static engine — the shard count never
+    changes and the policy plane costs nothing.  ``auto`` evaluates the
+    signal-fed autoscaler at every tick boundary on the coordinator:
+    scale-up / scale-down / rebalance / brownout decisions with
+    hysteresis and cooldown, fed ONLY canonical (seed-deterministic)
+    signals, executed through the live-migration seams — tenant states,
+    alerts, SLO and shed stay byte-identical to a static run of the
+    same seed.  ``script`` executes a fixed scaling schedule from
+    ``ANOMOD_SERVE_POLICY_SCRIPT`` instead of the signals (the
+    episode-replay probe).  Validated here so a typo fails loudly at
+    config construction instead of silently serving static.
+    """
+    raw = _env("ANOMOD_SERVE_POLICY", "off").strip().lower()
+    if raw in ("off", ""):
+        return "off"
+    if raw in ("auto", "script"):
+        return raw
+    raise ValueError(
+        f"ANOMOD_SERVE_POLICY must be off, auto or script, got {raw!r}")
+
+
+def _serve_policy_script_env() -> str:
+    """ANOMOD_SERVE_POLICY_SCRIPT: the fixed scaling schedule
+    ``ANOMOD_SERVE_POLICY=script`` executes (anomod.serve.policy).
+
+    Empty (the default) = no schedule — the script MODE then refuses at
+    the engine (an empty scripted policy is a misconfiguration, not a
+    quiet static run).  Otherwise a semicolon-separated action script
+    (``up@10;down@40;rebalance@25:k=2`` — see
+    :func:`validate_policy_script`), validated here so a typo fails
+    loudly at config construction.
+    """
+    raw = _env("ANOMOD_SERVE_POLICY_SCRIPT", "").strip()
+    if raw:
+        validate_policy_script(raw)
+    return raw
+
+
+def _serve_policy_int_env(name: str, default: str, lo: int,
+                          hi: int) -> int:
+    """Shared validator for the bounded integer policy knobs."""
+    raw = _env(name, default)
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}")
+    if not lo <= n <= hi:
+        raise ValueError(f"{name} must be in [{lo}, {hi}], got {n}")
+    return n
+
+
+def _serve_policy_min_shards_env() -> int:
+    """ANOMOD_SERVE_POLICY_MIN_SHARDS: the elastic policy's scale-down
+    floor — ``down`` decisions never shrink the shard set below it."""
+    return _serve_policy_int_env("ANOMOD_SERVE_POLICY_MIN_SHARDS", "1",
+                                 1, 256)
+
+
+def _serve_policy_max_shards_env() -> int:
+    """ANOMOD_SERVE_POLICY_MAX_SHARDS: the elastic policy's scale-up
+    ceiling — ``up`` decisions never grow the shard set past it (the
+    brownout ladder takes over once load persists at the ceiling)."""
+    return _serve_policy_int_env("ANOMOD_SERVE_POLICY_MAX_SHARDS", "8",
+                                 1, 256)
+
+
+def _serve_policy_target_imbalance_env() -> float:
+    """ANOMOD_SERVE_POLICY_TARGET_IMBALANCE: the max-shard-load /
+    mean-shard-load ratio (over the live served-rate EWMAs) past which
+    the auto policy triggers a rebalance pass.  1.0 would rebalance on
+    any skew; the default tolerates the skew a power-law head tenant
+    makes unavoidable."""
+    raw = _env("ANOMOD_SERVE_POLICY_TARGET_IMBALANCE", "1.5")
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_POLICY_TARGET_IMBALANCE must be a number, "
+            f"got {raw!r}")
+    if not 1.0 <= v <= 100.0:
+        raise ValueError(
+            f"ANOMOD_SERVE_POLICY_TARGET_IMBALANCE must be in "
+            f"[1.0, 100.0], got {v}")
+    return v
+
+
+def _serve_policy_cooldown_env() -> int:
+    """ANOMOD_SERVE_POLICY_COOLDOWN_TICKS: minimum ticks between
+    executed scaling decisions (scale-up/down/rebalance) — the
+    anti-thrash half of the hysteresis contract.  Brownout ladder
+    steps pace on the same cooldown."""
+    return _serve_policy_int_env("ANOMOD_SERVE_POLICY_COOLDOWN_TICKS",
+                                 "8", 1, 100_000)
 
 
 def _serve_ckpt_every_env() -> int:
@@ -791,6 +973,29 @@ class Config:
     # ANOMOD_SERVE_CHAOS — scripted serve-plane fault injection
     # (anomod.serve.chaos; "" = off, else a validated fault script).
     serve_chaos: str = dataclasses.field(default_factory=_serve_chaos_env)
+    # ANOMOD_SERVE_POLICY — elastic scaling policy: off (static), auto
+    # (signal-fed autoscaler), script (fixed schedule from
+    # ANOMOD_SERVE_POLICY_SCRIPT; anomod.serve.policy).
+    serve_policy: str = dataclasses.field(default_factory=_serve_policy_env)
+    # ANOMOD_SERVE_POLICY_SCRIPT — the scripted scaling schedule
+    # ("" = none; validated action grammar, see validate_policy_script).
+    serve_policy_script: str = dataclasses.field(
+        default_factory=_serve_policy_script_env)
+    # ANOMOD_SERVE_POLICY_MIN_SHARDS — elastic scale-down floor.
+    serve_policy_min_shards: int = dataclasses.field(
+        default_factory=_serve_policy_min_shards_env)
+    # ANOMOD_SERVE_POLICY_MAX_SHARDS — elastic scale-up ceiling (past
+    # it sustained overload climbs the brownout ladder instead).
+    serve_policy_max_shards: int = dataclasses.field(
+        default_factory=_serve_policy_max_shards_env)
+    # ANOMOD_SERVE_POLICY_TARGET_IMBALANCE — max/mean shard-load ratio
+    # past which the auto policy rebalances (live served-rate EWMAs).
+    serve_policy_target_imbalance: float = dataclasses.field(
+        default_factory=_serve_policy_target_imbalance_env)
+    # ANOMOD_SERVE_POLICY_COOLDOWN_TICKS — minimum ticks between
+    # executed scaling decisions (the anti-thrash hysteresis half).
+    serve_policy_cooldown_ticks: int = dataclasses.field(
+        default_factory=_serve_policy_cooldown_env)
     # ANOMOD_SERVE_CKPT_EVERY — shard-checkpoint cadence in ticks
     # (anomod.serve.supervise; 0 = supervision off, faults fail the
     # tick as before).

@@ -69,14 +69,18 @@ FLIGHT_FORMAT = 1
 PLANES: Tuple[str, ...] = ("admission", "dispatch", "fold", "score", "rca")
 
 #: per-tick keys excluded from the canonical byte surface and from
-#: ``diff``: wall-clock measurements, shard/lane grouping topology, and
-#: the supervisor's recovery events (what crashed/respawned/migrated is
+#: ``diff``: wall-clock measurements, shard/lane grouping topology, the
+#: supervisor's recovery events (what crashed/respawned/migrated is
 #: execution-strategy forensics — the no-score-gap contract pins the
 #: DECISION planes of a recovered run equal to fault-free, so recovery
-#: marks must never touch them) — the flight twin of the serving
-#: plane's SHARD_VARIANT_REPORT_FIELDS (one definition, shared by
+#: marks must never touch them), and the elastic policy's scaling
+#: events (what scaled up/down/rebalanced is likewise execution
+#: topology: an elastic run's canonical planes stay equal to a static
+#: run's) — the flight twin of the serving plane's
+#: SHARD_VARIANT_REPORT_FIELDS (one definition, shared by
 #: canonical_ticks, the parity tests and the pre-bench flight smoke).
-FLIGHT_VARIANT_KEYS: Tuple[str, ...] = ("walls", "topology", "recovery")
+FLIGHT_VARIANT_KEYS: Tuple[str, ...] = ("walls", "topology", "recovery",
+                                        "scaling")
 
 
 def crc_text(text: str, prev: int = 0) -> int:

@@ -22,7 +22,12 @@ per seed and identical at every shard count.  Fault tolerance
 cadenced checkpoint/restore through the get_state seam and deterministic
 re-execution (supervise) — a mid-tick shard crash recovers with NO score
 gap, byte-identical to fault-free — proven against scripted chaos aimed
-at the serve plane itself (chaos, ANOMOD_SERVE_CHAOS).
+at the serve plane itself (chaos, ANOMOD_SERVE_CHAOS).  Elastic
+serving (ANOMOD_SERVE_POLICY): a signal-fed autoscaler evaluated at
+every tick boundary drives scale-up/down/rebalance/brownout through
+the same migration seams at POLICY time (policy) — scaling episodes
+are seed-deterministic (same schedule under rerun and audit replay)
+and leave states/alerts/SLO/shed byte-identical to a static run.
 """
 
 from anomod.serve.batcher import (BucketedStreamReplay, BucketRunner,
@@ -30,6 +35,7 @@ from anomod.serve.batcher import (BucketedStreamReplay, BucketRunner,
 from anomod.serve.engine import ServeEngine, ServeReport, VirtualClock
 from anomod.serve.queues import AdmissionController, QueuedBatch, TenantSpec
 from anomod.serve.chaos import ChaosFault, ChaosWorkerCrash, ServeChaos
+from anomod.serve.policy import ElasticPolicy, TickSignals, plan_rebalance
 from anomod.serve.rca import OnlineRCA, RCAVerdict, RcaRunner
 from anomod.serve.shard import ShardWorker, plan_shards, rendezvous_shard
 from anomod.serve.supervise import ShardSupervisor
@@ -37,9 +43,10 @@ from anomod.serve.traffic import PowerLawTraffic, ScriptedTraffic
 
 __all__ = [
     "AdmissionController", "BucketRunner", "BucketedStreamReplay",
-    "ChaosFault", "ChaosWorkerCrash", "OnlineRCA", "PowerLawTraffic",
-    "QueuedBatch", "RCAVerdict", "RcaRunner", "ScriptedTraffic",
-    "ServeChaos", "ServeEngine", "ServeReport", "ShardSupervisor",
-    "ShardWorker", "TenantSpec", "VirtualClock", "plan_shards",
+    "ChaosFault", "ChaosWorkerCrash", "ElasticPolicy", "OnlineRCA",
+    "PowerLawTraffic", "QueuedBatch", "RCAVerdict", "RcaRunner",
+    "ScriptedTraffic", "ServeChaos", "ServeEngine", "ServeReport",
+    "ShardSupervisor", "ShardWorker", "TenantSpec", "TickSignals",
+    "VirtualClock", "plan_rebalance", "plan_shards",
     "rendezvous_shard", "split_plan",
 ]

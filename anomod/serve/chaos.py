@@ -58,7 +58,7 @@ class ChaosWorkerCrash(ChaosFault):
 
 class _Fault:
     __slots__ = ("kind", "tick", "shard", "phase", "ms", "repeat",
-                 "fired")
+                 "factor", "ticks", "fired")
 
     def __init__(self, spec: dict):
         self.kind = spec["kind"]
@@ -67,6 +67,8 @@ class _Fault:
         self.phase = spec["phase"]
         self.ms = spec["ms"]
         self.repeat = spec["repeat"]
+        self.factor = spec["factor"]
+        self.ticks = spec["ticks"]
         self.fired = 0
 
 
@@ -87,13 +89,35 @@ class ServeChaos:
             "anomod_serve_chaos_injected_total")
         self._obs_stalls = obs.counter("anomod_serve_chaos_stalls_total")
 
+    def surge_factor(self, tick: int) -> int:
+        """The fleet-wide arrival multiplier at virtual ``tick`` — the
+        product of every active ``surge`` fault's factor (surges are
+        deterministic functions of the tick index alone, so a replay of
+        the same script amplifies the same arrivals).  The first tick
+        of each surge counts as one injection (the never-a-silent-
+        fault contract: a surge that shows up nowhere reads as 'the
+        policy scaled for no reason')."""
+        factor = 1
+        for f in self.faults:
+            if f.kind != "surge" or not f.tick <= tick < f.tick + f.ticks:
+                continue
+            factor *= f.factor
+            if tick == f.tick:
+                with self._lock:
+                    if f.fired == 0:
+                        f.fired = 1
+                        self.n_injected += 1
+                        self._obs_injected.inc()
+        return factor
+
     def hit(self, phase: str, tick: int, shard: int) -> None:
         """One score-path phase boundary on one shard's slice of one
         ORIGIN tick.  Raises (or stalls) per the script; a no-op when
         nothing matches — the engine calls this unconditionally on the
         hot path only when a script is configured."""
         for f in self.faults:
-            if f.tick != tick or f.shard != shard or f.phase != phase:
+            if f.kind == "surge" or f.tick != tick or f.shard != shard \
+                    or f.phase != phase:
                 continue
             with self._lock:
                 if 0 <= f.repeat <= f.fired:

@@ -154,7 +154,26 @@ SHARD_VARIANT_REPORT_FIELDS = (
     "native_staged_dispatches",
     # supervision wall legs: snapshot and recovery time are wall
     # measurements (the decisions they protect are pinned identical)
-    "ckpt_wall_s", "recovery_wall_s")
+    "ckpt_wall_s", "recovery_wall_s",
+    # elastic topology: how many workers the policy ran at its peak is
+    # execution strategy (a policy-off run's peak IS its shard count),
+    # and the policy/migration wall is a wall measurement
+    "peak_shards", "policy_wall_s")
+
+
+def _runner_stats(r) -> dict:
+    """One runner's cumulative book + compile/wall legs as a plain
+    dict — the ONE shape shared by the report aggregation and the
+    retired-runner retention at elastic scale-down, so the "counts
+    cover the WHOLE run" invariant cannot drift when a new leg
+    lands in one site but not the other."""
+    return {"book": r.book_snapshot(),
+            "compile_s": r.compile_s,
+            "lane_compile_s": r.lane_compile_s,
+            "stage_wall_s": r.stage_wall_s,
+            "dispatch_wall_s": r.dispatch_wall_s,
+            "fold_wall_s": r.fold_wall_s,
+            "score_wall_s": r.score_wall_s}
 
 
 def _plane_col_gather(work):
@@ -273,6 +292,16 @@ class ServeReport:
     #                                              consecutive kill loops
     n_migrated_tenants: int                      # moved off dead shards
     recovery_wall_s: float                       # restore + re-exec wall
+    policy: str                                  # elastic mode: off|auto|
+    #                                              script
+    n_scale_ups: int                             # executed up episodes
+    n_scale_downs: int                           # executed down episodes
+    n_rebalances: int                            # executed rebalances
+    n_policy_migrations: int                     # tenants moved by policy
+    brownout_ticks: int                          # ticks at ladder level>=1
+    peak_shards: int                             # max workers the run held
+    policy_wall_s: float                         # policy eval + migration
+    #                                              wall
     flight_enabled: bool                         # black-box recorder on?
     flight_recorded_ticks: int                   # journal records written
     flight_dropped_ticks: int                    # ring evictions (0 = no
@@ -335,7 +364,13 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   ckpt_every: Optional[int] = None,
                   retries: Optional[int] = None,
                   retry_backoff_s: Optional[float] = None,
-                  max_respawns: Optional[int] = None
+                  max_respawns: Optional[int] = None,
+                  policy: Optional[str] = None,
+                  policy_script: Optional[str] = None,
+                  min_shards: Optional[int] = None,
+                  max_shards: Optional[int] = None,
+                  target_imbalance: Optional[float] = None,
+                  cooldown_ticks: Optional[int] = None
                   ) -> Tuple["ServeEngine", ServeReport]:
     """The canonical seeded serve run shared by ``anomod serve`` and
     ``bench.py --mode serve``: a power-law tenant fleet offering
@@ -370,7 +405,11 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          chaos=chaos, ckpt_every=ckpt_every,
                          retries=retries,
                          retry_backoff_s=retry_backoff_s,
-                         max_respawns=max_respawns)
+                         max_respawns=max_respawns, policy=policy,
+                         policy_script=policy_script,
+                         min_shards=min_shards, max_shards=max_shards,
+                         target_imbalance=target_imbalance,
+                         cooldown_ticks=cooldown_ticks)
     if engine.flight_recorder is not None:
         # the header's replay contract: `anomod audit replay` re-executes
         # this exact invocation from the journal alone.  Every
@@ -407,7 +446,23 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                    if engine._chaos is not None else ""),
             ckpt_every=engine.ckpt_every, retries=engine.retries,
             retry_backoff_s=engine.retry_backoff_s,
-            max_respawns=engine.max_respawns)
+            max_respawns=engine.max_respawns,
+            # the elastic-policy knobs, RESOLVED: an audit replay of an
+            # elastic run re-evaluates the same policy over the same
+            # canonical signals and re-executes the SAME scaling
+            # schedule (the episode-determinism pin)
+            policy=(engine.policy.mode if engine.policy is not None
+                    else "off"),
+            policy_script=(engine.policy.script
+                           if engine.policy is not None else ""),
+            min_shards=(engine.policy.min_shards
+                        if engine.policy is not None else None),
+            max_shards=(engine.policy.max_shards
+                        if engine.policy is not None else None),
+            target_imbalance=(engine.policy.target_imbalance
+                              if engine.policy is not None else None),
+            cooldown_ticks=(engine.policy.cooldown_ticks
+                            if engine.policy is not None else None))
     report = engine.run(traffic, duration_s=duration_s)
     return engine, report
 
@@ -443,7 +498,13 @@ class ServeEngine:
                  ckpt_every: Optional[int] = None,
                  retries: Optional[int] = None,
                  retry_backoff_s: Optional[float] = None,
-                 max_respawns: Optional[int] = None):
+                 max_respawns: Optional[int] = None,
+                 policy: Optional[str] = None,
+                 policy_script: Optional[str] = None,
+                 min_shards: Optional[int] = None,
+                 max_shards: Optional[int] = None,
+                 target_imbalance: Optional[float] = None,
+                 cooldown_ticks: Optional[int] = None):
         from anomod.config import get_config
         from anomod.utils.platform import enable_jit_cache
         if capacity_spans_per_s <= 0:
@@ -500,6 +561,75 @@ class ServeEngine:
             raise ValueError(
                 "the mesh plane manages its own sharded dispatch; "
                 "run it with shards=1 (ANOMOD_SERVE_SHARDS=1)")
+        #: elastic scaling policy (ANOMOD_SERVE_POLICY, anomod.serve.
+        #: policy): "off" (the default) is the static engine; "auto"/
+        #: "script" evaluate an ElasticPolicy at every tick boundary on
+        #: the coordinator and execute scale-up / scale-down /
+        #: rebalance / brownout decisions through the live-migration
+        #: seams.  Fed ONLY canonical signals, so the scaling schedule
+        #: is seed-deterministic (reruns and `anomod audit replay`
+        #: reproduce it) and tenant states / alerts / SLO / shed stay
+        #: byte-identical to a static run of the same seed.  The mesh
+        #: plane keeps state outside the migration seams and the
+        #: multimodal sidecar's modality planes have never been
+        #: migration-exercised, so the policy auto-disables on both
+        #: (an explicit request is refused) — the supervision idiom.
+        _policy_mode = (app_cfg.serve_policy if policy is None
+                        else str(policy).strip().lower() or "off")
+        if _policy_mode not in ("off", "auto", "script"):
+            raise ValueError(f"unknown serve policy mode "
+                             f"{_policy_mode!r} (off|auto|script)")
+        if (mesh is not None or multimodal) and _policy_mode != "off":
+            if policy is not None:
+                raise ValueError(
+                    "the elastic policy migrates tenants through the "
+                    "bucket-runner state seams; "
+                    + ("the mesh plane manages its own sharded state"
+                       if mesh is not None else
+                       "the multimodal sidecar planes are not covered "
+                       "by the migration seams")
+                    + " (ANOMOD_SERVE_POLICY=off)")
+            _policy_mode = "off"
+        self._elastic = _policy_mode != "off"
+        #: elastic engines run the SHARDED machinery at every count
+        #: (per-shard registries/runners/workers even at 1 shard), so a
+        #: scale-up never has to convert an inline engine mid-run; the
+        #: static 1-shard engine keeps the exact inline code path
+        self._use_workers = self.shards > 1 or self._elastic
+        self.policy = None
+        if self._elastic:
+            from anomod.serve.policy import ElasticPolicy
+            self.policy = ElasticPolicy(
+                _policy_mode,
+                int(app_cfg.serve_policy_min_shards
+                    if min_shards is None else min_shards),
+                int(app_cfg.serve_policy_max_shards
+                    if max_shards is None else max_shards),
+                float(app_cfg.serve_policy_target_imbalance
+                      if target_imbalance is None else target_imbalance),
+                int(app_cfg.serve_policy_cooldown_ticks
+                    if cooldown_ticks is None else cooldown_ticks),
+                script=(app_cfg.serve_policy_script
+                        if policy_script is None else policy_script))
+            if not (self.policy.min_shards <= self.shards
+                    <= self.policy.max_shards):
+                raise ValueError(
+                    f"shards={self.shards} is outside the elastic "
+                    f"envelope [{self.policy.min_shards}, "
+                    f"{self.policy.max_shards}] "
+                    "(ANOMOD_SERVE_POLICY_MIN/MAX_SHARDS)")
+        self.policy_wall_s = 0.0
+        #: spans resident in the replay states policy migrations moved
+        #: (the bench elasticity block's "migration spans" volume)
+        self.policy_migrated_spans = 0
+        self._peak_shards = self.shards
+        self._policy_events: List[dict] = []
+        self._policy_prev_chunks: Optional[List[int]] = None
+        self._policy_prev_shed = 0
+        #: retired shard runners' cumulative books (scale-down keeps
+        #: them so the report's canonical dispatch counts — and its
+        #: wall legs — still cover the whole run)
+        self._retired_runners: List[dict] = []
         #: tenant-state residency (ANOMOD_SERVE_STATE): "device" keeps
         #: each shard's tenant states in its runner's device-resident
         #: pool (lane folds = on-device scatter-adds in dispatch order,
@@ -522,7 +652,14 @@ class ServeEngine:
         _buckets = (buckets if buckets is not None
                     else app_cfg.serve_buckets)
         self._proc_registry = obs.get_registry()
-        if self.shards > 1:
+        #: the runner recipe a policy-time scale-up rebuilds from (the
+        #: same arguments every initial shard runner got)
+        self._runner_kw = dict(lane_buckets=lane_buckets,
+                               pipeline=self.pipeline,
+                               native_stage=native,
+                               state=self.serve_state)
+        self._buckets_arg = _buckets
+        if self._use_workers:
             from anomod.serve.shard import plan_shards
             self.shard_of = plan_shards(self.specs, self.shards,
                                         self.capacity_spans_per_s)
@@ -538,10 +675,9 @@ class ServeEngine:
             owned = [sum(1 for t in self.shard_of.values() if t == s)
                      for s in range(self.shards)]
             self._runners = [
-                BucketRunner(self.cfg, _buckets, lane_buckets=lane_buckets,
-                             registry=reg, pipeline=self.pipeline,
-                             native_stage=native, state=self.serve_state,
-                             pool_slots=max(owned[s], 1))
+                BucketRunner(self.cfg, _buckets, registry=reg,
+                             pool_slots=max(owned[s], 1),
+                             **self._runner_kw)
                 for s, reg in enumerate(self._shard_regs)]
             self._fold_state = [dict() for _ in range(self.shards)]
             self.runner = self._runners[0]
@@ -592,10 +728,13 @@ class ServeEngine:
             _windows = int(app_cfg.serve_rca_windows
                            if rca_windows is None else rca_windows)
             # one plane per shard (shard-private runner + registry, the
-            # BucketRunner discipline); the 1-shard plane records into
-            # the process registry directly
-            _regs = (self._shard_regs if self.shards > 1
+            # BucketRunner discipline); the inline 1-shard plane records
+            # into the process registry directly
+            _regs = (self._shard_regs if self._use_workers
                      else [self._proc_registry])
+            #: the RCA-plane recipe a policy-time scale-up rebuilds from
+            self._rca_kw = dict(buckets=_rca_buckets, topk=_topk,
+                                windows=_windows)
             self._rca_planes = [
                 OnlineRCA(self.services, self.cfg.window_us, self.t0_us,
                           RcaRunner(_rca_buckets, registry=reg),
@@ -674,11 +813,16 @@ class ServeEngine:
                     "native_staging": any(r.native_stage
                                           for r in self._runners),
                     "multimodal": self.multimodal,
+                    "policy": (self.policy.mode
+                               if self.policy is not None else "off"),
                  },
                  "config": config_snapshot(),
                  "versions": versions()},
                 max_ticks=flight_max_ticks,
                 digest_every=flight_digest_every)
+            #: the brownout ladder's restore point: level 2 coarsens
+            #: the live digest cadence 4x, relaxing back to this
+            self._flight_digest_base = self.flight_recorder.digest_every
             self._flight_prev_tot = None
             self._flight_prev_legs = None
             self._flight_alert_seen: Dict[int, int] = {}
@@ -710,14 +854,16 @@ class ServeEngine:
             # contract makes every leg equal fault-free).  The CLI's
             # `anomod serve --chaos` validates the range HARD — a typo
             # there is a user error, not a forensic override.
+            reachable = (self.policy.max_shards
+                         if self.policy is not None else self.shards)
             bad = sorted({f.shard for f in self._chaos.faults
-                          if f.shard >= self.shards})
+                          if f.kind != "surge" and f.shard >= reachable})
             if bad:
                 import warnings
                 warnings.warn(
                     f"chaos script targets shard(s) {bad} but the "
-                    f"engine has {self.shards} shard(s) (ids 0.."
-                    f"{self.shards - 1}); those faults will never "
+                    f"engine has {reachable} shard(s) (ids 0.."
+                    f"{reachable - 1}); those faults will never "
                     "fire", RuntimeWarning, stacklevel=2)
         #: shard supervision (ANOMOD_SERVE_CKPT_EVERY > 0, the default;
         #: anomod.serve.supervise): cadenced tenant-state checkpoints
@@ -854,6 +1000,16 @@ class ServeEngine:
         advance the clock.  Returns the served batches."""
         t_wall = time.perf_counter()
         now = self.clock.now_s + self.clock.tick_s   # decisions at tick end
+        if self._chaos is not None:
+            # scripted load surge (the chaos 'surge' kind): a pure
+            # function of the tick index, so the amplified arrival
+            # stream — and everything downstream of it — is identical
+            # on every rerun/replay of the same script, at every shard
+            # count, with the elastic policy on or off
+            factor = self._chaos.surge_factor(self.clock.ticks)
+            if factor > 1:
+                arrivals = [(tid, concat_span_batches([spans] * factor))
+                            for tid, spans in arrivals]
         if modality_arrivals:
             with self._span("serve.modality"):
                 for tenant_id, kind, batch in modality_arrivals:
@@ -903,7 +1059,7 @@ class ServeEngine:
                 sup.begin_tick(served)
             self._last_failures = None
             try:
-                if self.shards > 1:
+                if self._use_workers:
                     with self._span("serve.score_sharded"):
                         self._score_sharded(served)
                 elif self._fused:
@@ -964,13 +1120,30 @@ class ServeEngine:
                     if len(self._rca_planes) > 1 else 0]
                 plane.buffer(qb.tenant_id, qb.spans,
                              keep_window=floor.get(qb.tenant_id))
-            self._rca_tick(now)
+            # brownout level >= 1 (the elastic policy's degradation
+            # ladder) tightens the per-tick RCA budget to one run —
+            # the item set and verdict CONTENT are budget-invariant
+            # (the PR-6 pin); only the virtual scoring tick moves
+            self._rca_tick(now, budget=(
+                1 if self.policy is not None
+                and self.policy.brownout_level >= 1 else None))
         if self.flight_recorder is not None:
             # the journal entry rides INSIDE the measured wall (the
             # serve_wall_s accumulation below) — the bench's flight
             # overhead leg prices the recorder, never hides it
             self._flight_tick(now, served,
                               time.perf_counter() - t_wall)
+        if self.policy is not None:
+            # the elastic-policy step runs AFTER this tick's journal
+            # record (a scale-down must not remove a runner whose
+            # tick-t dispatch deltas have not been journaled yet); its
+            # events ride the NEXT record's `scaling` variant key, and
+            # its wall lands inside the measured tick wall — the bench
+            # elasticity block prices scaling, never hides it
+            t0 = time.perf_counter()
+            with self._span("serve.policy"):
+                self._policy_step(served)
+            self.policy_wall_s += time.perf_counter() - t0
         self.clock.advance()
         # telemetry work stays INSIDE the measured wall: the bench's
         # enabled-vs-off overhead number must price the scrape, not
@@ -1160,6 +1333,12 @@ class ServeEngine:
         self._flight_prev_tot = tot
         legs = [r.leg_walls() for r in self._runners]
         prev_legs = self._flight_prev_legs or [{} for _ in legs]
+        if len(prev_legs) < len(legs):
+            # an elastic scale-up appended runners since the last
+            # record: the new runners' whole books are this tick's
+            # delta (a truncating zip would silently drop their chunks
+            # from the canonical dispatch plane)
+            prev_legs = prev_legs + [{}] * (len(legs) - len(prev_legs))
         by_width: Dict[int, int] = {}
         chunks = 0
         shard_legs = []
@@ -1252,6 +1431,14 @@ class ServeEngine:
         # contract the variant-key tests pin.
         rec["recovery"] = (self._supervisor.drain_events()
                            if self._supervisor is not None else [])
+        # elastic-policy decisions ride the VARIANT tier too (the
+        # "scaling" key in FLIGHT_VARIANT_KEYS): WHAT scaled, when, and
+        # which tenants moved is execution topology — the canonical
+        # planes stay equal to a static run's (the elastic no-score-gap
+        # pin), so scaling marks never touch them.  Always present
+        # (usually empty), the recovery-key contract.
+        scaling, self._policy_events = self._policy_events, []
+        rec["scaling"] = scaling
         if final:
             rec["final"] = True
         fr.record(rec)
@@ -1412,6 +1599,256 @@ class ServeEngine:
                 hook("score")
                 hook("commit")
 
+    # -- the elastic-policy plane (anomod.serve.policy) --------------------
+
+    def _policy_step(self, served: List[QueuedBatch]) -> None:
+        """One tick-boundary policy evaluation on the coordinator:
+        fold this tick's CANONICAL signals into the policy EWMAs,
+        collect its decisions, execute them through the live-migration
+        seams, and journal what actually happened.  Every input is a
+        function of seed+config (served spans, staged-chunk books,
+        backlog, shed — never a wall clock), so the whole scaling
+        schedule replays from the flight header."""
+        from anomod.serve.policy import TickSignals
+        tick = self.clock.ticks
+        served_by_tenant: Dict[int, int] = {}
+        for qb in served:
+            served_by_tenant[qb.tenant_id] = \
+                served_by_tenant.get(qb.tenant_id, 0) + qb.n_spans
+        chunks = [r.n_dispatches for r in self._runners]
+        prev = self._policy_prev_chunks
+        if prev is None:
+            prev = [0] * len(chunks)
+        elif len(prev) != len(chunks):
+            prev = (prev + [0] * len(chunks))[:len(chunks)]
+        tot = self.admission.totals()
+        self.policy.observe(TickSignals(
+            tick=tick, served_by_tenant=served_by_tenant,
+            per_shard_chunks=[c - p for c, p in zip(chunks, prev)],
+            backlog_spans=self.admission.backlog_spans,
+            max_backlog=self.max_backlog,
+            shed_delta=tot.shed_spans - self._policy_prev_shed,
+            budget_spans=self.capacity_spans_per_s
+            * self.clock.tick_s))
+        self._policy_prev_shed = tot.shed_spans
+        topology_changed = False
+        for d in self.policy.decide(tick, self.shards):
+            topology_changed |= self._execute_decision(d, tick)
+        if topology_changed and self._supervisor is not None:
+            # the recovery log must never span a topology change: the
+            # checkpoint's per-runner books and tenant placements are
+            # indexed by the CURRENT shard set, so every scaling action
+            # ends on a fresh baseline
+            self._supervisor.note_topology_change()
+        self._policy_prev_chunks = [r.n_dispatches
+                                    for r in self._runners]
+        if self.flight_recorder is None and self._policy_events:
+            # no journal to drain into: the counters/report carry the
+            # story, and the event list must not grow with a
+            # flight-off run's episode count
+            self._policy_events.clear()
+
+    def _execute_decision(self, d: dict, tick: int) -> bool:
+        """Execute one policy decision against the live envelope;
+        returns whether the shard topology changed.  A decision the
+        envelope refuses (scripted ``up`` at the ceiling) is journaled
+        as skipped — never silently dropped, never counted."""
+        pol = self.policy
+        act = d["action"]
+        if act == "up":
+            if self.shards >= pol.max_shards:
+                self._policy_events.append(
+                    {"kind": "scale_up", "tick": tick,
+                     "skipped": f"at max_shards={pol.max_shards}"})
+                return False
+            moved = self._scale_up()
+            self._peak_shards = max(self._peak_shards, self.shards)
+            self._policy_events.append(
+                {"kind": "scale_up", "tick": tick,
+                 "from": self.shards - 1, "to": self.shards,
+                 "tenants": len(moved), "moved": moved})
+            pol.note_executed("up", tick, migrated=len(moved),
+                              shards=self.shards)
+            return True
+        if act == "down":
+            if self.shards <= pol.min_shards:
+                self._policy_events.append(
+                    {"kind": "scale_down", "tick": tick,
+                     "skipped": f"at min_shards={pol.min_shards}"})
+                return False
+            moved = self._scale_down()
+            self._policy_events.append(
+                {"kind": "scale_down", "tick": tick,
+                 "from": self.shards + 1, "to": self.shards,
+                 "tenants": len(moved), "moved": moved})
+            pol.note_executed("down", tick, migrated=len(moved),
+                              shards=self.shards)
+            return True
+        if act == "rebalance":
+            from anomod.serve.policy import plan_rebalance
+            dead = (self._supervisor.dead_shards
+                    if self._supervisor is not None else ())
+            moves = plan_rebalance(self.shard_of, self.shards,
+                                   self.specs, pol.rate_ewma,
+                                   self.capacity_spans_per_s,
+                                   int(d.get("k", 1)), dead=dead)
+            if not moves:
+                pol.note_noop(tick)
+                self._policy_events.append(
+                    {"kind": "rebalance", "tick": tick,
+                     "skipped": "already balanced"})
+                return False
+            imb_before = pol.imbalance()
+            for tid, dst in moves:
+                self._move_tenant(tid, dst)
+            self._policy_events.append(
+                {"kind": "rebalance", "tick": tick,
+                 "tenants": len(moves), "moved": [t for t, _ in moves],
+                 "imbalance_ewma": round(imb_before, 4)})
+            pol.note_executed("rebalance", tick, migrated=len(moves))
+            return True
+        # brownout: degrade (or restore) the auxiliary planes — RCA
+        # budget at level >= 1 (applied at the _rca_tick call site),
+        # flight digest cadence at level >= 2 (applied here)
+        level = max(0, min(int(d.get("level", 1)),
+                           self._policy_max_brownout()))
+        prev = pol.brownout_level
+        if level == prev:
+            # a redundant scripted step is journaled like any other
+            # clamped decision — an auditor must be able to tell
+            # "evaluated, already there" from "never executed"
+            self._policy_events.append(
+                {"kind": "brownout", "tick": tick,
+                 "skipped": f"already at level {prev}"})
+            return False
+        self._apply_brownout(level)
+        self._policy_events.append(
+            {"kind": "brownout", "tick": tick, "from": prev,
+             "to": level})
+        pol.note_executed("brownout", tick, level=level)
+        return False
+
+    def _policy_max_brownout(self) -> int:
+        from anomod.serve.policy import MAX_BROWNOUT_LEVEL
+        return MAX_BROWNOUT_LEVEL
+
+    def _apply_brownout(self, level: int) -> None:
+        fr = self.flight_recorder
+        if fr is not None:
+            fr.digest_every = (self._flight_digest_base * 4
+                               if level >= 2
+                               else self._flight_digest_base)
+
+    def _scale_up(self) -> List[int]:
+        """Grow the shard set by one worker and migrate the rendezvous
+        DELTA — only tenants the new candidate wins under the grown
+        set move (minimal disruption: everything else keeps its owner,
+        so the migration bill is ~1/(n+1) of the fleet, not a full
+        reshuffle).  Returns the moved tenant ids."""
+        from functools import partial
+
+        from anomod.serve.shard import ShardWorker, rendezvous_shard
+        s = self.shards
+        moved = [tid for tid in sorted(self.shard_of)
+                 if rendezvous_shard(tid, s + 1) == s]
+        reg = obs.Registry(enabled=self._proc_registry.enabled)
+        runner = BucketRunner(self.cfg, self._buckets_arg, registry=reg,
+                              pool_slots=max(len(moved), 1),
+                              **self._runner_kw)
+        self._shard_regs.append(reg)
+        self._runners.append(runner)
+        self._fold_state.append(dict())
+        if self.rca:
+            from anomod.serve.rca import OnlineRCA, RcaRunner
+            self._rca_planes.append(OnlineRCA(
+                self.services, self.cfg.window_us, self.t0_us,
+                RcaRunner(self._rca_kw["buckets"], registry=reg),
+                topk=self._rca_kw["topk"],
+                windows=self._rca_kw["windows"]))
+        self.shards = s + 1
+        if self._workers is not None:
+            self._workers.append(ShardWorker(s))
+            # warm the new runner's compile grid on its own worker —
+            # inside the measured tick wall (scaling is real work the
+            # bench elasticity block prices), off the serving threads
+            self._workers[s].submit(partial(self._warm_shard, s))
+            self._workers[s].join()
+        else:
+            self._warm_shard(s)
+        for tid in moved:
+            self._move_tenant(tid, s)
+        return moved
+
+    def _scale_down(self) -> List[int]:
+        """Drain the highest shard through the live-migration seam and
+        retire its worker.  The victim is ALWAYS the tail id, so the
+        candidate set stays ``range(shards)`` and the rendezvous key
+        stays the one placement definition; its tenants re-place by
+        rendezvous over the shrunk set — exactly the tenants whose
+        owner changed, nobody else moves.  The victim's cumulative
+        book/walls are retained so the report still covers the whole
+        run, and its registry takes a final drain fold.  Returns the
+        moved tenant ids."""
+        from anomod.serve.shard import rendezvous_shard
+        s = self.shards - 1
+        dead = (self._supervisor.dead_shards
+                if self._supervisor is not None else set())
+        candidates = [x for x in range(s) if x not in dead]
+        moved = sorted(tid for tid, sh in self.shard_of.items()
+                       if sh == s)
+        for tid in moved:
+            self._move_tenant(
+                tid, rendezvous_shard(tid, s, candidates=candidates))
+        errs = []
+        if self._workers is not None:
+            try:
+                self._workers.pop().close()
+            except BaseException as e:        # noqa: BLE001 — re-raised
+                errs.append(e)
+        self._proc_registry.fold_from(self._shard_regs[s],
+                                      self._fold_state[s],
+                                      shard=str(s), final=True)
+        self._retired_runners.append(_runner_stats(self._runners[s]))
+        self._runners.pop()
+        self._shard_regs.pop()
+        self._fold_state.pop()
+        if self.rca and len(self._rca_planes) > s:
+            self._rca_planes.pop()
+        if self._supervisor is not None:
+            self._supervisor.dead_shards.discard(s)
+        self.shards = s
+        if errs:
+            raise errs[0]
+        return moved
+
+    def _move_tenant(self, tid: int, dst: int) -> None:
+        """Live-migrate one tenant between shards through the official
+        state seams: gather (always-copy) via ``snapshot_replay``,
+        reinstall on the new owner via ``restore_replay``, repoint the
+        detector's replay plane, and carry the RCA evidence buffers.
+        Tenant bits are placement-invariant (the PR-5/8 pins), so the
+        move cannot shift a single scored byte."""
+        src = self.shard_of.get(tid, 0)
+        if src == dst:
+            return
+        rep = self._tenant_replay.pop(tid, None)
+        self.shard_of[tid] = dst
+        if rep is not None:
+            from anomod.serve.supervise import (restore_replay,
+                                                snapshot_replay)
+            snap = snapshot_replay(rep)
+            self.policy_migrated_spans += int(snap["n_spans"])
+            if hasattr(rep, "release"):
+                rep.release()            # hand the pool slot back
+            new_rep = self._replay_for(tid)
+            restore_replay(new_rep, snap)
+            det = self._tenant_det.get(tid)
+            if det is not None:
+                det.replay = new_rep
+        if self.rca and len(self._rca_planes) > max(src, dst):
+            self._rca_planes[src].move_tenant_evidence(
+                self._rca_planes[dst], tid)
+
     # -- the online alert→culprit pass (anomod.serve.rca) -----------------
 
     def _rca_enqueue(self, now: float) -> None:
@@ -1453,7 +1890,7 @@ class ServeEngine:
                     len(self._rca_queue))
         items = [self._rca_queue.popleft() for _ in range(burst)]
         with self._span("serve.rca"):
-            if self.shards > 1:
+            if self._use_workers:
                 from anomod.serve.shard import fold_verdicts, join_all
                 parts: List[list] = [[] for _ in range(self.shards)]
                 for it in items:
@@ -1496,7 +1933,7 @@ class ServeEngine:
         """Drive the engine from a traffic source for ``duration_s``
         virtual seconds, then close every tenant's last window."""
         if warm and self.mesh is None:
-            if self.shards > 1:
+            if self._use_workers:
                 # warm shard 0 FIRST, alone: with ANOMOD_JIT_CACHE on
                 # it populates the persistent cache, so the remaining
                 # shards' identical-HLO grids (warmed in parallel on
@@ -1549,7 +1986,7 @@ class ServeEngine:
             # per-tick digest cadence
             self._flight_tick(self.clock.now_s, [],
                               time.perf_counter() - t_wall, final=True)
-        if self.shards > 1:
+        if self._use_workers:
             # run-end registry fold: shard histograms (lane counts
             # etc.) DRAIN through the Histogram.merge_digest seam — the
             # same way the per-tenant SLO digests already join; drain
@@ -1677,21 +2114,28 @@ class ServeEngine:
         compile_s = lane_compile_s = 0.0
         native_staged = 0
         stage_wall = dispatch_wall = fold_wall = score_wall = 0.0
-        for r in self._runners:
-            for w, n in r.dispatches_by_width.items():
+        # live runners + the books/walls of runners an elastic
+        # scale-down retired: the canonical dispatch counts (and the
+        # wall legs) must cover the WHOLE run, not just the final
+        # topology
+        stats = [_runner_stats(r) for r in self._runners] \
+            + self._retired_runners
+        for st in stats:
+            book = st["book"]
+            for w, n in book["dispatches_by_width"].items():
                 disp_by_width[w] = disp_by_width.get(w, 0) + n
-            for b, n in r.lanes_by_bucket.items():
+            for b, n in book["lanes_by_bucket"].items():
                 lanes_by_bucket[b] = lanes_by_bucket.get(b, 0) + n
-            staged_lanes += r.staged_lanes
-            live_lanes += r.live_lanes
-            fused_dispatches += r.fused_dispatches
-            compile_s += r.compile_s
-            lane_compile_s += r.lane_compile_s
-            native_staged += r.native_staged
-            stage_wall += r.stage_wall_s
-            dispatch_wall += r.dispatch_wall_s
-            fold_wall += r.fold_wall_s
-            score_wall += r.score_wall_s
+            staged_lanes += book["staged_lanes"]
+            live_lanes += book["live_lanes"]
+            fused_dispatches += book["fused_dispatches"]
+            native_staged += book["native_staged"]
+            compile_s += st["compile_s"]
+            lane_compile_s += st["lane_compile_s"]
+            stage_wall += st["stage_wall_s"]
+            dispatch_wall += st["dispatch_wall_s"]
+            fold_wall += st["fold_wall_s"]
+            score_wall += st["score_wall_s"]
         shard_tenants: Dict[int, int] = {s: 0 for s in range(self.shards)}
         shard_spans: Dict[int, int] = {s: 0 for s in range(self.shards)}
         for spec in self.specs:
@@ -1782,6 +2226,20 @@ class ServeEngine:
             recovery_wall_s=round(self._supervisor.recovery_wall_s
                                   if self._supervisor is not None
                                   else 0.0, 4),
+            policy=(self.policy.mode if self.policy is not None
+                    else "off"),
+            n_scale_ups=(self.policy.n_scale_ups
+                         if self.policy is not None else 0),
+            n_scale_downs=(self.policy.n_scale_downs
+                           if self.policy is not None else 0),
+            n_rebalances=(self.policy.n_rebalances
+                          if self.policy is not None else 0),
+            n_policy_migrations=(self.policy.n_migrated
+                                 if self.policy is not None else 0),
+            brownout_ticks=(self.policy.brownout_ticks
+                            if self.policy is not None else 0),
+            peak_shards=max(self._peak_shards, self.shards),
+            policy_wall_s=round(self.policy_wall_s, 4),
             flight_enabled=self.flight,
             flight_recorded_ticks=(self.flight_recorder.n_recorded
                                    if self.flight_recorder is not None

@@ -307,6 +307,21 @@ class OnlineRCA:
         while buf and int(buf[0].start_us.max()) < cutoff:
             buf.pop(0)
 
+    def move_tenant_evidence(self, other: "OnlineRCA",
+                             tenant_id: int) -> None:
+        """Hand one tenant's evidence buffer (and its high-water mark)
+        to ``other`` — the migration seam for dead-shard recovery
+        (anomod.serve.supervise) and elastic scaling
+        (anomod.serve.engine), so neither reaches into the private
+        buffer dicts.  A tenant with no buffered evidence is a no-op;
+        batches move by reference (they are immutable)."""
+        buf = self._buf.pop(tenant_id, None)
+        hi = self._buf_hi.pop(tenant_id, None)
+        if buf is not None:
+            other._buf[tenant_id] = buf
+        if hi is not None:
+            other._buf_hi[tenant_id] = hi
+
     def _evidence_batch(self, tenant_id: int,
                         alert_window: int) -> Optional[SpanBatch]:
         lo = self.t0_us + (alert_window + 1 - self.windows) * self.window_us

@@ -41,17 +41,36 @@ from typing import Dict, List, Optional, Sequence
 from anomod.serve.queues import TenantSpec
 
 
+def _fmix32(h: int) -> int:
+    """MurmurHash3's 32-bit avalanche finalizer.  crc32 alone is
+    XOR-LINEAR: two keys differing only in the shard suffix differ by a
+    near-constant XOR, so comparing raw crc32 scores across shards
+    clumps — runs of ~80 CONSECUTIVE tenant ids all prefer the same
+    shard (measured: the 1→2 delta set over tenants 0..79 was empty,
+    which would make a small fleet's first scale-up a placement
+    no-op).  The multiply/shift mix destroys that linear structure
+    while staying process- and hash-seed-stable."""
+    h &= 0xFFFFFFFF
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
 def rendezvous_shard(tenant_id: int, n_shards: int,
                      candidates: Optional[Sequence[int]] = None) -> int:
-    """Highest-random-weight shard for one tenant (crc32 — stable across
-    processes and Python hash seeds).  ``candidates`` restricts the
-    draw to a subset of shard ids (the dead-shard migration case: the
-    ONE key definition must serve initial placement and migration
-    alike, or the two could silently disagree)."""
+    """Highest-random-weight shard for one tenant (crc32 + the
+    :func:`_fmix32` avalanche — stable across processes and Python hash
+    seeds).  ``candidates`` restricts the draw to a subset of shard ids
+    (the dead-shard migration and elastic scale-down cases: the ONE key
+    definition must serve initial placement, recovery migration and
+    policy-time scaling alike, or they could silently disagree)."""
     pool = range(n_shards) if candidates is None else candidates
     best, best_score = -1, -1
     for s in pool:
-        score = zlib.crc32(f"{tenant_id}/{s}".encode())
+        score = _fmix32(zlib.crc32(f"{tenant_id}/{s}".encode()))
         if score > best_score:
             best, best_score = s, score
     if best < 0:

@@ -157,7 +157,7 @@ class ShardSupervisor:
     :class:`~anomod.serve.engine.ServeEngine`."""
 
     def __init__(self, engine, ckpt_every: int, retries: int,
-                 backoff_s: float, max_respawns: int):
+                 backoff_s: float, max_respawns: int, sleep_fn=None):
         if ckpt_every < 1:
             raise ValueError("supervision needs ckpt_every >= 1 "
                              "(0 disables it at the engine)")
@@ -166,6 +166,12 @@ class ShardSupervisor:
         self.retries = int(retries)
         self.backoff_s = float(backoff_s)
         self.max_respawns = int(max_respawns)
+        #: the respawn-backoff clock, injectable so supervised
+        #: campaigns are wall-free under test (a fake sleep records the
+        #: schedule instead of parking the coordinator).  Backoff is
+        #: wall-side supervision policy either way: the replayed
+        #: DECISIONS stay pinned byte-identical at any sleep_fn.
+        self._sleep = sleep_fn if sleep_fn is not None else time.sleep
         self._ckpt: Optional[_Checkpoint] = None
         #: (tick, served) since the last checkpoint — the re-execution
         #: input; batches are immutable, so retention is reference-cheap
@@ -229,6 +235,16 @@ class ShardSupervisor:
     def drain_events(self) -> List[dict]:
         ev, self._events = self._events, []
         return ev
+
+    def note_topology_change(self) -> None:
+        """The elastic policy changed the shard set (scale-up/down or
+        a rebalance migration): take a fresh baseline checkpoint NOW.
+        The checkpoint's per-runner books and tenant placements index
+        the current topology, and the recovery log is the re-execution
+        input against exactly that checkpoint — letting the log span a
+        scale boundary would re-execute slices against books that no
+        longer line up with the runner list."""
+        self._checkpoint()
 
     # -- checkpointing -----------------------------------------------------
 
@@ -299,8 +315,7 @@ class ShardSupervisor:
             if self._fail_counts.get(fail_key, 0) >= self.retries:
                 event["quarantined"] += self._quarantine(s, fail_key[1])
             if self.backoff_s > 0:
-                # anomod-lint: disable=D101 — respawn backoff is wall-side supervision policy (off by default); the replayed DECISIONS stay pinned byte-identical
-                time.sleep(min(self.backoff_s * (2 ** attempt), 5.0))
+                self._sleep(min(self.backoff_s * (2 ** attempt), 5.0))
             self._respawn_worker(s, event)
             try:
                 restored = self._restore_and_replay(s, event)
@@ -501,13 +516,8 @@ class ShardSupervisor:
         if eng.rca and len(eng._rca_planes) > 1:
             src = eng._rca_planes[s]
             for tid in moved:
-                buf = src._buf.pop(tid, None)
-                hi = src._buf_hi.pop(tid, None)
-                dst = eng._rca_planes[eng.shard_of[tid]]
-                if buf is not None:
-                    dst._buf[tid] = buf
-                if hi is not None:
-                    dst._buf_hi[tid] = hi
+                src.move_tenant_evidence(
+                    eng._rca_planes[eng.shard_of[tid]], tid)
         for tid in moved:
             snap = self._ckpt.tenants.get(tid)
             if snap is not None:

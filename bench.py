@@ -171,6 +171,12 @@ def serve_main(probe_fresh=False) -> int:
     counts, and the no-score-gap parity bits (the chaos leg's
     states/alerts/p99/shed and canonical flight journal must equal the
     fault-free headline's).
+    An ELASTICITY pair (sub-capacity load + a scripted ``surge`` chaos
+    window, served static and again under ``ANOMOD_SERVE_POLICY=auto``)
+    fills the ``elasticity`` block: scale-up/down episode counts, the
+    migration volume, and the elastic determinism parity bits (the
+    policy run's states/alerts/p99/shed and canonical flight journal
+    must equal the static leg's).
     After the shard-scaling legs,
     two ONLINE-RCA legs (1-shard and 2-shard, ``rca=True``, same seed)
     fill the ``rca`` block: top-k hit-rate (k=1,3,5) against the
@@ -302,6 +308,34 @@ def serve_main(probe_fresh=False) -> int:
             eng_rca, rep_rca = run_power_law(shards=1, rca=True, **run_kw)
             set_registry(Registry(enabled=True))
             eng_rca2, _ = run_power_law(shards=2, rca=True, **run_kw)
+            # the ELASTICITY legs: a sub-capacity fleet hit by a
+            # scripted load surge (the chaos 'surge' kind), served
+            # twice on the same seed — once static, once under the
+            # signal-fed elastic policy (scale 1→2 into the surge, back
+            # down after it).  The capture's own proof of the elastic
+            # determinism contract: the policy run must produce ≥1
+            # scale-up and ≥1 scale-down episode AND leave every
+            # decision plane byte-identical to the static run — the
+            # autoscaler moves wall-clock capacity around, never a
+            # scored byte.
+            elastic_kw = dict(run_kw)
+            elastic_kw["overload"] = 0.6
+            # an eighth-of-the-run surge: long enough to sustain the
+            # scale-up hysteresis, short enough that the brownout
+            # ladder never reaches level 2 (digest coarsening) — the
+            # parity bit below compares canonical journals, and a
+            # deliberately coarsened digest cadence would read as fold
+            # divergence (the ladder has its own pinned test)
+            surge_script = (f"surge@{n_ticks // 4}:factor=4:"
+                            f"ticks={max(1, n_ticks // 8)}")
+            set_registry(Registry(enabled=True))
+            eng_els, rep_els = run_power_law(
+                shards=1, chaos=surge_script, **elastic_kw)
+            set_registry(Registry(enabled=True))
+            eng_el, rep_el = run_power_law(
+                shards=1, chaos=surge_script, policy="auto",
+                min_shards=1, max_shards=2, cooldown_ticks=5,
+                **elastic_kw)
         finally:
             set_registry(prev_reg)
         set_registry(reg)
@@ -603,6 +637,55 @@ def serve_main(probe_fresh=False) -> int:
                 "verdicts_identical_1_vs_2_shards":
                     [v.to_dict() for v in eng_rca.rca_verdicts]
                     == [v.to_dict() for v in eng_rca2.rca_verdicts],
+            },
+        }
+        # elastic serving (ISSUE-13): the policy leg's scaling episodes
+        # under the scripted surge, the migration volume, the shard
+        # imbalance the run ended on, and the determinism parity bits —
+        # states/alerts/p99/shed byte-identical to the static leg of
+        # the same seed+surge, canonical flight journals equal under
+        # `anomod audit diff` semantics
+        _el_alerts_same, _el_states_same = _engines_identical(
+            eng_els, eng_el)
+        _el_journal_ok = None
+        if eng_els.flight_recorder is not None \
+                and eng_el.flight_recorder is not None:
+            _el_journal_ok = _diff_journals(
+                eng_els.flight_recorder.journal(),
+                eng_el.flight_recorder.journal()) is None
+        _el_events = [ev for t in (eng_el.flight_recorder.records()
+                                   if eng_el.flight_recorder is not None
+                                   else [])
+                      for ev in t.get("scaling", ())]
+        out["elasticity"] = {
+            "policy": rep_el.policy,
+            "chaos_script": surge_script,
+            "min_shards": 1, "max_shards": 2, "cooldown_ticks": 5,
+            "n_scale_ups": rep_el.n_scale_ups,
+            "n_scale_downs": rep_el.n_scale_downs,
+            "n_rebalances": rep_el.n_rebalances,
+            "n_policy_migrations": rep_el.n_policy_migrations,
+            "migrated_spans": eng_el.policy_migrated_spans,
+            "brownout_ticks": rep_el.brownout_ticks,
+            "peak_shards": rep_el.peak_shards,
+            "final_shards": rep_el.shards,
+            "policy_wall_s": rep_el.policy_wall_s,
+            "shard_imbalance_static": rep_els.shard_imbalance,
+            "shard_imbalance_elastic": rep_el.shard_imbalance,
+            "episodes": [{"kind": ev.get("kind"),
+                          "tick": ev.get("tick"),
+                          "tenants": ev.get("tenants", 0)}
+                         for ev in _el_events],
+            "spans_per_sec_static": rep_els.sustained_spans_per_sec,
+            "spans_per_sec_elastic": rep_el.sustained_spans_per_sec,
+            "parity": {
+                "alerts_identical": _el_alerts_same,
+                "states_identical": _el_states_same,
+                "p99_identical": rep_el.latency.get("p99_latency_s")
+                == rep_els.latency.get("p99_latency_s"),
+                "shed_identical":
+                    rep_el.shed_fraction == rep_els.shed_fraction,
+                "journal_canonical_identical": _el_journal_ok,
             },
         }
         # enabled-vs-off telemetry overhead on the same seed (acceptance

@@ -82,6 +82,11 @@ and the table in docs/BENCHMARKS.md mirrors them):
   unsuppressed, unbaselined violation — a capture of a tree with a
   broken determinism or parity contract is not reproducible from its
   record.  Both modes run this gate right after the env contract.
+- ``EXIT_POLICY_DIVERGENCE`` (10): the elastic smoke (scale 1→2→1
+  under a scripted load surge, ``anomod audit diff`` vs the static run
+  of the same seed) found a score gap or failed to produce both a
+  scale-up and a scale-down episode — the elastic policy either moved
+  a scored byte or never scaled at all.
 
 Always prints one JSON line describing the decision (plus the contract
 gate's line).  ``--traces`` must match the bench invocation's span
@@ -108,6 +113,7 @@ EXIT_STATE_POOL_UNUSABLE = 6
 EXIT_FLIGHT_DIVERGENCE = 7
 EXIT_RECOVERY_DIVERGENCE = 8
 EXIT_LINT = 9
+EXIT_POLICY_DIVERGENCE = 10
 
 
 def _shard_fanout_smoke() -> dict:
@@ -291,6 +297,40 @@ def _recovery_smoke():
                                eng_chaos.flight_recorder.journal())
 
 
+def _elastic_smoke():
+    """The elastic-policy smoke (<5 s): the same tiny seeded
+    sub-capacity run hit by a scripted load surge (the chaos ``surge``
+    kind), served static and again under ``ANOMOD_SERVE_POLICY=auto``
+    with a 1→2 shard envelope.  The policy leg must produce at least
+    one scale-up AND one scale-down episode (a policy that never
+    scales is a silent no-op — raised as a precondition failure), and
+    its canonical flight journal must equal the static leg's (the
+    elastic no-score-gap contract: scaling moves wall capacity, never
+    a scored byte).  Returns ``(info, divergence_or_None)``."""
+    from anomod.obs.flight import diff_journals
+    from anomod.serve.engine import run_power_law
+
+    kw = dict(n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+              overload=0.6, duration_s=24, tick_s=1.0, seed=5,
+              window_s=5.0, baseline_windows=4, fault_tenants=0,
+              buckets=(64, 256), lane_buckets=(1, 2, 4),
+              max_backlog=1500, n_windows=16, flight_digest_every=4,
+              chaos="surge@6:factor=6:ticks=6")
+    eng_static, _ = run_power_law(shards=1, **kw)
+    eng_elastic, rep = run_power_law(
+        shards=1, policy="auto", min_shards=1, max_shards=2,
+        cooldown_ticks=3, **kw)
+    info = {"scale_ups": rep.n_scale_ups,
+            "scale_downs": rep.n_scale_downs,
+            "migrated_tenants": rep.n_policy_migrations,
+            "peak_shards": rep.peak_shards}
+    if rep.n_scale_ups < 1 or rep.n_scale_downs < 1:
+        raise RuntimeError(
+            f"elastic smoke produced no full scaling episode: {info}")
+    return info, diff_journals(eng_static.flight_recorder.journal(),
+                               eng_elastic.flight_recorder.journal())
+
+
 def check_serve() -> int:
     """Serve-bench preconditions: env contract parses, bucket set
     compiles, the shard fan-out reproduces the 1-shard output, and the
@@ -437,6 +477,21 @@ def check_serve() -> int:
                   "left a score gap vs the fault-free run of the same "
                   "seed", file=sys.stderr)
             return EXIT_RECOVERY_DIVERGENCE
+        # the elastic smoke: scale 1→2→1 under a scripted surge must
+        # leave the canonical journal equal to the static run — its own
+        # exit code, distinct from a recovery or replay divergence
+        elastic_info, elastic_div = _elastic_smoke()
+        out["elastic_smoke"] = elastic_info
+        if elastic_div is not None:
+            out["status"] = "policy-divergence"
+            out["divergence"] = elastic_div
+            print(json.dumps(out))
+            print(f"pre_bench_check: elastic smoke diverged at tick "
+                  f"{elastic_div['tick']} in the "
+                  f"{elastic_div['plane']} plane — a policy-scaled run "
+                  "left a score gap vs the static run of the same "
+                  "seed", file=sys.stderr)
+            return EXIT_POLICY_DIVERGENCE
         print(json.dumps(out))
         return EXIT_READY
     except Exception as e:

@@ -207,7 +207,13 @@ CANONICAL_REPORT_FIELDS = (
     "rca_alert_to_culprit_s", "supervised", "ckpt_every",
     "n_checkpoints", "n_shard_crashes", "n_respawns",
     "n_restored_ticks", "n_quarantined", "n_migrated_tenants",
-    "flight_enabled", "flight_recorded_ticks", "flight_dropped_ticks")
+    "flight_enabled", "flight_recorded_ticks", "flight_dropped_ticks",
+    # elastic policy (ISSUE-13): the policy mode and its executed
+    # decision counts are seed-deterministic (and zero with the policy
+    # off, so the shard fan-out parity holds trivially); peak_shards /
+    # policy_wall_s are the variant topology/wall halves
+    "policy", "n_scale_ups", "n_scale_downs", "n_rebalances",
+    "n_policy_migrations", "brownout_ticks")
 
 
 def test_canonical_report_inventory_pinned():

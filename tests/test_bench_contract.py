@@ -273,6 +273,32 @@ def test_bench_serve_mode_contract(tmp_path):
     assert par["p99_identical"] is True
     assert par["shed_identical"] is True
     assert par["journal_canonical_identical"] is True
+    # elasticity block (ISSUE-13): the policy leg under the scripted
+    # surge must complete a full scaling episode (>=1 up AND >=1 down)
+    # and carry the elastic determinism parity bits — byte-identical
+    # decisions and an equal canonical journal vs the static leg
+    el = out["elasticity"]
+    assert el["policy"] == "auto"
+    assert el["chaos_script"].startswith("surge@")
+    assert el["min_shards"] == 1 and el["max_shards"] == 2
+    assert el["n_scale_ups"] >= 1
+    assert el["n_scale_downs"] >= 1
+    assert el["n_policy_migrations"] >= 1
+    assert el["migrated_spans"] >= 0
+    assert el["peak_shards"] == 2
+    assert el["policy_wall_s"] >= 0
+    assert el["shard_imbalance_static"] >= 1.0
+    assert el["shard_imbalance_elastic"] >= 1.0
+    kinds = [ev["kind"] for ev in el["episodes"]]
+    assert "scale_up" in kinds and "scale_down" in kinds
+    assert el["spans_per_sec_static"] > 0
+    assert el["spans_per_sec_elastic"] > 0
+    par = el["parity"]
+    assert par["alerts_identical"] is True
+    assert par["states_identical"] is True
+    assert par["p99_identical"] is True
+    assert par["shed_identical"] is True
+    assert par["journal_canonical_identical"] is True
 
 
 def test_pre_bench_exit_codes_named_and_unique():
@@ -295,7 +321,7 @@ def test_pre_bench_exit_codes_named_and_unique():
         "EXIT_SERVE_PRECONDITION": 3, "EXIT_ENV_CONTRACT": 4,
         "EXIT_NATIVE_UNUSABLE": 5, "EXIT_STATE_POOL_UNUSABLE": 6,
         "EXIT_FLIGHT_DIVERGENCE": 7, "EXIT_RECOVERY_DIVERGENCE": 8,
-        "EXIT_LINT": 9,
+        "EXIT_LINT": 9, "EXIT_POLICY_DIVERGENCE": 10,
     }
     # every literal return in the gate's source goes through a constant
     src = (Path(__file__).parent.parent / "scripts"

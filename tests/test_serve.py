@@ -604,7 +604,8 @@ def test_scatter_step_bit_identical_to_matmul_step():
                                       st.hist + dhist[i])
 
 
-@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize(
+    "seed", [0, pytest.param(1, marks=pytest.mark.slow)])
 def test_fused_scoring_bit_identical_to_sequential_with_coalescing(seed):
     """THE fused parity pin: a fused engine run under overload — with
     same-tenant micro-batches genuinely coalescing per tick — emits

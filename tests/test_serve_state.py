@@ -354,7 +354,9 @@ def _host_gather(work):
     return gather
 
 
-@pytest.mark.parametrize("seed", [0, 3, 9])
+@pytest.mark.parametrize(
+    "seed", [0, pytest.param(3, marks=pytest.mark.slow),
+             pytest.param(9, marks=pytest.mark.slow)])
 def test_batched_scoring_byte_identical_to_sequential(seed):
     """THE batched-scorer pin: score_closed_windows_batched over several
     tenants == per-tenant _score_through, byte-identical — alert stream
@@ -422,7 +424,8 @@ def _fingerprint(eng):
         for tid in sorted(set(eng._tenant_det) | set(eng._tenant_replay))}
 
 
-@pytest.mark.parametrize("seed", [5, 11])
+@pytest.mark.parametrize(
+    "seed", [5, pytest.param(11, marks=pytest.mark.slow)])
 def test_engine_device_vs_host_byte_identical(seed):
     """THE residency pin: a seeded overloaded fused run with the device
     pool emits per-tenant alerts, replay states, SLO quantiles and shed
