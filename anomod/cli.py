@@ -416,6 +416,11 @@ def main(argv=None) -> int:
                          help="disable the GIL-free C++ lane staging for "
                               "this run: the interpreter fill, as before "
                               "ANOMOD_NATIVE (byte-identical output)")
+    p_serve.add_argument("--perf", action="store_true",
+                         help="dispatch-lifecycle timeline + overlap-"
+                              "bubble accounting (anomod.obs.perf; "
+                              "default: ANOMOD_PERF — pure read-side, "
+                              "decisions byte-identical either way)")
     p_serve.add_argument("--no-score", action="store_true",
                          help="replay-plane only (skip per-tenant window "
                               "scoring) — isolates the serving overhead")
@@ -553,6 +558,50 @@ def main(argv=None) -> int:
                          default=None,
                          help="record: tenant-state residency; replay: "
                               "override the recorded residency")
+
+    p_perf = sub.add_parser(
+        "perf", help="performance observatory (anomod.obs.perf): "
+        "`record` runs seeded traffic with the dispatch-lifecycle "
+        "timeline on and dumps the event timeline + overlap-bubble "
+        "analysis (--chrome adds a Chrome/Perfetto trace, one lane "
+        "per shard/scratch-slot), `diff` compares two bench captures "
+        "— decision metrics byte-exact, wall metrics by bootstrap "
+        "confidence intervals over their raw_wall_s samples against "
+        "the explicit box noise model (ANOMOD_PERF_NOISE_FLOOR) — "
+        "exiting nonzero naming the first statistically significant "
+        "wall regression or decision drift, and `history` indexes a "
+        "bench_runs/ directory into a trajectory table")
+    p_perf.add_argument("action", choices=["record", "diff", "history"])
+    p_perf.add_argument("paths", nargs="*",
+                        help="diff: the two capture JSONs (A then B); "
+                             "history: the runs directory "
+                             "(default bench_runs/)")
+    p_perf.add_argument("--out", default=None,
+                        help="record: timeline JSON output path "
+                             "(required)")
+    p_perf.add_argument("--chrome", default=None,
+                        help="record: also dump the timeline as a "
+                             "Chrome trace-event array (loads in "
+                             "chrome://tracing / Perfetto; lanes group "
+                             "by shard, shard/slot tags in args)")
+    p_perf.add_argument("--tenants", type=int, default=24,
+                        help="record only (default 24)")
+    p_perf.add_argument("--duration", type=float, default=30.0,
+                        help="record: virtual seconds to serve")
+    p_perf.add_argument("--tick", type=float, default=0.5)
+    p_perf.add_argument("--capacity", type=float, default=4000.0)
+    p_perf.add_argument("--overload", type=float, default=1.5)
+    p_perf.add_argument("--seed", type=int, default=0)
+    p_perf.add_argument("--shards", type=int, default=None,
+                        help="record: engine shard count (default: "
+                             "ANOMOD_SERVE_SHARDS)")
+    p_perf.add_argument("--pipeline", type=int, default=None,
+                        help="record: dispatch pipeline depth (default: "
+                             "ANOMOD_SERVE_PIPELINE)")
+    p_perf.add_argument("--noise-floor", type=float, default=None,
+                        help="diff: box noise fraction the wall-ratio "
+                             "CIs must clear (default: "
+                             "ANOMOD_PERF_NOISE_FLOOR, 0.35)")
 
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
@@ -1037,6 +1086,7 @@ def main(argv=None) -> int:
             pipeline=args.pipeline,
             native=False if args.no_native else None,
             state=args.state, chaos=args.chaos,
+            perf=True if args.perf else None,
             ckpt_every=args.ckpt_every,
             policy=args.policy, policy_script=args.policy_script,
             min_shards=args.min_shards, max_shards=args.max_shards,
@@ -1048,6 +1098,117 @@ def main(argv=None) -> int:
             from pathlib import Path as _P
             tracer.dump(_P(args.trace_out))
         print(json.dumps(report.to_dict(), indent=2))
+        return 0
+
+    if args.cmd == "perf":
+        from pathlib import Path as _P
+        if args.action == "history":
+            if len(args.paths) > 1:
+                parser.error("perf history takes at most one runs "
+                             "directory")
+            # mode-mismatched flags fail loud, never silently ignored
+            # (the audit-branch discipline)
+            for flag, val in (("--out", args.out),
+                              ("--chrome", args.chrome),
+                              ("--noise-floor", args.noise_floor)):
+                if val is not None:
+                    parser.error(f"{flag} applies to perf "
+                                 + ("diff" if flag == "--noise-floor"
+                                    else "record")
+                                 + ", not history")
+            from anomod.obs.perf import capture_history
+            rows = capture_history(args.paths[0] if args.paths
+                                   else "bench_runs")
+            print(json.dumps({"check": "anomod_perf_history",
+                              "n_captures": len(rows), "runs": rows},
+                             indent=2))
+            return 0
+        if args.action == "diff":
+            if len(args.paths) != 2:
+                parser.error("perf diff takes exactly two capture "
+                             "paths (A then B)")
+            if args.out or args.chrome:
+                parser.error("--out/--chrome apply to perf record")
+            from anomod.obs.perf import diff_captures
+            try:
+                a = json.loads(_P(args.paths[0]).read_text())
+                b = json.loads(_P(args.paths[1]).read_text())
+            except (OSError, ValueError) as e:
+                parser.error(f"cannot load capture: {e}")
+            doc = diff_captures(a, b, noise_floor=args.noise_floor)
+            print(json.dumps(doc, indent=2))
+            if doc["decision_mismatches"]:
+                m = doc["decision_mismatches"][0]
+                print(f"perf diff: decision drift at {m['path']} "
+                      f"(a={m['a']!r}, b={m['b']!r}) — decision "
+                      "metrics are byte-exact across same-seed "
+                      "captures; this is not noise", file=sys.stderr)
+                return 2
+            if doc["status"] == "decision-coverage-gap":
+                print("perf diff: the two captures share NO decision "
+                      "metrics (truncated or foreign capture?) — "
+                      "nothing was actually compared byte-exact, so "
+                      "this verdict must not pass a gate",
+                      file=sys.stderr)
+                return 2
+            if doc["regressions"]:
+                r = doc["regressions"][0]
+                print(f"perf diff: statistically significant wall "
+                      f"regression at {r['path']}: B/A mean ratio "
+                      f"{r['ratio']} (95% CI {r['ci95']}) clears the "
+                      f"1+{doc['noise_model']['floor_fraction']} "
+                      "noise floor", file=sys.stderr)
+                return 1
+            return 0
+        # record
+        if not args.out:
+            parser.error("perf record needs --out")
+        if args.paths:
+            parser.error("perf record takes no positional paths")
+        if args.noise_floor is not None:
+            parser.error("--noise-floor applies to perf diff")
+        _probe_backend(args)
+        from anomod.obs.perf import (PERF_FORMAT, analyze_events,
+                                     perf_tracer, round_events)
+        from anomod.serve.engine import run_power_law
+        eng, rep = run_power_law(
+            n_tenants=args.tenants, n_services=8,
+            capacity_spans_per_s=args.capacity, overload=args.overload,
+            duration_s=args.duration, tick_s=args.tick, seed=args.seed,
+            shards=args.shards, pipeline=args.pipeline, perf=True)
+        stats = analyze_events(eng.perf_events, eng.pipeline)
+        from anomod.obs.flight import _atomic_write_json
+        _atomic_write_json(args.out, {
+            "perf_format": PERF_FORMAT,
+            "engine": {"shards": rep.shards, "pipeline": rep.pipeline,
+                       "seed": args.seed, "tick_s": args.tick},
+            "report": {
+                "perf_events_recorded": rep.perf_events_recorded,
+                "events_dropped": eng.perf_events_dropped,
+                "overlap_headroom_s": rep.overlap_headroom_s,
+                "fold_wait_s": rep.fold_wait_s,
+                "bubble_fractions": rep.bubble_fractions,
+                "stage_wall_s": rep.stage_wall_s,
+                "dispatch_wall_s": rep.dispatch_wall_s,
+                "fold_wall_s": rep.fold_wall_s,
+                "score_wall_s": rep.score_wall_s,
+                "serve_wall_s": rep.serve_wall_s},
+            "raw_wall_s": [round(t, 6) for t in eng.tick_walls],
+            "events": round_events(eng.perf_events)})
+        out = {"action": "record", "out": args.out,
+               "events": rep.perf_events_recorded,
+               "overlap_headroom_s": rep.overlap_headroom_s,
+               "fold_wait_s": rep.fold_wait_s,
+               "fold_wall_s": rep.fold_wall_s,
+               "headroom_of_fold":
+                   rep.bubble_fractions.get("headroom_of_fold"),
+               "analysis": {k: round(v, 6) if isinstance(v, float)
+                            else v for k, v in stats.items()}}
+        if args.chrome:
+            tr = perf_tracer(eng.perf_events)
+            tr.dump_chrome(_P(args.chrome))
+            out["chrome"] = {"out": args.chrome, "spans": tr.n_spans}
+        print(json.dumps(out, indent=2))
         return 0
 
     if args.cmd == "audit":

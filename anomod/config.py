@@ -810,6 +810,65 @@ def _serve_max_respawns_env() -> int:
     return n
 
 
+def _perf_env() -> bool:
+    """ANOMOD_PERF: the performance observatory's dispatch-lifecycle
+    timeline (anomod.obs.perf).
+
+    Default OFF — it is a deep-dive instrument (the flight recorder is
+    the always-on journal); when on, every fused lane dispatch records
+    staged/submitted/materialized/folded/slot-refilled event
+    timestamps, the per-tick overlap-headroom bound is computed, and
+    the events ride the flight journal's ``perf`` VARIANT key.  A pure
+    read-side consumer: decisions are byte-identical on or off
+    (pinned), overhead priced in the bench ``perf`` block (≤5% bar).
+    """
+    return _env("ANOMOD_PERF", "0").strip().lower() \
+        not in ("0", "false", "off", "no", "")
+
+
+def _perf_max_events_env() -> int:
+    """ANOMOD_PERF_MAX_EVENTS: retained dispatch-timeline event bound.
+
+    The engine keeps the drained lifecycle events for report/export;
+    past this bound the OLDEST drop and every eviction is counted
+    (``anomod_perf_dropped_events_total`` — loss visible, never
+    silent, the flight-ring discipline).
+    """
+    raw = _env("ANOMOD_PERF_MAX_EVENTS", "262144")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_PERF_MAX_EVENTS must be a positive integer, "
+            f"got {raw!r}")
+    if not 1 <= n <= 100_000_000:
+        raise ValueError(
+            f"ANOMOD_PERF_MAX_EVENTS must be in [1, 100000000], got {n}")
+    return n
+
+
+def _perf_noise_floor_env() -> float:
+    """ANOMOD_PERF_NOISE_FLOOR: the box noise model `anomod perf diff`
+    tests wall ratios against (fraction; 0.35 = this box's measured
+    ±35% run-to-run floor, docs/BENCHMARKS.md).
+
+    A wall regression is flagged only when the whole 95% bootstrap CI
+    of the B/A mean-wall ratio clears ``1 + floor`` — the floor is the
+    EXPLICIT noise hedge every capture comparison used to carry as
+    prose.
+    """
+    raw = _env("ANOMOD_PERF_NOISE_FLOOR", "0.35")
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_PERF_NOISE_FLOOR must be a number, got {raw!r}")
+    if not 0 <= v <= 10:
+        raise ValueError(
+            f"ANOMOD_PERF_NOISE_FLOOR must be in [0, 10], got {v}")
+    return v
+
+
 def _native_env() -> str:
     """ANOMOD_NATIVE: the C++ native runtime switch (anomod.io.native) —
     ingest scanning AND the serving plane's GIL-free lane staging.
@@ -1028,6 +1087,17 @@ class Config:
     # (anomod.obs.flight.forensic_bundle; None = dumps off).
     flight_dump_dir: Optional[Path] = dataclasses.field(
         default_factory=_flight_dump_dir_env)
+    # ANOMOD_PERF — dispatch-lifecycle timeline + overlap-bubble
+    # accounting (anomod.obs.perf; off by default, pure read-side).
+    perf: bool = dataclasses.field(default_factory=_perf_env)
+    # ANOMOD_PERF_MAX_EVENTS — retained timeline-event bound (oldest
+    # drop past it, counted in the registry).
+    perf_max_events: int = dataclasses.field(
+        default_factory=_perf_max_events_env)
+    # ANOMOD_PERF_NOISE_FLOOR — the explicit box noise model `anomod
+    # perf diff` tests bootstrap wall-ratio CIs against.
+    perf_noise_floor: float = dataclasses.field(
+        default_factory=_perf_noise_floor_env)
     # ANOMOD_NATIVE — C++ native runtime switch: auto (use when the .so
     # loads), on (required, fail loud with the build reason), off
     # (pure-Python paths; anomod.io.native).

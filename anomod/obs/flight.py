@@ -76,11 +76,14 @@ PLANES: Tuple[str, ...] = ("admission", "dispatch", "fold", "score", "rca")
 #: marks must never touch them), and the elastic policy's scaling
 #: events (what scaled up/down/rebalanced is likewise execution
 #: topology: an elastic run's canonical planes stay equal to a static
-#: run's) — the flight twin of the serving plane's
+#: run's), and the performance observatory's per-tick dispatch-
+#: lifecycle timeline (anomod.obs.perf — pure wall-clock event
+#: timestamps plus the overlap-headroom bound computed from them) —
+#: the flight twin of the serving plane's
 #: SHARD_VARIANT_REPORT_FIELDS (one definition, shared by
 #: canonical_ticks, the parity tests and the pre-bench flight smoke).
 FLIGHT_VARIANT_KEYS: Tuple[str, ...] = ("walls", "topology", "recovery",
-                                        "scaling")
+                                        "scaling", "perf")
 
 
 def crc_text(text: str, prev: int = 0) -> int:

@@ -129,7 +129,8 @@ class BucketRunner:
                  native_stage: Optional[bool] = None,
                  lane_engine: Optional[str] = None,
                  state: Optional[str] = None,
-                 pool_slots: int = 32):
+                 pool_slots: int = 32,
+                 perf=None):
         import jax
         from anomod.config import get_config
         if buckets is None:
@@ -172,6 +173,15 @@ class BucketRunner:
         #: process registry at the tick barrier) — default is the
         #: process registry, exactly as before
         self._reg = registry if registry is not None else obs.get_registry()
+        #: dispatch-lifecycle event sink (anomod.obs.perf.PerfRecorder,
+        #: the performance observatory's read-side seam) — None (the
+        #: default) records nothing; when set, the fused submit/retire
+        #: path stamps staged/submitted/materialized/folded/refill
+        #: events REUSING the wall-leg clock reads below, so the
+        #: timeline reconciles with the five-leg walls to float
+        #: rounding and recording costs no extra perf_counter call on
+        #: the already-timed points
+        self.perf = perf
         #: max in-flight fused dispatches is ``pipeline - 1`` (depth 1 =
         #: fully synchronous, the pre-pipelining behavior); the submit/
         #: drain path keeps ``pipeline`` pinned scratch slots per
@@ -536,6 +546,10 @@ class BucketRunner:
             if self.native_stage:
                 self._stage_plans[key] = native_io.make_stage_plan(
                     scratch, self._pad_fill, mat_keys=STAGE_KEYS)
+        elif self.perf is not None:
+            # an existing scratch slot is being REUSED: stamp the
+            # slot-refilled event on the dispatch that last held it
+            self.perf.note_refill(key, t0)
         plan = self._stage_plans.get(key)
         if plan is not None and plan.stage(group_cols):
             self.native_staged += 1
@@ -545,6 +559,8 @@ class BucketRunner:
         dt = time.perf_counter() - t0
         self.stage_wall_s += dt
         self._obs_stage_s.inc(dt)
+        if self.perf is not None:
+            self.perf.note_staged(key, t0, t0 + dt)
         return scratch, key
 
     def _fill_slot_py(self, scratch: dict, group_cols: List[dict],
@@ -596,19 +612,28 @@ class BucketRunner:
         for n_live, lanes in self.lane_plan(len(work)):
             group = work[pos:pos + n_live]
             pos += n_live
-            scratch, _ = self._fill_slot(width, lanes,
-                                         [cols for _, cols in group])
+            scratch, key = self._fill_slot(width, lanes,
+                                           [cols for _, cols in group])
             exe = self._lane_exec_for((width, lanes), scratch)
+            prf = self.perf
             t0 = time.perf_counter()
             dagg, dhist = exe(scratch)
             t1 = time.perf_counter()
+            if prf is not None:
+                prf.note_submitted(key, t0, t1)
+                prf.note_retire(key, t1)
             # materialize before the scratch is reused: the host copy is
             # the execute barrier, and the scatter-back below reads it
             dagg = np.asarray(dagg)
             dhist = np.asarray(dhist)
+            if prf is not None:
+                t_mat = time.perf_counter()
+                prf.note_materialized(key, t_mat)
             for i, (st, _) in enumerate(group):
                 out.append(fold_delta(st, dagg[i], dhist[i]))
             t2 = time.perf_counter()
+            if prf is not None:
+                prf.note_folded(key, t2)
             self.dispatch_wall_s += t1 - t0
             self._obs_dispatch_s.inc(t1 - t0)
             self.fold_wall_s += t2 - t1
@@ -644,6 +669,8 @@ class BucketRunner:
             dt = time.perf_counter() - t0
             self.dispatch_wall_s += dt
             self._obs_dispatch_s.inc(dt)
+            if self.perf is not None:
+                self.perf.note_submitted(key, t0, t0 + dt)
             self._inflight.append(
                 ([replay for replay, _ in group], dagg, dhist, key))
             self._account_group(n_live, lanes)
@@ -669,8 +696,11 @@ class BucketRunner:
         host copy is the execute barrier, then :func:`fold_delta` per
         lane through the get_state/set_state seam — the same
         elementwise f32 add the in-step update performs."""
-        replays, dagg, dhist, _ = self._inflight.popleft()
+        replays, dagg, dhist, key = self._inflight.popleft()
+        prf = self.perf
         t0 = time.perf_counter()
+        if prf is not None:
+            prf.note_retire(key, t0)
         pool = self.pool
         if pool is not None and replays and all(
                 getattr(r, "_slot", None) is not None
@@ -678,15 +708,23 @@ class BucketRunner:
                 for r in replays):
             pool.scatter_fold([r._slot for r in replays], dagg, dhist)
             dagg.block_until_ready()           # scratch-reuse barrier
+            if prf is not None:
+                t_wait = time.perf_counter() - t0
+                prf.note_materialized(key, t0 + t_wait)
         else:
             dagg = np.asarray(dagg)
             dhist = np.asarray(dhist)
+            if prf is not None:
+                t_wait = time.perf_counter() - t0
+                prf.note_materialized(key, t0 + t_wait)
             for i, replay in enumerate(replays):
                 replay.set_state(fold_delta(replay.get_state(),
                                             dagg[i], dhist[i]))
         dt = time.perf_counter() - t0
         self.fold_wall_s += dt
         self._obs_fold_s.inc(dt)
+        if prf is not None:
+            prf.note_folded(key, t0 + dt)
 
     def drain_lanes(self) -> None:
         """Retire every in-flight dispatch (tick-end barrier)."""
@@ -701,9 +739,13 @@ class BucketRunner:
         planes keep their last-folded states instead of silently
         absorbing an aborted tick's work on some later drain."""
         while self._inflight:
-            _, dagg, dhist, _ = self._inflight.popleft()
+            _, dagg, dhist, key = self._inflight.popleft()
             np.asarray(dagg)
             np.asarray(dhist)
+            if self.perf is not None:
+                # dropped, counted — an aborted dispatch must not
+                # complete its timeline as if it folded
+                self.perf.note_aborted(key)
 
     @property
     def inflight_dispatches(self) -> int:

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from anomod import obs
+from anomod.obs.perf import bubble_fractions as _perf_bubbles
 from anomod.ops.tdigest import (TDigest, tdigest_build, tdigest_merge_many,
                                 tdigest_quantile)
 from anomod.replay import N_FEATS, ReplayConfig
@@ -158,7 +159,14 @@ SHARD_VARIANT_REPORT_FIELDS = (
     # elastic topology: how many workers the policy ran at its peak is
     # execution strategy (a policy-off run's peak IS its shard count),
     # and the policy/migration wall is a wall measurement
-    "peak_shards", "policy_wall_s")
+    "peak_shards", "policy_wall_s",
+    # the performance observatory (anomod.obs.perf): lifecycle-event
+    # counts follow the fused-dispatch grouping topology, and the
+    # fold-wait / overlap-headroom / bubble numbers are wall-clock
+    # measurements — consciously VARIANT, never the parity surface
+    # (perf_enabled, the config bit, stays canonical)
+    "perf_events_recorded", "overlap_headroom_s", "fold_wait_s",
+    "bubble_fractions")
 
 
 def _runner_stats(r) -> dict:
@@ -306,6 +314,16 @@ class ServeReport:
     flight_recorded_ticks: int                   # journal records written
     flight_dropped_ticks: int                    # ring evictions (0 = no
     #                                              loss; never silent)
+    perf_enabled: bool                           # dispatch-lifecycle
+    #                                              timeline on?
+    perf_events_recorded: int                    # lifecycle events taken
+    overlap_headroom_s: float                    # fold WAIT legally
+    #                                              hideable under next-
+    #                                              round staging (upper
+    #                                              bound; anomod.obs.perf)
+    fold_wait_s: float                           # measured execute WAIT
+    #                                              inside the fold leg
+    bubble_fractions: Dict[str, float]           # per-leg dead-time shares
     serve_wall_s: float
     sustained_spans_per_sec: float
 
@@ -360,6 +378,7 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   flight: Optional[bool] = None,
                   flight_digest_every: Optional[int] = None,
                   flight_max_ticks: Optional[int] = None,
+                  perf: Optional[bool] = None,
                   chaos: Optional[str] = None,
                   ckpt_every: Optional[int] = None,
                   retries: Optional[int] = None,
@@ -402,7 +421,7 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          state=state, flight=flight,
                          flight_digest_every=flight_digest_every,
                          flight_max_ticks=flight_max_ticks,
-                         chaos=chaos, ckpt_every=ckpt_every,
+                         perf=perf, chaos=chaos, ckpt_every=ckpt_every,
                          retries=retries,
                          retry_backoff_s=retry_backoff_s,
                          max_respawns=max_respawns, policy=policy,
@@ -437,6 +456,10 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
             state=engine.serve_state, flight=True,
             flight_digest_every=engine.flight_recorder.digest_every,
             flight_max_ticks=engine.flight_recorder.max_ticks,
+            # the perf plane, RESOLVED: a replay of a perf-on run
+            # re-records its timeline (variant tier — the canonical
+            # journal is identical either way, the read-side pin)
+            perf=engine.perf,
             # the fault-tolerance knobs, RESOLVED: an audit replay of a
             # chaos run re-injects the same script and re-recovers —
             # its canonical journal must equal the original's (the
@@ -494,6 +517,7 @@ class ServeEngine:
                  flight: Optional[bool] = None,
                  flight_digest_every: Optional[int] = None,
                  flight_max_ticks: Optional[int] = None,
+                 perf: Optional[bool] = None,
                  chaos: Optional[object] = None,
                  ckpt_every: Optional[int] = None,
                  retries: Optional[int] = None,
@@ -652,6 +676,41 @@ class ServeEngine:
         _buckets = (buckets if buckets is not None
                     else app_cfg.serve_buckets)
         self._proc_registry = obs.get_registry()
+        #: the performance observatory (ANOMOD_PERF, anomod.obs.perf):
+        #: per-shard dispatch-lifecycle recorders ride the runners'
+        #: fused submit/retire path (staged / submitted / materialized
+        #: / folded / slot-refilled event timestamps), drain at the
+        #: tick barrier in shard order (the fold_verdicts idiom), feed
+        #: the overlap-bubble analyzer, and ride the flight journal's
+        #: ``perf`` VARIANT key.  A pure read-side consumer: every
+        #: decision is byte-identical with recording on or off
+        #: (pinned).  The mesh plane manages its own dispatch, so the
+        #: timeline records nothing there (the runner path is idle).
+        self.perf = bool(app_cfg.perf if perf is None else perf)
+        self.perf_max_events = int(app_cfg.perf_max_events)
+        self.perf_events: list = []      # retained timeline (bounded)
+        self.perf_events_recorded = 0
+        self.perf_events_dropped = 0
+        self.perf_headroom_s = 0.0
+        self.perf_wait_s = 0.0
+        self._perf_pending: list = []    # drains of retired runners
+        self._perf_tick_doc: Optional[dict] = None
+        self._perf_recs: list = []
+        if self.perf:
+            from anomod.obs.perf import PerfRecorder
+            self._perf_recs = [PerfRecorder(s)
+                               for s in range(self.shards)]
+            # metric handles only when the plane is live (the RCA
+            # discipline: a perf-off run must not register permanently-
+            # zero series)
+            self._obs_perf_events = obs.counter(
+                "anomod_perf_events_total")
+            self._obs_perf_dropped = obs.counter(
+                "anomod_perf_dropped_events_total")
+            self._obs_fold_wait = obs.counter(
+                "anomod_serve_fold_wait_seconds_total")
+            self._obs_headroom = obs.counter(
+                "anomod_serve_overlap_headroom_seconds_total")
         #: the runner recipe a policy-time scale-up rebuilds from (the
         #: same arguments every initial shard runner got)
         self._runner_kw = dict(lane_buckets=lane_buckets,
@@ -677,6 +736,8 @@ class ServeEngine:
             self._runners = [
                 BucketRunner(self.cfg, _buckets, registry=reg,
                              pool_slots=max(owned[s], 1),
+                             perf=(self._perf_recs[s] if self.perf
+                                   else None),
                              **self._runner_kw)
                 for s, reg in enumerate(self._shard_regs)]
             self._fold_state = [dict() for _ in range(self.shards)]
@@ -688,7 +749,9 @@ class ServeEngine:
                                        pipeline=self.pipeline,
                                        native_stage=native,
                                        state=self.serve_state,
-                                       pool_slots=max(len(self.specs), 1))
+                                       pool_slots=max(len(self.specs), 1),
+                                       perf=(self._perf_recs[0]
+                                             if self.perf else None))
             self._runners = [self.runner]
         self._workers = None
         #: online RCA (ANOMOD_SERVE_RCA): when a tenant's detector fires
@@ -768,6 +831,11 @@ class ServeEngine:
         #: persists across idle ticks; forgiving it would forge capacity)
         self._max_served_batch = 0
         self.serve_wall_s = 0.0
+        #: per-tick serve-wall samples (one float per tick, bounded by
+        #: the run's tick count) — the ``raw_wall_s`` sample list the
+        #: bench ``perf`` block commits and `anomod perf diff`
+        #: bootstraps over; wall clock, never a decision input
+        self.tick_walls: List[float] = []
         self.n_spans_served = 0
         # self-scrape plumbing (anomod.obs): cached handles for the tick
         # loop, plus a per-tick registry scrape on the VIRTUAL clock so a
@@ -815,6 +883,7 @@ class ServeEngine:
                     "multimodal": self.multimodal,
                     "policy": (self.policy.mode
                                if self.policy is not None else "off"),
+                    "perf": self.perf,
                  },
                  "config": config_snapshot(),
                  "versions": versions()},
@@ -987,9 +1056,9 @@ class ServeEngine:
 
     # -- the tick loop ----------------------------------------------------
 
-    def _span(self, name: str):
+    def _span(self, name: str, **tags):
         import contextlib
-        return (self.tracer.span(name) if self.tracer is not None
+        return (self.tracer.span(name, **tags) if self.tracer is not None
                 else contextlib.nullcontext())
 
     def tick(self, arrivals, modality_arrivals=()) -> List[QueuedBatch]:
@@ -1000,6 +1069,12 @@ class ServeEngine:
         advance the clock.  Returns the served batches."""
         t_wall = time.perf_counter()
         now = self.clock.now_s + self.clock.tick_s   # decisions at tick end
+        if self._perf_recs:
+            # tick-boundary stamp (the workers are quiescent between
+            # ticks, so this cross-thread write races nothing): events
+            # the dispatch path records below key on this tick index
+            for rec_ in self._perf_recs:
+                rec_.tick = self.clock.ticks
         if self._chaos is not None:
             # scripted load surge (the chaos 'surge' kind): a pure
             # function of the tick index, so the amplified arrival
@@ -1127,6 +1202,11 @@ class ServeEngine:
             self._rca_tick(now, budget=(
                 1 if self.policy is not None
                 and self.policy.brownout_level >= 1 else None))
+        # the perf-timeline drain rides INSIDE the measured wall (the
+        # bench perf block prices the recorder, never hides it); it
+        # runs after the score barrier, so every dispatch of this tick
+        # has folded and its record is complete
+        self._perf_tick_doc = self._perf_drain() if self.perf else None
         if self.flight_recorder is not None:
             # the journal entry rides INSIDE the measured wall (the
             # serve_wall_s accumulation below) — the bench's flight
@@ -1154,7 +1234,9 @@ class ServeEngine:
                               or len(self._tenant_replay))
         if self.clock.ticks % self._scrape_every == 0:
             self._registry.scrape(now_s=now)
-        self.serve_wall_s += time.perf_counter() - t_wall
+        t_tick = time.perf_counter() - t_wall
+        self.serve_wall_s += t_tick
+        self.tick_walls.append(t_tick)
         return served
 
     def _score_fused(self, served: List[QueuedBatch]) -> None:
@@ -1286,6 +1368,44 @@ class ServeEngine:
         dt = time.perf_counter() - t0
         runner.score_wall_s += dt
         runner._obs_score_s.inc(dt)
+
+    # -- the performance observatory (anomod.obs.perf) --------------------
+
+    def _perf_drain(self) -> dict:
+        """Tick-barrier drain of the per-shard dispatch-lifecycle
+        recorders: fold in (shard, seq) order, run the overlap-bubble
+        analyzer, accumulate the run totals, retain the events
+        (bounded — evictions counted, never silent) and return the
+        journal-shaped doc the flight record's ``perf`` variant key
+        carries — or None when no flight recorder will consume it
+        (the rounded event copies would be pure dead allocation inside
+        the measured wall)."""
+        from anomod.obs.perf import (analyze_events, fold_perf_records,
+                                     round_events)
+        parts = [self._perf_pending] \
+            + [r.drain() for r in self._perf_recs]
+        self._perf_pending = []
+        events = fold_perf_records(parts)
+        stats = analyze_events(events, self.pipeline)
+        n = len(events)
+        self.perf_events_recorded += n
+        self.perf_headroom_s += stats["headroom_s"]
+        self.perf_wait_s += stats["wait_s"]
+        if n:
+            self._obs_perf_events.inc(n)
+            self._obs_fold_wait.inc(stats["wait_s"])
+            self._obs_headroom.inc(stats["headroom_s"])
+        self.perf_events.extend(events)
+        over = len(self.perf_events) - self.perf_max_events
+        if over > 0:
+            del self.perf_events[:over]
+            self.perf_events_dropped += over
+            self._obs_perf_dropped.inc(over)
+        if self.flight_recorder is None:
+            return None
+        return {"events": round_events(events),
+                "headroom_s": round(stats["headroom_s"], 6),
+                "wait_s": round(stats["wait_s"], 6)}
 
     # -- the black-box flight recorder (anomod.obs.flight) ----------------
 
@@ -1439,6 +1559,14 @@ class ServeEngine:
         # (usually empty), the recovery-key contract.
         scaling, self._policy_events = self._policy_events, []
         rec["scaling"] = scaling
+        # the performance observatory's tick timeline rides the VARIANT
+        # tier too (the "perf" key in FLIGHT_VARIANT_KEYS): pure
+        # wall-clock event timestamps + the overlap-headroom bound —
+        # never the parity surface.  ALWAYS present (empty when the
+        # plane is off) — the every-record-carries-every-tier contract.
+        perf_doc, self._perf_tick_doc = self._perf_tick_doc, None
+        rec["perf"] = perf_doc if perf_doc is not None else \
+            {"events": [], "headroom_s": 0.0, "wait_s": 0.0}
         if final:
             rec["final"] = True
         fr.record(rec)
@@ -1572,11 +1700,17 @@ class ServeEngine:
         if hook is not None:
             hook("stage")
         if self._fused:
-            pending = self._stage_pending(served)
-            self._dispatch_rounds(pending, runner, chaos_hook=hook)
-            if hook is not None:
-                hook("fold")
-            self._commit_pending(pending, runner, chaos_hook=hook)
+            # the shard/pipeline tags ride the span into the chrome
+            # export's args, and the span opens ON the worker thread —
+            # so a sharded trace's Perfetto lanes group by shard
+            # instead of collapsing onto the coordinator's lane
+            with self._span("serve.score_shard", shard=shard_id,
+                            pipeline=self.pipeline):
+                pending = self._stage_pending(served)
+                self._dispatch_rounds(pending, runner, chaos_hook=hook)
+                if hook is not None:
+                    hook("fold")
+                self._commit_pending(pending, runner, chaos_hook=hook)
             if hook is not None:
                 hook("commit")
         else:
@@ -1752,8 +1886,14 @@ class ServeEngine:
         moved = [tid for tid in sorted(self.shard_of)
                  if rendezvous_shard(tid, s + 1) == s]
         reg = obs.Registry(enabled=self._proc_registry.enabled)
+        prec = None
+        if self.perf:
+            from anomod.obs.perf import PerfRecorder
+            prec = PerfRecorder(s)
+            prec.tick = self.clock.ticks
+            self._perf_recs.append(prec)
         runner = BucketRunner(self.cfg, self._buckets_arg, registry=reg,
-                              pool_slots=max(len(moved), 1),
+                              pool_slots=max(len(moved), 1), perf=prec,
                               **self._runner_kw)
         self._shard_regs.append(reg)
         self._runners.append(runner)
@@ -1809,6 +1949,11 @@ class ServeEngine:
                                       self._fold_state[s],
                                       shard=str(s), final=True)
         self._retired_runners.append(_runner_stats(self._runners[s]))
+        if self.perf and len(self._perf_recs) > s:
+            # the victim's undrained lifecycle events fold into the
+            # next tick's drain (the retained-book discipline: the
+            # timeline covers the whole run, not the final topology)
+            self._perf_pending.extend(self._perf_recs.pop().drain())
         self._runners.pop()
         self._shard_regs.pop()
         self._fold_state.pop()
@@ -1979,6 +2124,10 @@ class ServeEngine:
                 self._rca_tick(self.clock.now_s,
                                budget=len(self._rca_queue))
         self.serve_wall_s += time.perf_counter() - t_wall
+        if self.perf:
+            # settle any lifecycle events the final drain window left
+            # (and feed the settlement record's perf key below)
+            self._perf_tick_doc = self._perf_drain()
         if self.flight_recorder is not None:
             # run-end settlement record: finish() alerts + drained RCA
             # verdicts land here, and the forced state digest gives every
@@ -2247,6 +2396,13 @@ class ServeEngine:
             flight_dropped_ticks=(self.flight_recorder.n_dropped
                                   if self.flight_recorder is not None
                                   else 0),
+            perf_enabled=self.perf,
+            perf_events_recorded=self.perf_events_recorded,
+            overlap_headroom_s=round(self.perf_headroom_s, 6),
+            fold_wait_s=round(self.perf_wait_s, 6),
+            bubble_fractions=(_perf_bubbles(
+                self.perf_wait_s, self.perf_headroom_s, fold_wall,
+                self.serve_wall_s) if self.perf else {}),
             serve_wall_s=round(self.serve_wall_s, 4),
             sustained_spans_per_sec=round(
                 self.n_spans_served / max(self.serve_wall_s, 1e-9), 1),

@@ -56,6 +56,20 @@ class Tracer:
         self._lock = threading.Lock()
         self._tls = threading.local()
         self._trace_id = f"anomod-{int(time.time() * 1e6):x}"
+        # thread ident -> small stable lane id, in first-span order: the
+        # chrome exporter's ``tid`` — worker-thread spans (shard workers,
+        # the prefetch pipeline) land on their OWN Perfetto lane instead
+        # of all collapsing onto lane 0, so a sharded run's concurrency
+        # structure is visually inspectable
+        self._tids: dict = {}
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            got = self._tids.get(ident)
+            if got is None:
+                got = self._tids[ident] = len(self._tids)
+            return got
 
     def _stack(self) -> List[int]:
         stack = getattr(self._tls, "stack", None)
@@ -74,6 +88,7 @@ class Tracer:
         parent = stack[-1] if stack else None
         start = time.time()
         rec = {"name": name, "start": start, "dur": 0.0, "parent": parent,
+               "tid": self._tid(),
                "tags": {str(k): v for k, v in tags.items()}, "events": []}
         with self._lock:
             idx = len(self._spans)
@@ -84,6 +99,25 @@ class Tracer:
         finally:
             stack.pop()
             rec["dur"] = time.time() - start
+
+    def add_span(self, name: str, start_s: float, dur_s: float,
+                 parent: Optional[int] = None, tid: int = 0,
+                 **tags) -> int:
+        """Append a PRE-TIMED span record (explicit start/duration/lane)
+        — the injection seam for timelines measured elsewhere, e.g. the
+        performance observatory's dispatch-lifecycle events
+        (anomod.obs.perf.perf_tracer), which export through the one
+        chrome/jaeger pipeline instead of growing a second exporter.
+        Never touches the thread-local span stack.  Returns the span's
+        index (usable as a later ``parent``)."""
+        rec = {"name": name, "start": float(start_s),
+               "dur": float(dur_s), "parent": parent, "tid": int(tid),
+               "tags": {str(k): v for k, v in tags.items()},
+               "events": []}
+        with self._lock:
+            idx = len(self._spans)
+            self._spans.append(rec)
+        return idx
 
     def event(self, message: str, **fields) -> None:
         """Attach an event to the CURRENT thread's innermost open span
@@ -148,7 +182,13 @@ class Tracer:
                 "name": s["name"], "ph": "X", "cat": self.service,
                 "ts": int(s["start"] * 1e6),
                 "dur": int(s["dur"] * 1e6),
-                "pid": 0, "tid": 0,
+                # one lane per recording thread (or per explicit
+                # add_span lane): Perfetto groups worker-thread spans —
+                # shard workers, the dispatch timeline's scratch slots —
+                # instead of collapsing every span onto lane 0; the
+                # shard/slot TAGS ride in args (below) so lanes group
+                # by shard in the UI and survive the round trip
+                "pid": 0, "tid": s.get("tid", 0),
                 "args": {**{str(k): str(v)
                             for k, v in sorted(s["tags"].items())},
                          "span_id": i,
@@ -206,6 +246,7 @@ def spans_from_chrome(events: List[dict]) -> List[dict]:
                     "start": e.get("ts", 0) / 1e6,
                     "dur": e.get("dur", 0) / 1e6,
                     "parent": None if parent in (-1, None) else int(parent),
+                    "tid": int(e.get("tid", 0)),
                     "tags": args})
     return out
 

@@ -308,6 +308,18 @@ def serve_main(probe_fresh=False) -> int:
             eng_rca, rep_rca = run_power_law(shards=1, rca=True, **run_kw)
             set_registry(Registry(enabled=True))
             eng_rca2, _ = run_power_law(shards=2, rca=True, **run_kw)
+            # the PERF leg: same seed, the dispatch-lifecycle timeline
+            # (anomod.obs.perf) forced ON — the `perf` block carries
+            # the overlap-headroom bound (the go/no-go instrument for
+            # the fold-wait-overlap attack), the measured fold WAIT,
+            # the on/off overhead fraction (bar: <= 5%, the telemetry/
+            # flight discipline; the on leg runs after the headline so
+            # the ratio inherits warmup like every A/B pair here), the
+            # read-side parity bits, and the headline leg's per-tick
+            # raw_wall_s samples `anomod perf diff` bootstraps over
+            set_registry(Registry(enabled=True))
+            eng_perf, rep_perf = run_power_law(perf=True, shards=1,
+                                               **run_kw)
             # the ELASTICITY legs: a sub-capacity fleet hit by a
             # scripted load surge (the chaos 'surge' kind), served
             # twice on the same seed — once static, once under the
@@ -637,6 +649,45 @@ def serve_main(probe_fresh=False) -> int:
                 "verdicts_identical_1_vs_2_shards":
                     [v.to_dict() for v in eng_rca.rca_verdicts]
                     == [v.to_dict() for v in eng_rca2.rca_verdicts],
+            },
+        }
+        # the performance observatory (ISSUE-14): the dispatch-lifecycle
+        # timeline's overlap-bubble analysis on the same seed — the
+        # overlap-headroom bound is the go/no-go instrument for ROADMAP
+        # attack (1) (overlap the fold wait behind next-round staging),
+        # the overhead fraction prices the recorder (≤5% bar), the
+        # parity bits pin the read-side contract, and the raw_wall_s
+        # per-tick samples are what `anomod perf diff` bootstraps over
+        # instead of hedging wall ratios in prose
+        from anomod.config import get_config as _get_config
+        _pf_alerts_same, _pf_states_same = _engines_identical(
+            eng_head, eng_perf)
+        out["perf"] = {
+            "enabled_headline": rep.perf_enabled,
+            "events_recorded": rep_perf.perf_events_recorded,
+            "events_dropped": eng_perf.perf_events_dropped,
+            "overlap_headroom_s": rep_perf.overlap_headroom_s,
+            "fold_wait_s": rep_perf.fold_wait_s,
+            "fold_wall_s": rep_perf.fold_wall_s,
+            "bubble_fractions": rep_perf.bubble_fractions,
+            # the headline leg's per-tick serve walls: the matched-leg
+            # sample list noise-aware capture diffing pairs by path
+            "raw_wall_s": [round(t, 6) for t in eng_head.tick_walls],
+            "perf_leg": {"raw_wall_s": [round(t, 6)
+                                        for t in eng_perf.tick_walls]},
+            "noise_floor": _get_config().perf_noise_floor,
+            "spans_per_sec_on": rep_perf.sustained_spans_per_sec,
+            "spans_per_sec_off": rep.sustained_spans_per_sec,
+            "overhead_fraction": round(max(
+                0.0, 1.0 - rep_perf.sustained_spans_per_sec
+                / max(rep.sustained_spans_per_sec, 1e-9)), 4),
+            "parity": {
+                "alerts_identical": _pf_alerts_same,
+                "states_identical": _pf_states_same,
+                "p99_identical": rep_perf.latency.get("p99_latency_s")
+                == rep.latency.get("p99_latency_s"),
+                "shed_identical":
+                    rep_perf.shed_fraction == rep.shed_fraction,
             },
         }
         # elastic serving (ISSUE-13): the policy leg's scaling episodes

@@ -87,6 +87,13 @@ and the table in docs/BENCHMARKS.md mirrors them):
   of the same seed) found a score gap or failed to produce both a
   scale-up and a scale-down episode — the elastic policy either moved
   a scored byte or never scaled at all.
+- ``EXIT_PERF_DIVERGENCE`` (11): the performance-observatory smoke
+  (record → report → self-diff, anomod.obs.perf) failed — the
+  dispatch-lifecycle recorder moved a decision byte, the timeline no
+  longer reconciles with the five-leg walls, or ``anomod perf diff``
+  semantics broke (a same-capture self-diff flagged something, or a
+  doctored 2× slowdown went unflagged) — a capture's perf block /
+  regression verdicts could not be trusted.
 
 Always prints one JSON line describing the decision (plus the contract
 gate's line).  ``--traces`` must match the bench invocation's span
@@ -114,6 +121,7 @@ EXIT_FLIGHT_DIVERGENCE = 7
 EXIT_RECOVERY_DIVERGENCE = 8
 EXIT_LINT = 9
 EXIT_POLICY_DIVERGENCE = 10
+EXIT_PERF_DIVERGENCE = 11
 
 
 def _shard_fanout_smoke() -> dict:
@@ -331,6 +339,91 @@ def _elastic_smoke():
                                eng_elastic.flight_recorder.journal())
 
 
+def _perf_smoke():
+    """The performance-observatory smoke (<5 s): record → report →
+    self-diff.  RECORD: a tiny seeded run with the dispatch-lifecycle
+    timeline ON must record events and leave every decision
+    byte-identical to the same run with it OFF (alert streams, SLO
+    quantiles, shed, canonical flight journal — the read-side
+    contract).  REPORT: the event-timeline durations must reconcile
+    with the five-leg ServeReport walls within tolerance (the events
+    reuse the wall-leg clock reads, so drift means a hook moved).
+    SELF-DIFF: ``diff_captures`` of a capture-shaped doc against
+    itself must be clean, and against a doctored 2× wall slowdown must
+    flag a regression — the noise-aware verdict machinery proves both
+    directions before a driver trusts it.  Returns
+    ``(info, problem_or_None)``."""
+    import copy
+    import dataclasses
+
+    from anomod.obs.perf import diff_captures
+    from anomod.serve.engine import run_power_law
+
+    kw = dict(n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+              overload=2.0, duration_s=16, tick_s=1.0, seed=5,
+              window_s=5.0, baseline_windows=4, fault_tenants=0,
+              buckets=(64, 256), lane_buckets=(1, 2, 4),
+              max_backlog=1500, n_windows=16, shards=1, pipeline=2)
+    eng_off, rep_off = run_power_law(**kw)
+    eng_on, rep_on = run_power_law(perf=True, **kw)
+    info = {"events": rep_on.perf_events_recorded,
+            "overlap_headroom_s": rep_on.overlap_headroom_s,
+            "fold_wait_s": rep_on.fold_wait_s}
+
+    def problem(what, detail):
+        return info, {"what": what, "detail": detail}
+
+    if rep_on.perf_events_recorded < 1:
+        return problem("no-events", "the perf run recorded no dispatch "
+                       "lifecycle events")
+    for tid in eng_off._tenant_det:
+        if [dataclasses.asdict(a) for a in eng_off.alerts_for(tid)] != \
+                [dataclasses.asdict(a) for a in eng_on.alerts_for(tid)]:
+            return problem("decision-divergence",
+                           f"tenant {tid} alert stream diverges with "
+                           "perf recording on")
+    if rep_off.latency != rep_on.latency \
+            or rep_off.shed_fraction != rep_on.shed_fraction:
+        return problem("decision-divergence",
+                       "SLO/shed diverge with perf recording on")
+    if eng_off.flight_recorder is not None \
+            and eng_on.flight_recorder is not None \
+            and eng_off.flight_recorder.canonical_bytes() \
+            != eng_on.flight_recorder.canonical_bytes():
+        return problem("decision-divergence",
+                       "canonical flight journal diverges with perf "
+                       "recording on")
+    evs = eng_on.perf_events
+    disp = sum(e["submitted"] - e["submitted_t0"] for e in evs)
+    fold = sum(e["folded"] - e["retire_t0"] for e in evs)
+    stage = sum(e["staged"] - e["staged_t0"] for e in evs)
+    for name, got, wall in (("dispatch", disp, rep_on.dispatch_wall_s),
+                            ("fold", fold, rep_on.fold_wall_s)):
+        if abs(got - wall) > 1e-3 + 0.02 * wall:
+            return problem("reconciliation",
+                           f"timeline {name} {got:.6f}s vs report "
+                           f"wall {wall:.6f}s")
+    if stage > rep_on.stage_wall_s + 1e-3:
+        return problem("reconciliation",
+                       f"timeline stage {stage:.6f}s exceeds report "
+                       f"wall {rep_on.stage_wall_s:.6f}s")
+    cap = {"metric": "perf_smoke",
+           "shed_fraction": rep_on.shed_fraction,
+           "p99_admission_to_scored_latency_s":
+               rep_on.latency.get("p99_latency_s"),
+           "perf": {"raw_wall_s": [round(t, 6)
+                                   for t in eng_on.tick_walls]}}
+    if diff_captures(cap, copy.deepcopy(cap))["status"] != "ok":
+        return problem("self-diff", "a capture self-diff was not clean")
+    doctored = copy.deepcopy(cap)
+    doctored["perf"]["raw_wall_s"] = [
+        2.0 * t for t in doctored["perf"]["raw_wall_s"]]
+    if not diff_captures(cap, doctored)["regressions"]:
+        return problem("self-diff",
+                       "a doctored 2x wall slowdown went unflagged")
+    return info, None
+
+
 def check_serve() -> int:
     """Serve-bench preconditions: env contract parses, bucket set
     compiles, the shard fan-out reproduces the 1-shard output, and the
@@ -492,6 +585,22 @@ def check_serve() -> int:
                   "left a score gap vs the static run of the same "
                   "seed", file=sys.stderr)
             return EXIT_POLICY_DIVERGENCE
+        # the performance-observatory smoke: record → report →
+        # self-diff — a perf-block capture or an `anomod perf diff`
+        # verdict from a broken observatory would be worse than none
+        perf_info, perf_problem = _perf_smoke()
+        out["perf_smoke"] = perf_info
+        if perf_problem is not None:
+            out["status"] = "perf-divergence"
+            out["problem"] = perf_problem
+            print(json.dumps(out))
+            print(f"pre_bench_check: perf-observatory smoke failed "
+                  f"({perf_problem['what']}): {perf_problem['detail']}"
+                  " — the dispatch-lifecycle recorder or the "
+                  "noise-aware diff broke its contract; do not trust "
+                  "perf blocks or regression verdicts",
+                  file=sys.stderr)
+            return EXIT_PERF_DIVERGENCE
         print(json.dumps(out))
         return EXIT_READY
     except Exception as e:

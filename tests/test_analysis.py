@@ -213,7 +213,12 @@ CANONICAL_REPORT_FIELDS = (
     # off, so the shard fan-out parity holds trivially); peak_shards /
     # policy_wall_s are the variant topology/wall halves
     "policy", "n_scale_ups", "n_scale_downs", "n_rebalances",
-    "n_policy_migrations", "brownout_ticks")
+    "n_policy_migrations", "brownout_ticks",
+    # the performance observatory (ISSUE-14): whether the dispatch-
+    # lifecycle timeline ran is config, identical at every shard
+    # count; its event counts / headroom / wait / bubble numbers are
+    # wall-clock+topology and live on SHARD_VARIANT_REPORT_FIELDS
+    "perf_enabled")
 
 
 def test_canonical_report_inventory_pinned():

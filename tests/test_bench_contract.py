@@ -273,6 +273,43 @@ def test_bench_serve_mode_contract(tmp_path):
     assert par["p99_identical"] is True
     assert par["shed_identical"] is True
     assert par["journal_canonical_identical"] is True
+    # performance-observatory block (ISSUE-14): the dispatch-lifecycle
+    # timeline's overlap-headroom bound, the measured fold WAIT, the
+    # per-tick raw_wall_s samples `anomod perf diff` bootstraps over,
+    # the on/off overhead fraction, and the read-side parity bits
+    pf = out["perf"]
+    assert pf["enabled_headline"] is False     # deep-dive opt-in, off
+    assert pf["events_recorded"] > 0
+    assert pf["events_dropped"] == 0
+    assert pf["overlap_headroom_s"] >= 0.0
+    assert pf["fold_wait_s"] >= 0.0
+    assert pf["fold_wait_s"] <= pf["fold_wall_s"] + 1e-6
+    # the headroom bound can never exceed the wait it would hide
+    assert pf["overlap_headroom_s"] <= pf["fold_wait_s"] + 1e-6
+    bf = pf["bubble_fractions"]
+    assert set(bf) == {"stage", "dispatch", "score",
+                       "fold_wait_of_fold", "fold_wait_of_serve",
+                       "headroom_of_fold", "headroom_of_serve"}
+    assert all(0.0 <= v <= 1.0 for v in bf.values())
+    # one serve-wall sample per headline tick: the bootstrap's input
+    assert len(pf["raw_wall_s"]) > 0
+    assert all(t >= 0 for t in pf["raw_wall_s"])
+    assert len(pf["perf_leg"]["raw_wall_s"]) > 0
+    assert pf["noise_floor"] > 0
+    assert pf["spans_per_sec_on"] > 0
+    assert pf["spans_per_sec_off"] == out["value"]
+    assert 0.0 <= pf["overhead_fraction"] < 1.0
+    par = pf["parity"]
+    assert par["alerts_identical"] is True
+    assert par["states_identical"] is True
+    assert par["p99_identical"] is True
+    assert par["shed_identical"] is True
+    # a self-diff of the finished capture must be clean: decisions
+    # byte-exact by identity, walls trivially within the noise model
+    from anomod.obs.perf import diff_captures
+    self_diff = diff_captures(out, json.loads(json.dumps(out)))
+    assert self_diff["status"] == "ok"
+    assert self_diff["decisions"]["identical"] is True
     # elasticity block (ISSUE-13): the policy leg under the scripted
     # surge must complete a full scaling episode (>=1 up AND >=1 down)
     # and carry the elastic determinism parity bits — byte-identical
@@ -322,6 +359,7 @@ def test_pre_bench_exit_codes_named_and_unique():
         "EXIT_NATIVE_UNUSABLE": 5, "EXIT_STATE_POOL_UNUSABLE": 6,
         "EXIT_FLIGHT_DIVERGENCE": 7, "EXIT_RECOVERY_DIVERGENCE": 8,
         "EXIT_LINT": 9, "EXIT_POLICY_DIVERGENCE": 10,
+        "EXIT_PERF_DIVERGENCE": 11,
     }
     # every literal return in the gate's source goes through a constant
     src = (Path(__file__).parent.parent / "scripts"

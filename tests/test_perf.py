@@ -1,0 +1,521 @@
+"""Performance observatory (anomod.obs.perf) + `anomod perf`.
+
+The acceptance-critical pins: the dispatch-lifecycle recorder is a pure
+READ-SIDE consumer (states/alerts/SLO/shed and the canonical flight
+journal byte-identical with recording on or off); the event timeline
+RECONCILES with the five-leg ServeReport walls (the hooks reuse the
+wall-leg clock reads, so agreement is float-rounding-exact for the
+dispatch and fold legs); the overlap-headroom analyzer implements its
+documented model exactly (synthetic-event unit pins); `anomod perf
+diff` passes two same-seed captures and flags a doctored 2× wall
+slowdown by name; and the Chrome export rides the one Tracer pipeline
+with shard/slot tags that survive the ``spans_from_chrome`` round trip.
+"""
+
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from anomod.obs.perf import (EVENT_FIELDS, PerfRecorder, analyze_events,
+                             bootstrap_ratio_ci, capture_history,
+                             collect_decisions, collect_wall_samples,
+                             diff_captures, fold_perf_records, perf_tracer)
+from anomod.serve.engine import SHARD_VARIANT_REPORT_FIELDS, run_power_law
+
+#: the shared tiny seeded run (the test_flight idiom): long enough for
+#: multiple fused dispatch rounds per tick so the pipeline actually
+#: carries in-flight work the timeline can see
+RUN_KW = dict(n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+              overload=2.0, duration_s=20, tick_s=1.0, seed=5,
+              window_s=5.0, baseline_windows=4, fault_tenants=1,
+              buckets=(64, 256), lane_buckets=(1, 2, 4), max_backlog=1500,
+              n_windows=16, shards=1, pipeline=2)
+
+
+def _run(**overrides):
+    return run_power_law(**{**RUN_KW, **overrides})
+
+
+@pytest.fixture(scope="module")
+def perf_pair():
+    """One perf-off / perf-on run pair on the same seed."""
+    eng_off, rep_off = _run()
+    eng_on, rep_on = _run(perf=True)
+    return eng_off, rep_off, eng_on, rep_on
+
+
+# ---------------------------------------------------------------------------
+# the read-side contract (the PR-9 pin technique)
+# ---------------------------------------------------------------------------
+
+def test_perf_on_off_decisions_byte_identical(perf_pair):
+    eng_off, rep_off, eng_on, rep_on = perf_pair
+    # every tenant's alert stream and replay state, bitwise
+    assert set(eng_off._tenant_det) == set(eng_on._tenant_det)
+    for tid in eng_off._tenant_det:
+        assert eng_off.alerts_for(tid) == eng_on.alerts_for(tid)
+        s1 = eng_off._tenant_replay[tid].state
+        s2 = eng_on._tenant_replay[tid].state
+        assert np.array_equal(np.asarray(s1.agg), np.asarray(s2.agg))
+        assert np.array_equal(np.asarray(s1.hist), np.asarray(s2.hist))
+    # SLO / shed / admission byte-identical
+    assert rep_off.latency == rep_on.latency
+    assert rep_off.shed_fraction == rep_on.shed_fraction
+    assert rep_off.per_priority == rep_on.per_priority
+    # report-field equality outside the declared variant surface and
+    # the perf plane's own config bit
+    skip = set(SHARD_VARIANT_REPORT_FIELDS) | {"perf_enabled"}
+    a = {k: v for k, v in rep_off.to_dict().items() if k not in skip}
+    b = {k: v for k, v in rep_on.to_dict().items() if k not in skip}
+    assert a == b
+    # canonical flight journals equal — the recorder never touched a
+    # canonical plane (events ride the `perf` VARIANT key only)
+    assert eng_off.flight_recorder.canonical_bytes() \
+        == eng_on.flight_recorder.canonical_bytes()
+
+
+def test_perf_plane_live_and_variant_declared(perf_pair):
+    _, _, eng_on, rep_on = perf_pair
+    assert rep_on.perf_enabled is True
+    assert rep_on.perf_events_recorded > 0
+    assert rep_on.fold_wait_s > 0.0
+    assert 0.0 <= rep_on.overlap_headroom_s <= rep_on.fold_wait_s + 1e-9
+    assert eng_on.perf_events_dropped == 0
+    # the new report fields are consciously variant (the P401 route)
+    for f in ("perf_events_recorded", "overlap_headroom_s",
+              "fold_wait_s", "bubble_fractions"):
+        assert f in SHARD_VARIANT_REPORT_FIELDS
+    from anomod.obs.flight import FLIGHT_VARIANT_KEYS
+    assert "perf" in FLIGHT_VARIANT_KEYS
+    # every journal record carries the perf tier (the self-describing
+    # shape contract), and the events landed in the VARIANT tier
+    recs = eng_on.flight_recorder.records()
+    assert all("perf" in r for r in recs)
+    assert sum(len(r["perf"]["events"]) for r in recs) \
+        == rep_on.perf_events_recorded
+    # a perf-OFF engine journals the tier EMPTY, never absent
+    eng_off = perf_pair[0]
+    assert all(r["perf"] == {"events": [], "headroom_s": 0.0,
+                             "wait_s": 0.0}
+               for r in eng_off.flight_recorder.records())
+
+
+# ---------------------------------------------------------------------------
+# timeline ↔ five-leg wall reconciliation
+# ---------------------------------------------------------------------------
+
+def test_timeline_reconciles_with_report_walls(perf_pair):
+    """The events reuse the wall-leg clock reads: the summed dispatch
+    and fold event durations equal the report walls to rounding, stage
+    events are a subset of the stage wall (stage_plan time is not a
+    dispatch event), and the measured WAIT fits inside the fold leg."""
+    _, _, eng_on, rep_on = perf_pair
+    evs = eng_on.perf_events
+    assert evs and all(set(EVENT_FIELDS) == set(e) for e in evs)
+    disp = sum(e["submitted"] - e["submitted_t0"] for e in evs)
+    fold = sum(e["folded"] - e["retire_t0"] for e in evs)
+    stage = sum(e["staged"] - e["staged_t0"] for e in evs)
+    wait = sum(e["materialized"] - e["retire_t0"] for e in evs)
+    # report walls round to 4 digits; per-leg slack is rounding only
+    assert abs(disp - rep_on.dispatch_wall_s) <= 1e-3 + 0.01 * disp
+    assert abs(fold - rep_on.fold_wall_s) <= 1e-3 + 0.01 * fold
+    assert 0.0 < stage <= rep_on.stage_wall_s + 1e-3
+    assert 0.0 < wait <= fold + 1e-9
+    assert abs(wait - rep_on.fold_wait_s) < 1e-6
+    # per-tick: each journal record's perf events sum to that record's
+    # variant fold-leg wall delta (the journal carries both surfaces)
+    for rec in eng_on.flight_recorder.records():
+        evs_t = rec["perf"]["events"]
+        if not evs_t:
+            continue
+        fold_t = sum(e["folded"] - e["retire_t0"] for e in evs_t)
+        assert abs(fold_t - rec["walls"]["fold_s"]) <= 2e-3
+    # lifecycle ordering holds per event
+    for e in evs:
+        assert e["staged_t0"] <= e["staged"] <= e["submitted_t0"] \
+            <= e["submitted"] <= e["retire_t0"] <= e["materialized"] \
+            <= e["folded"]
+
+
+def test_slot_refill_stamps_previous_dispatch(perf_pair):
+    """A reused scratch slot stamps the PREVIOUS dispatch on that slot
+    with the refill time — strictly after that dispatch materialized
+    (the PR-5 scratch-reuse contract, now visible in the timeline)."""
+    _, _, eng_on, _ = perf_pair
+    refilled = [e for e in eng_on.perf_events
+                if e["refill"] is not None]
+    assert refilled, "a multi-round run must reuse scratch slots"
+    for e in refilled:
+        assert e["refill"] >= e["materialized"]
+
+
+# ---------------------------------------------------------------------------
+# the overlap-headroom model (synthetic-event unit pins)
+# ---------------------------------------------------------------------------
+
+def _ev(seq, slot, wait, stage, tick=0, shard=0, t0=0.0):
+    """A synthetic lifecycle event: ``stage`` seconds of scratch pack,
+    ``wait`` seconds blocked at retire."""
+    staged = t0 + stage
+    return {"seq": seq, "tick": tick, "shard": shard, "width": 64,
+            "lanes": 2, "slot": slot, "staged_t0": t0, "staged": staged,
+            "submitted_t0": staged, "submitted": staged + 0.001,
+            "retire_t0": staged + 0.002,
+            "materialized": staged + 0.002 + wait,
+            "folded": staged + 0.003 + wait, "refill": None}
+
+
+def test_headroom_claims_later_other_slot_staging():
+    # dispatch 0 waits 10 ms; dispatch 1 (other slot) stages 4 ms after
+    # it — all 4 ms are legally hideable under the wait
+    evs = [_ev(0, slot=0, wait=0.010, stage=0.001),
+           _ev(1, slot=1, wait=0.0, stage=0.004, t0=1.0)]
+    got = analyze_events(evs, pipeline=2)
+    assert got["n_events"] == 2
+    assert abs(got["wait_s"] - 0.010) < 1e-12
+    assert abs(got["headroom_s"] - 0.004) < 1e-12
+
+
+def test_headroom_capped_by_wait_and_blocked_by_same_slot():
+    # same-slot follower: its staging needs THIS slot, the barrier
+    # protects exactly that — zero headroom
+    evs = [_ev(0, slot=0, wait=0.010, stage=0.001),
+           _ev(1, slot=0, wait=0.0, stage=0.004, t0=1.0)]
+    assert analyze_events(evs, pipeline=2)["headroom_s"] == 0.0
+    # headroom never exceeds the wait it hides under
+    evs = [_ev(0, slot=0, wait=0.002, stage=0.001),
+           _ev(1, slot=1, wait=0.0, stage=0.050, t0=1.0)]
+    got = analyze_events(evs, pipeline=2)
+    assert abs(got["headroom_s"] - 0.002) < 1e-12
+
+
+def test_headroom_depth_window_and_single_claim():
+    # pipeline=1: only the NEXT other-slot dispatch's staging is legal
+    evs = [_ev(0, slot=0, wait=0.010, stage=0.001),
+           _ev(1, slot=1, wait=0.0, stage=0.003, t0=1.0),
+           _ev(2, slot=2, wait=0.0, stage=0.004, t0=2.0)]
+    got = analyze_events(evs, pipeline=1)
+    assert abs(got["headroom_s"] - 0.003) < 1e-12
+    # pipeline=2 reaches both
+    got = analyze_events(evs, pipeline=2)
+    assert abs(got["headroom_s"] - 0.007) < 1e-12
+    # a stage wall claims once: the earliest wait takes both followers'
+    # staging (1 + 3 ms); the second wait finds nothing left — the
+    # total is 4 ms, NOT 4 + 3 (double-counting ev2 under both waits)
+    evs = [_ev(0, slot=0, wait=0.010, stage=0.001),
+           _ev(1, slot=1, wait=0.010, stage=0.001, t0=1.0),
+           _ev(2, slot=2, wait=0.0, stage=0.003, t0=2.0)]
+    got = analyze_events(evs, pipeline=4)
+    assert abs(got["headroom_s"] - 0.004) < 1e-12
+    # groups never span (tick, shard) boundaries
+    evs = [_ev(0, slot=0, wait=0.010, stage=0.001, tick=0),
+           _ev(1, slot=1, wait=0.0, stage=0.004, t0=1.0, tick=1)]
+    assert analyze_events(evs, pipeline=2)["headroom_s"] == 0.0
+
+
+def test_fold_perf_records_order_and_recorder_abort():
+    a = [_ev(0, slot=0, wait=0, stage=0.001, shard=1)]
+    b = [_ev(0, slot=0, wait=0, stage=0.001, shard=0),
+         _ev(1, slot=1, wait=0, stage=0.001, shard=0)]
+    folded = fold_perf_records([a, b])
+    assert [(e["shard"], e["seq"]) for e in folded] == \
+        [(0, 0), (0, 1), (1, 0)]
+    # an aborted dispatch drops its open record, counted
+    rec = PerfRecorder(0)
+    rec.note_staged((64, 2, 0), 0.0, 0.001)
+    rec.note_aborted((64, 2, 0))
+    assert rec.drain() == [] and rec.n_aborted == 1
+
+
+# ---------------------------------------------------------------------------
+# noise-aware capture diffing
+# ---------------------------------------------------------------------------
+
+def _capture(walls, shed=0.4, p99=23.0):
+    return {"metric": "serve_sustained_throughput", "value": 1e5,
+            "shed_fraction": shed,
+            "p99_admission_to_scored_latency_s": p99,
+            "staging": {"parity": {"alerts_identical": True}},
+            "perf": {"raw_wall_s": list(walls),
+                     "overlap_headroom_s": 0.01}}
+
+
+def test_diff_same_capture_clean_and_doctored_flagged():
+    rng = np.random.default_rng(0)
+    walls = (0.05 + 0.01 * rng.random(40)).tolist()
+    a = _capture(walls)
+    doc = diff_captures(a, copy.deepcopy(a), noise_floor=0.35)
+    assert doc["status"] == "ok"
+    assert doc["decisions"]["identical"] is True
+    assert doc["regressions"] == []
+    assert doc["noise_model"]["floor_fraction"] == 0.35
+    # a 2x wall slowdown clears any reasonable noise floor and is
+    # named by path — the mechanized answer to "is this PR slower"
+    slow = _capture([2.0 * w for w in walls])
+    doc = diff_captures(a, slow, noise_floor=0.35)
+    assert doc["status"] == "wall-regression"
+    assert doc["regressions"][0]["path"] == "perf.raw_wall_s"
+    assert doc["regressions"][0]["ci95"][0] > 1.35
+    # ...and the mirror direction reads as improvement, not regression
+    doc = diff_captures(slow, a, noise_floor=0.35)
+    assert doc["status"] == "ok"
+    assert doc["walls"][0]["verdict"] == "improvement"
+    # noise-sized wobble stays within the floor
+    wobble = _capture([1.1 * w for w in walls])
+    assert diff_captures(a, wobble, noise_floor=0.35)["status"] == "ok"
+
+
+def test_diff_decision_drift_is_never_noise():
+    a = _capture([0.05] * 10)
+    b = _capture([0.05] * 10, shed=0.41)
+    doc = diff_captures(a, b, noise_floor=0.35)
+    assert doc["status"] == "decision-drift"
+    assert doc["decision_mismatches"][0]["path"] == "shed_fraction"
+    # parity bits are decisions too
+    b = _capture([0.05] * 10)
+    b["staging"]["parity"]["alerts_identical"] = False
+    doc = diff_captures(a, b, noise_floor=0.35)
+    assert any(m["path"] == "staging.parity.alerts_identical"
+               for m in doc["decision_mismatches"])
+
+
+def test_diff_decision_coverage_gap_is_not_ok():
+    """A diff that never actually compared the decision surface must
+    not report ok: a truncated/foreign capture sharing NO decision
+    keys reads as a coverage gap (identical=None), while PARTIAL
+    overlap stays legitimate — block schemas grow across PRs."""
+    a = _capture([0.05] * 10)
+    b = {"metric": "x", "perf": {"raw_wall_s": [0.05] * 10}}
+    doc = diff_captures(a, b, noise_floor=0.35)
+    assert doc["status"] == "decision-coverage-gap"
+    assert doc["decisions"]["identical"] is None
+    assert doc["decisions"]["compared"] == 0
+    # partial overlap (B grew a block A lacks) is still ok
+    c = copy.deepcopy(a)
+    c["new_block"] = {"shed_fraction": 0.7}
+    doc = diff_captures(a, c, noise_floor=0.35)
+    assert doc["status"] == "ok"
+    assert doc["decisions"]["only_in_b"] == ["new_block.shed_fraction"]
+    # two decision-free docs compare nothing and that IS ok
+    assert diff_captures({"x": 1}, {"x": 2})["status"] == "ok"
+
+
+def test_collectors_and_bootstrap_determinism():
+    a = _capture([0.05] * 5)
+    assert "perf.raw_wall_s" in collect_wall_samples(a)
+    dec = collect_decisions(a)
+    assert "shed_fraction" in dec
+    assert "staging.parity.alerts_identical" in dec
+    assert "value" not in dec                  # throughput is a wall
+    # seeded bootstrap: the same inputs always give the same CI
+    x = [1.0, 1.1, 0.9, 1.05]
+    y = [2.0, 2.2, 1.8, 2.1]
+    assert bootstrap_ratio_ci(x, y) == bootstrap_ratio_ci(x, y)
+    ratio, lo, hi = bootstrap_ratio_ci(x, y)
+    assert lo <= ratio <= hi and lo > 1.5
+
+
+def test_capture_history_indexes_runs(tmp_path):
+    (tmp_path / "b.json").write_text(json.dumps(
+        {"metric": "m", "value": 2.0, "unit": "u",
+         "timestamp_utc": "2026-08-04T01:00:00Z",
+         "shed_fraction": 0.4,
+         "perf": {"overlap_headroom_s": 0.5,
+                  "raw_wall_s": [0.1, 0.2]}}))
+    (tmp_path / "a.json").write_text(json.dumps(
+        {"metric": "m", "value": 1.0, "unit": "u",
+         "timestamp_utc": "2026-08-03T01:00:00Z"}))
+    (tmp_path / "junk.json").write_text("not json")
+    (tmp_path / "other.json").write_text(json.dumps({"no": "metric"}))
+    rows = capture_history(tmp_path)
+    assert [r["value"] for r in rows] == [1.0, 2.0]   # timestamp order
+    assert rows[1]["overlap_headroom_s"] == 0.5
+    assert rows[1]["n_wall_sample_legs"] == 1
+    assert rows[0]["overlap_headroom_s"] is None
+
+
+# ---------------------------------------------------------------------------
+# Chrome/Perfetto export through the one Tracer pipeline
+# ---------------------------------------------------------------------------
+
+def test_perf_chrome_export_roundtrip(perf_pair):
+    from anomod.utils.tracing import spans_from_chrome
+    _, _, eng_on, _ = perf_pair
+    tr = perf_tracer(eng_on.perf_events)
+    events = tr.to_chrome()
+    assert events and all(e["ph"] == "X" for e in events)
+    # shard + pipeline-slot tags ride args (the Perfetto grouping key)
+    assert all("shard" in e["args"] and "slot" in e["args"]
+               for e in events)
+    spans = spans_from_chrome(events)
+    names = {s["name"] for s in spans}
+    assert {"lane.stage", "lane.dispatch", "lane.inflight",
+            "lane.wait", "lane.fold"} <= names
+    # round trip: tags and lanes survive a Perfetto-style re-sort
+    resorted = spans_from_chrome(
+        sorted(events, key=lambda e: e["ts"], reverse=True))
+    assert resorted == spans
+    for s in spans:
+        assert s["tags"]["shard"] == "0"
+        assert "slot" in s["tags"] and "width" in s["tags"]
+    # distinct scratch slots land on distinct lanes (tids)
+    by_slot = {}
+    for e in events:
+        by_slot.setdefault((e["args"]["width"], e["args"]["lanes"],
+                            e["args"]["slot"]), set()).add(e["tid"])
+    assert all(len(tids) == 1 for tids in by_slot.values())
+    if len(by_slot) > 1:
+        all_tids = [next(iter(t)) for t in by_slot.values()]
+        assert len(set(all_tids)) == len(all_tids)
+
+
+def test_tracer_worker_thread_lanes_and_tags():
+    """Satellite pin: worker-thread spans export on their OWN chrome
+    lane (tid) with shard tags in args, and spans_from_chrome carries
+    the lane through the round trip."""
+    import threading
+
+    from anomod.utils.tracing import Tracer, spans_from_chrome
+    tr = Tracer("anomod-test")
+    with tr.span("coordinator"):
+        pass
+    # both workers alive at once (a finished thread's ident is
+    # reusable — the engine's ShardWorkers are persistent, which is
+    # what the lane-per-thread contract rides on)
+    barrier = threading.Barrier(2)
+
+    def worker(shard):
+        with tr.span("serve.score_shard", shard=shard, pipeline=2):
+            barrier.wait(timeout=10)
+
+    ts = [threading.Thread(target=worker, args=(s,)) for s in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    events = tr.to_chrome()
+    shard_spans = [e for e in events
+                   if e["name"] == "serve.score_shard"]
+    assert {e["args"]["shard"] for e in shard_spans} == {"0", "1"}
+    # worker lanes are distinct from the coordinator's lane 0
+    assert all(e["tid"] != 0 for e in shard_spans)
+    assert len({e["tid"] for e in shard_spans}) == 2
+    spans = spans_from_chrome(events)
+    got = [s for s in spans if s["name"] == "serve.score_shard"]
+    assert {s["tags"]["shard"] for s in got} == {"0", "1"}
+    assert all(s["tid"] != 0 for s in got)
+
+
+def test_sharded_engine_trace_carries_shard_tags():
+    """The engine's worker-thread score spans carry the shard tag into
+    the chrome export — a 2-shard trace's lanes group by shard."""
+    from anomod.utils.tracing import Tracer
+    tracer = Tracer("anomod-serve")
+    _run(shards=2, tracer=tracer)
+    events = tracer.to_chrome()
+    shard_spans = [e for e in events
+                   if e["name"] == "serve.score_shard"]
+    assert {e["args"]["shard"] for e in shard_spans} == {"0", "1"}
+    assert len({e["tid"] for e in shard_spans}) == 2
+
+
+# ---------------------------------------------------------------------------
+# knobs + CLI
+# ---------------------------------------------------------------------------
+
+def test_perf_knobs_validated(monkeypatch):
+    from anomod.config import Config
+    monkeypatch.setenv("ANOMOD_PERF", "1")
+    monkeypatch.setenv("ANOMOD_PERF_MAX_EVENTS", "1024")
+    monkeypatch.setenv("ANOMOD_PERF_NOISE_FLOOR", "0.2")
+    cfg = Config()
+    assert cfg.perf is True
+    assert cfg.perf_max_events == 1024
+    assert cfg.perf_noise_floor == 0.2
+    for var, bad in (("ANOMOD_PERF_MAX_EVENTS", "zero"),
+                     ("ANOMOD_PERF_MAX_EVENTS", "0"),
+                     ("ANOMOD_PERF_NOISE_FLOOR", "lots"),
+                     ("ANOMOD_PERF_NOISE_FLOOR", "-1")):
+        monkeypatch.setenv(var, bad)
+        with pytest.raises(ValueError):
+            Config()
+        monkeypatch.setenv("ANOMOD_PERF_MAX_EVENTS", "1024")
+        monkeypatch.setenv("ANOMOD_PERF_NOISE_FLOOR", "0.2")
+
+
+def test_perf_cli_record_and_diff(tmp_path, capsys):
+    from anomod.cli import main
+    out = tmp_path / "timeline.json"
+    chrome = tmp_path / "timeline_chrome.json"
+    rc = main(["perf", "record", "--out", str(out),
+               "--chrome", str(chrome), "--tenants", "4",
+               "--duration", "8", "--tick", "1.0",
+               "--capacity", "1000", "--seed", "3"])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["perf_format"] == 1
+    assert doc["report"]["perf_events_recorded"] == len(doc["events"])
+    assert len(doc["raw_wall_s"]) == 8
+    from anomod.utils.tracing import spans_from_chrome
+    spans = spans_from_chrome(json.loads(chrome.read_text()))
+    assert any(s["name"] == "lane.stage" for s in spans)
+    capsys.readouterr()
+    # diff: a capture against itself exits 0; a doctored 2x exits 1
+    # naming the wall; a decision drift exits 2
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    cap = _capture([0.05, 0.06, 0.055, 0.052, 0.058] * 4)
+    a.write_text(json.dumps(cap))
+    b.write_text(json.dumps(cap))
+    assert main(["perf", "diff", str(a), str(b)]) == 0
+    capsys.readouterr()
+    slow = copy.deepcopy(cap)
+    slow["perf"]["raw_wall_s"] = [2 * w for w in
+                                  slow["perf"]["raw_wall_s"]]
+    b.write_text(json.dumps(slow))
+    assert main(["perf", "diff", str(a), str(b)]) == 1
+    got = json.loads(capsys.readouterr().out)
+    assert got["regressions"][0]["path"] == "perf.raw_wall_s"
+    drift = copy.deepcopy(cap)
+    drift["shed_fraction"] = 0.99
+    b.write_text(json.dumps(drift))
+    assert main(["perf", "diff", str(a), str(b)]) == 2
+    capsys.readouterr()
+    # a coverage-gap diff exits 2 like drift: nothing was compared
+    b.write_text(json.dumps({"metric": "x",
+                             "perf": {"raw_wall_s": [0.05] * 10}}))
+    assert main(["perf", "diff", str(a), str(b)]) == 2
+    capsys.readouterr()
+    # history over the two files
+    assert main(["perf", "history", str(tmp_path)]) == 0
+    hist = json.loads(capsys.readouterr().out)
+    assert hist["n_captures"] >= 2
+    # mode-mismatched flags fail loud, never silently ignored
+    with pytest.raises(SystemExit):
+        main(["perf", "history", str(tmp_path), "--out", "x.json"])
+    with pytest.raises(SystemExit):
+        main(["perf", "history", str(tmp_path), "--noise-floor", "0.2"])
+    capsys.readouterr()
+
+
+def test_perf_retention_bound_counts_drops(monkeypatch):
+    """The retained-event ring is bounded and every eviction is
+    counted — loss visible, never silent (the flight-ring pin)."""
+    monkeypatch.setenv("ANOMOD_PERF_MAX_EVENTS", "8")
+    from anomod.config import Config, get_config, set_config
+    old = get_config()
+    try:
+        set_config(Config())
+        # flight OFF: the perf plane still accumulates and retains
+        # (the journal doc alone is skipped — nothing consumes it)
+        eng, rep = _run(perf=True, duration_s=10, flight=False)
+        assert rep.perf_events_recorded > 8
+        assert rep.fold_wait_s > 0.0
+        assert len(eng.perf_events) == 8
+        assert eng.perf_events_dropped == rep.perf_events_recorded - 8
+        # the retained tail is the newest events
+        assert eng.perf_events[-1]["tick"] >= eng.perf_events[0]["tick"]
+    finally:
+        set_config(old)
