@@ -603,6 +603,62 @@ def main(argv=None) -> int:
                              "CIs must clear (default: "
                              "ANOMOD_PERF_NOISE_FLOOR, 0.35)")
 
+    p_cen = sub.add_parser(
+        "census", help="fleet census observatory (anomod.obs.census): "
+        "`record` runs seeded traffic with the deterministic resident-"
+        "bytes + hot-set/Zipf census on and dumps the census timeline, "
+        "`probe` sweeps registered-fleet sizes at fixed hot traffic and "
+        "fits the O(registered) per-tick wall and resident-bytes "
+        "slopes (the baseline the million-tenant tiering refactor must "
+        "flatten), and `diff` compares two bench captures' census "
+        "blocks — byte counts exact (deterministic, so every delta is "
+        "real), wall slopes within the explicit box noise tolerance — "
+        "exiting nonzero on a regression: the tiering PR's "
+        "before/after judge")
+    p_cen.add_argument("action", choices=["record", "probe", "diff"])
+    p_cen.add_argument("paths", nargs="*",
+                       help="diff: the two capture JSONs (A then B)")
+    p_cen.add_argument("--out", default=None,
+                       help="record: census-timeline JSON output path "
+                            "(required); probe: optional sweep output "
+                            "path")
+    # every shape flag defaults to None so the other actions can tell
+    # "passed" from "absent" and refuse it loudly (the audit-branch
+    # discipline: a silently ignored flag makes the user believe they
+    # parameterized the run); each action resolves its real defaults
+    p_cen.add_argument("--tenants", type=int, default=None,
+                       help="record only (default 24)")
+    p_cen.add_argument("--duration", type=float, default=None,
+                       help="record: virtual seconds to serve "
+                            "(default 30)")
+    p_cen.add_argument("--tick", type=float, default=None,
+                       help="record only (default 0.5)")
+    p_cen.add_argument("--capacity", type=float, default=None,
+                       help="record only (default 4000)")
+    p_cen.add_argument("--overload", type=float, default=None,
+                       help="record only (default 1.5)")
+    p_cen.add_argument("--seed", type=int, default=None,
+                       help="record/probe (default 0)")
+    p_cen.add_argument("--shards", type=int, default=None,
+                       help="record: engine shard count (default: "
+                            "ANOMOD_SERVE_SHARDS)")
+    p_cen.add_argument("--every", type=int, default=None,
+                       help="record: census cadence in ticks "
+                            "(default: ANOMOD_CENSUS_EVERY)")
+    p_cen.add_argument("--sizes", default=None,
+                       help="probe: comma-separated registered-fleet "
+                            "sizes (default: ANOMOD_CENSUS_SWEEP)")
+    p_cen.add_argument("--hot", type=int, default=None,
+                       help="probe: fixed hot-traffic tenant count "
+                            "(default 1000)")
+    p_cen.add_argument("--ticks", type=int, default=None,
+                       help="probe: measured ticks per sweep size "
+                            "(default 8)")
+    p_cen.add_argument("--tolerance", type=float, default=None,
+                       help="diff: wall-slope noise tolerance the B/A "
+                            "ratio must clear (default: "
+                            "ANOMOD_PERF_NOISE_FLOOR)")
+
     p_q = sub.add_parser(
         "quality", help="de-saturated quality sweep: degradation curves over "
         "fault severity with noise + confounders (HardMode)")
@@ -1209,6 +1265,155 @@ def main(argv=None) -> int:
             tr.dump_chrome(_P(args.chrome))
             out["chrome"] = {"out": args.chrome, "spans": tr.n_spans}
         print(json.dumps(out, indent=2))
+        return 0
+
+    if args.cmd == "census":
+        from pathlib import Path as _P
+        # mode-mismatched flags fail loud, never silently ignored
+        # (the audit/perf-branch discipline): record-only and
+        # probe-only flags are refused by the other actions
+        _record_only = (("--tenants", args.tenants),
+                        ("--duration", args.duration),
+                        ("--tick", args.tick),
+                        ("--capacity", args.capacity),
+                        ("--overload", args.overload),
+                        ("--shards", args.shards),
+                        ("--every", args.every))
+        _probe_only = (("--sizes", args.sizes), ("--hot", args.hot),
+                       ("--ticks", args.ticks))
+        if args.action != "record":
+            for flag, got in _record_only:
+                if got is not None:
+                    parser.error(f"{flag} applies to census record, "
+                                 f"not {args.action}")
+        if args.action != "probe":
+            for flag, got in _probe_only:
+                if got is not None:
+                    parser.error(f"{flag} applies to census probe, "
+                                 f"not {args.action}")
+        if args.action == "diff":
+            if len(args.paths) != 2:
+                parser.error("census diff takes exactly two capture "
+                             "paths (A then B)")
+            if args.out:
+                parser.error("--out applies to census record/probe")
+            if args.seed is not None:
+                parser.error("--seed applies to census record/probe")
+            from anomod.obs.census import diff_census
+            try:
+                a = json.loads(_P(args.paths[0]).read_text())
+                b = json.loads(_P(args.paths[1]).read_text())
+            except (OSError, ValueError) as e:
+                parser.error(f"cannot load capture: {e}")
+            doc = diff_census(a, b, tolerance=args.tolerance)
+            print(json.dumps(doc, indent=2))
+            if doc["status"] == "census-missing":
+                print("census diff: capture(s) carry no census block "
+                      f"(missing in {doc['missing_in']}) — nothing was "
+                      "compared, so this verdict must not pass a gate",
+                      file=sys.stderr)
+                return 2
+            if doc["status"] == "bytes-regression":
+                r = doc["bytes_regressions"][0]
+                print(f"census diff: resident bytes grew on the "
+                      f"{r['plane']!r} plane ({r['a']} -> {r['b']}) — "
+                      "byte counts are deterministic; this is real "
+                      "growth, not noise", file=sys.stderr)
+                return 1
+            if doc["status"] == "slope-regression":
+                r = doc["slope_regressions"][0]
+                if r["exact"]:
+                    # the bytes slope is deterministic — the verdict
+                    # is exact growth, never a tolerance breach
+                    print(f"census diff: the {r['slope']} baseline "
+                          f"grew (a={r['a']}, b={r['b']}) — this "
+                          "slope is deterministic; any growth is "
+                          "real, not noise", file=sys.stderr)
+                else:
+                    print(f"census diff: the {r['slope']} baseline "
+                          f"regressed (a={r['a']}, b={r['b']}) past "
+                          f"the 1+{doc['tolerance']} noise tolerance",
+                          file=sys.stderr)
+                return 1
+            return 0
+        if args.tolerance is not None:
+            parser.error("--tolerance applies to census diff")
+        if args.paths:
+            parser.error(f"census {args.action} takes no positional "
+                         "paths")
+        if args.action == "probe":
+            sizes = None
+            if args.sizes is not None:
+                try:
+                    sizes = tuple(int(p.strip())
+                                  for p in args.sizes.split(",")
+                                  if p.strip())
+                    if len(sizes) < 2 or any(s < 1 for s in sizes) \
+                            or any(a >= b for a, b
+                                   in zip(sizes, sizes[1:])):
+                        raise ValueError(
+                            "need >= 2 strictly ascending positive "
+                            "sizes")
+                except ValueError as e:
+                    parser.error(f"--sizes: {e}")
+            if args.ticks is not None and args.ticks < 1:
+                parser.error("--ticks must be >= 1")
+            if args.hot is not None and args.hot < 1:
+                parser.error("--hot must be >= 1")
+            _probe_backend(args)
+            from anomod.obs.census import CENSUS_FORMAT, fleet_probe
+            doc = {"census_format": CENSUS_FORMAT,
+                   "sweep": fleet_probe(
+                       sizes=sizes,
+                       hot=1000 if args.hot is None else args.hot,
+                       ticks=8 if args.ticks is None else args.ticks,
+                       seed=0 if args.seed is None else args.seed)}
+            if args.out:
+                from anomod.obs.flight import _atomic_write_json
+                _atomic_write_json(args.out, doc)
+                doc["out"] = args.out
+            print(json.dumps(doc, indent=2))
+            return 0
+        # record
+        if not args.out:
+            parser.error("census record needs --out")
+
+        def _or(v, default):
+            return default if v is None else v
+
+        _probe_backend(args)
+        from anomod.obs.census import CENSUS_FORMAT
+        from anomod.obs.flight import _atomic_write_json
+        from anomod.serve.engine import run_power_law
+        eng, rep = run_power_law(
+            n_tenants=_or(args.tenants, 24), n_services=8,
+            capacity_spans_per_s=_or(args.capacity, 4000.0),
+            overload=_or(args.overload, 1.5),
+            duration_s=_or(args.duration, 30.0),
+            tick_s=_or(args.tick, 0.5), seed=_or(args.seed, 0),
+            shards=args.shards, census=True, census_every=args.every,
+            flight=True)
+        stream = [rec["census"]
+                  for rec in eng.flight_recorder.records()
+                  if rec["census"]["planes"]]
+        _atomic_write_json(args.out, {
+            "census_format": CENSUS_FORMAT,
+            "engine": {"shards": rep.shards, "seed": _or(args.seed, 0),
+                       "tick_s": _or(args.tick, 0.5),
+                       "census_every": eng.census_every},
+            "report": {
+                "census_ticks": rep.census_ticks,
+                "census_hot_set": rep.census_hot_set,
+                "census_resident_bytes": rep.census_resident_bytes},
+            "stream": stream})
+        print(json.dumps({
+            "action": "record", "out": args.out,
+            "census_ticks": rep.census_ticks,
+            "resident_bytes":
+                rep.census_resident_bytes.get("total"),
+            "pool_reconciled":
+                rep.census_resident_bytes.get("pool_reconciled"),
+            "hot_set": rep.census_hot_set}, indent=2))
         return 0
 
     if args.cmd == "audit":

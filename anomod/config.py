@@ -869,6 +869,117 @@ def _perf_noise_floor_env() -> float:
     return v
 
 
+def _census_env() -> bool:
+    """ANOMOD_CENSUS: the fleet census observatory (anomod.obs.census).
+
+    Default OFF — like the perf timeline it is a deep-dive instrument
+    (the flight recorder stays the always-on journal); when on, every
+    ``ANOMOD_CENSUS_EVERY``-th tick takes a deterministic resident-
+    bytes census (per-(shard, plane) byte counts from array shapes and
+    container lengths — never an RSS wall) plus the hot-set/Zipf
+    census, exported as registry gauges and the flight journal's
+    ``census`` VARIANT key.  A pure read-side consumer: decisions are
+    byte-identical on or off (pinned), overhead priced in the bench
+    ``census`` block (≤5% bar).
+    """
+    return _env("ANOMOD_CENSUS", "0").strip().lower() \
+        not in ("0", "false", "off", "no", "")
+
+
+def _census_every_env() -> int:
+    """ANOMOD_CENSUS_EVERY: census cadence in ticks (the flight
+    digest-cadence idiom).  Every Nth tick the census drains at the
+    tick barrier; a census is also always forced into the run-end
+    settlement record.  1 censuses every tick."""
+    raw = _env("ANOMOD_CENSUS_EVERY", "8")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_CENSUS_EVERY must be a positive integer, got {raw!r}")
+    if not 1 <= n <= 1_000_000:
+        raise ValueError(
+            f"ANOMOD_CENSUS_EVERY must be in [1, 1000000], got {n}")
+    return n
+
+
+#: default hot-set decay thresholds (ticks): the census reports the
+#: hot-set size at each — how many tenants were served within the last
+#: N ticks (anomod.obs.census.CensusTracker.hot_doc)
+DEFAULT_CENSUS_DECAY_TICKS = (4, 16, 64, 256)
+
+
+def _census_int_tuple_env(name: str, default: tuple, lo: int,
+                          hi: int) -> tuple:
+    """Shared validator for the census's ascending-int-list knobs
+    (decay thresholds, sweep sizes): comma-separated positive ints,
+    strictly ascending — the bucket-set contract."""
+    raw = _env(name, "")
+    if not raw:
+        return default
+    parts = [p.strip() for p in raw.split(",") if p.strip()]
+    try:
+        out = tuple(int(p) for p in parts)
+    except ValueError:
+        raise ValueError(f"{name} must be comma-separated integers, "
+                         f"got {raw!r}")
+    if not out:
+        raise ValueError(f"{name} must not be empty")
+    if any(not lo <= v <= hi for v in out):
+        raise ValueError(f"{name} entries must be in [{lo}, {hi}], "
+                         f"got {out}")
+    if any(a >= b for a, b in zip(out, out[1:])):
+        raise ValueError(f"{name} must be strictly ascending: {out}")
+    return out
+
+
+def _census_decay_ticks_env() -> tuple:
+    """ANOMOD_CENSUS_DECAY_TICKS: comma-separated hot-set decay
+    thresholds in ticks, strictly ascending (e.g. ``4,16,64``) — the
+    hot-set-size-at-decay-threshold curve's x axis."""
+    return _census_int_tuple_env("ANOMOD_CENSUS_DECAY_TICKS",
+                                 DEFAULT_CENSUS_DECAY_TICKS,
+                                 1, 10_000_000)
+
+
+#: default registered-fleet sweep sizes for the census cost-attribution
+#: probe (anomod.obs.census.fleet_probe): tick wall + resident bytes
+#: measured at each registered count (fixed ~1e3-hot traffic), slopes
+#: fitted vs registered — the O(registered) baseline the ROADMAP's
+#: tiering refactor must flatten
+DEFAULT_CENSUS_SWEEP = (1_000, 10_000, 100_000)
+
+
+def _census_sweep_env() -> tuple:
+    """ANOMOD_CENSUS_SWEEP: comma-separated registered-fleet sizes for
+    the census probe sweep, strictly ascending; at least two sizes (a
+    slope needs two points)."""
+    out = _census_int_tuple_env("ANOMOD_CENSUS_SWEEP",
+                                DEFAULT_CENSUS_SWEEP, 1, 10_000_000)
+    if len(out) < 2:
+        raise ValueError(
+            f"ANOMOD_CENSUS_SWEEP needs >= 2 sizes (a slope fit needs "
+            f"two points), got {out}")
+    return out
+
+
+def _census_coldest_k_env() -> int:
+    """ANOMOD_CENSUS_COLDEST_K: coldest-K eviction-candidate preview
+    length per census tick (observed-only; the future LRU demotion
+    policy's input)."""
+    raw = _env("ANOMOD_CENSUS_COLDEST_K", "8")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_CENSUS_COLDEST_K must be a positive integer, "
+            f"got {raw!r}")
+    if not 1 <= n <= 4096:
+        raise ValueError(
+            f"ANOMOD_CENSUS_COLDEST_K must be in [1, 4096], got {n}")
+    return n
+
+
 def _native_env() -> str:
     """ANOMOD_NATIVE: the C++ native runtime switch (anomod.io.native) —
     ingest scanning AND the serving plane's GIL-free lane staging.
@@ -1098,6 +1209,26 @@ class Config:
     # perf diff` tests bootstrap wall-ratio CIs against.
     perf_noise_floor: float = dataclasses.field(
         default_factory=_perf_noise_floor_env)
+    # ANOMOD_CENSUS — fleet census observatory: deterministic
+    # resident-bytes + hot-set/Zipf census per cadence tick
+    # (anomod.obs.census; off by default, pure read-side).
+    census: bool = dataclasses.field(default_factory=_census_env)
+    # ANOMOD_CENSUS_EVERY — census cadence in ticks (the flight
+    # digest-cadence idiom; a census is always forced at run end).
+    census_every: int = dataclasses.field(
+        default_factory=_census_every_env)
+    # ANOMOD_CENSUS_DECAY_TICKS — hot-set decay thresholds in ticks
+    # (the hot-set-size-at-decay-threshold curve's x axis).
+    census_decay_ticks: tuple = dataclasses.field(
+        default_factory=_census_decay_ticks_env)
+    # ANOMOD_CENSUS_SWEEP — registered-fleet sizes for the census
+    # cost-attribution probe (anomod.obs.census.fleet_probe).
+    census_sweep: tuple = dataclasses.field(
+        default_factory=_census_sweep_env)
+    # ANOMOD_CENSUS_COLDEST_K — coldest-K eviction-candidate preview
+    # length per census tick.
+    census_coldest_k: int = dataclasses.field(
+        default_factory=_census_coldest_k_env)
     # ANOMOD_NATIVE — C++ native runtime switch: auto (use when the .so
     # loads), on (required, fail loud with the build reason), off
     # (pure-Python paths; anomod.io.native).

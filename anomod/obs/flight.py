@@ -78,12 +78,18 @@ PLANES: Tuple[str, ...] = ("admission", "dispatch", "fold", "score", "rca")
 #: topology: an elastic run's canonical planes stay equal to a static
 #: run's), and the performance observatory's per-tick dispatch-
 #: lifecycle timeline (anomod.obs.perf — pure wall-clock event
-#: timestamps plus the overlap-headroom bound computed from them) —
+#: timestamps plus the overlap-headroom bound computed from them), and
+#: the fleet census observatory's resident-bytes/hot-set records
+#: (anomod.obs.census — deterministic and wall-free, but per-shard
+#: pool/scratch bytes follow the execution TOPOLOGY, so the key is
+#: variant like ``topology``; unlike ``walls``/``perf`` the census
+#: stream is byte-equal across same-seed reruns of one topology,
+#: pinned in tests/test_census.py) —
 #: the flight twin of the serving plane's
 #: SHARD_VARIANT_REPORT_FIELDS (one definition, shared by
 #: canonical_ticks, the parity tests and the pre-bench flight smoke).
 FLIGHT_VARIANT_KEYS: Tuple[str, ...] = ("walls", "topology", "recovery",
-                                        "scaling", "perf")
+                                        "scaling", "perf", "census")
 
 
 def crc_text(text: str, prev: int = 0) -> int:

@@ -166,7 +166,15 @@ SHARD_VARIANT_REPORT_FIELDS = (
     # measurements — consciously VARIANT, never the parity surface
     # (perf_enabled, the config bit, stays canonical)
     "perf_events_recorded", "overlap_headroom_s", "fold_wait_s",
-    "bubble_fractions")
+    "bubble_fractions",
+    # the fleet census observatory (anomod.obs.census): resident-bytes
+    # totals follow the execution TOPOLOGY (per-shard pool capacity and
+    # scratch grids depend on the shard count and residency), so the
+    # byte dict is consciously VARIANT — the hot-set census
+    # (census_hot_set) and the census tick count derive from
+    # coordinator admission decisions alone and stay CANONICAL; the
+    # census wall is a wall measurement (the in-run overhead price)
+    "census_resident_bytes", "census_wall_s")
 
 
 def _runner_stats(r) -> dict:
@@ -324,6 +332,16 @@ class ServeReport:
     fold_wait_s: float                           # measured execute WAIT
     #                                              inside the fold leg
     bubble_fractions: Dict[str, float]           # per-leg dead-time shares
+    census_enabled: bool                         # fleet census on?
+    census_ticks: int                            # census drains taken
+    census_hot_set: Dict[str, object]            # hot-set/Zipf census
+    #                                              (canonical: admission-
+    #                                              derived, shard-invariant)
+    census_resident_bytes: Dict[str, object]     # deterministic resident
+    #                                              bytes (variant: follows
+    #                                              pool/scratch topology)
+    census_wall_s: float                         # census drain wall (the
+    #                                              in-run overhead price)
     serve_wall_s: float
     sustained_spans_per_sec: float
 
@@ -379,6 +397,8 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   flight_digest_every: Optional[int] = None,
                   flight_max_ticks: Optional[int] = None,
                   perf: Optional[bool] = None,
+                  census: Optional[bool] = None,
+                  census_every: Optional[int] = None,
                   chaos: Optional[str] = None,
                   ckpt_every: Optional[int] = None,
                   retries: Optional[int] = None,
@@ -421,7 +441,9 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          state=state, flight=flight,
                          flight_digest_every=flight_digest_every,
                          flight_max_ticks=flight_max_ticks,
-                         perf=perf, chaos=chaos, ckpt_every=ckpt_every,
+                         perf=perf, census=census,
+                         census_every=census_every,
+                         chaos=chaos, ckpt_every=ckpt_every,
                          retries=retries,
                          retry_backoff_s=retry_backoff_s,
                          max_respawns=max_respawns, policy=policy,
@@ -460,6 +482,12 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
             # re-records its timeline (variant tier — the canonical
             # journal is identical either way, the read-side pin)
             perf=engine.perf,
+            # the census plane, RESOLVED: a replay of a census-on run
+            # re-takes the same deterministic census (the `census`
+            # variant stream of a replay is byte-equal to the
+            # original's at matching topology — pinned)
+            census=engine.census,
+            census_every=engine.census_every,
             # the fault-tolerance knobs, RESOLVED: an audit replay of a
             # chaos run re-injects the same script and re-recovers —
             # its canonical journal must equal the original's (the
@@ -518,6 +546,8 @@ class ServeEngine:
                  flight_digest_every: Optional[int] = None,
                  flight_max_ticks: Optional[int] = None,
                  perf: Optional[bool] = None,
+                 census: Optional[bool] = None,
+                 census_every: Optional[int] = None,
                  chaos: Optional[object] = None,
                  ckpt_every: Optional[int] = None,
                  retries: Optional[int] = None,
@@ -711,6 +741,55 @@ class ServeEngine:
                 "anomod_serve_fold_wait_seconds_total")
             self._obs_headroom = obs.counter(
                 "anomod_serve_overlap_headroom_seconds_total")
+        #: the fleet census observatory (ANOMOD_CENSUS, anomod.obs.
+        #: census): every ANOMOD_CENSUS_EVERY-th tick (and always at
+        #: run end) the coordinator takes a deterministic resident-
+        #: bytes census of every plane (state pools, lane scratch,
+        #: admission queues/registries, SLO digests, RCA evidence,
+        #: recorder retentions — shapes and container lengths, never
+        #: an RSS wall) plus the hot-set/Zipf census, exported as
+        #: registry gauges, new ServeReport fields and the flight
+        #: journal's ``census`` VARIANT key.  A pure read-side
+        #: consumer: every decision is byte-identical with the census
+        #: on or off (pinned).
+        self.census = bool(app_cfg.census if census is None else census)
+        self.census_every = int(app_cfg.census_every
+                                if census_every is None else census_every)
+        if self.census_every < 1:
+            raise ValueError("census_every must be >= 1 tick")
+        self._census_tracker = None
+        self._census_tick_doc: Optional[dict] = None
+        self.census_ticks = 0
+        self.census_hot_set: Dict[str, object] = {}
+        self.census_resident: Dict[str, object] = {}
+        self.census_peak_bytes = 0
+        self.census_wall_s = 0.0
+        self._census_reconciled = True
+        if self.census:
+            from anomod.obs.census import CensusTracker
+            self._census_tracker = CensusTracker(
+                app_cfg.census_decay_ticks,
+                app_cfg.census_coldest_k, self.census_every)
+            # metric handles only when the plane is live (the RCA/perf
+            # discipline: a census-off run must not register
+            # permanently-zero series)
+            self._obs_census = {
+                "total": obs.gauge("anomod_census_resident_bytes"),
+                "pool": obs.gauge("anomod_census_pool_bytes"),
+                "scratch": obs.gauge("anomod_census_scratch_bytes"),
+                "admission": obs.gauge("anomod_census_admission_bytes"),
+                "slo": obs.gauge("anomod_census_slo_bytes"),
+                "rca": obs.gauge("anomod_census_rca_bytes"),
+                "recorder": obs.gauge("anomod_census_recorder_bytes"),
+                "registered": obs.gauge(
+                    "anomod_census_registered_tenants"),
+                "resident": obs.gauge("anomod_census_resident_tenants"),
+                "hot": obs.gauge("anomod_census_hot_tenants"),
+                "occupancy": obs.gauge(
+                    "anomod_census_slot_occupancy_fraction"),
+            }
+            self._obs_census_ticks = obs.counter(
+                "anomod_census_ticks_total")
         #: the runner recipe a policy-time scale-up rebuilds from (the
         #: same arguments every initial shard runner got)
         self._runner_kw = dict(lane_buckets=lane_buckets,
@@ -884,6 +963,7 @@ class ServeEngine:
                     "policy": (self.policy.mode
                                if self.policy is not None else "off"),
                     "perf": self.perf,
+                    "census": self.census,
                  },
                  "config": config_snapshot(),
                  "versions": versions()},
@@ -1207,6 +1287,21 @@ class ServeEngine:
         # runs after the score barrier, so every dispatch of this tick
         # has folded and its record is complete
         self._perf_tick_doc = self._perf_drain() if self.perf else None
+        if self._census_tracker is not None:
+            # hot-set bookkeeping every tick (O(served)); the full
+            # resident-bytes census drains on its cadence, INSIDE the
+            # measured wall (the bench census block prices it, never
+            # hides it) and after the perf drain so the recorder
+            # retentions it counts are this tick's.  The census wall
+            # accumulates separately so the bench prices the overhead
+            # IN-RUN (census_wall_s / serve_wall_s — the ckpt_wall
+            # idiom: exact, immune to this box's A/B leg noise).
+            t0 = time.perf_counter()
+            self._census_tracker.observe(self.clock.ticks, served)
+            self._census_tick_doc = (
+                self._census_drain()
+                if self._census_tracker.due(self.clock.ticks) else None)
+            self.census_wall_s += time.perf_counter() - t0
         if self.flight_recorder is not None:
             # the journal entry rides INSIDE the measured wall (the
             # serve_wall_s accumulation below) — the bench's flight
@@ -1407,6 +1502,46 @@ class ServeEngine:
                 "headroom_s": round(stats["headroom_s"], 6),
                 "wait_s": round(stats["wait_s"], 6)}
 
+    # -- the fleet census observatory (anomod.obs.census) -----------------
+
+    def _census_drain(self) -> dict:
+        """One tick-barrier census: the deterministic resident-bytes
+        walk over every plane (shapes and container lengths only — the
+        workers are quiescent at the barrier, so the per-shard pool/
+        scratch reads race nothing), the hot-set/Zipf doc, the
+        registry gauges, and the journal-shaped record the flight
+        ``census`` variant key carries.  A pure read of engine state:
+        no clocks, no RNG, no mutation of any decision plane."""
+        from anomod.obs.census import collect_resident_bytes
+        planes, by_plane, total, reconciled = \
+            collect_resident_bytes(self)
+        tracker = self._census_tracker
+        hot = tracker.hot_doc(self.clock.ticks, len(self.specs),
+                              list(self._tenant_replay))
+        self.census_ticks += 1
+        self._census_reconciled = self._census_reconciled and reconciled
+        self.census_peak_bytes = max(self.census_peak_bytes, total)
+        self.census_hot_set = hot
+        self.census_resident = {
+            "total": total, "peak_total": self.census_peak_bytes,
+            "by_plane": by_plane,
+            "pool_reconciled": self._census_reconciled}
+        g = self._obs_census
+        g["total"].set(total)
+        for plane in ("pool", "scratch", "admission", "slo", "rca"):
+            g[plane].set(by_plane.get(plane, 0))
+        g["recorder"].set(by_plane.get("flight", 0)
+                          + by_plane.get("perf", 0))
+        g["registered"].set(len(self.specs))
+        g["resident"].set(hot["resident"])
+        g["hot"].set(hot["hot_by_decay"].get(
+            str(min(tracker.decay_ticks)), 0))
+        g["occupancy"].set(hot["occupancy_vs_registered"])
+        self._obs_census_ticks.inc()
+        return {"tick": self.clock.ticks, "planes": planes,
+                "total_bytes": total, "pool_reconciled": reconciled,
+                "hot": hot}
+
     # -- the black-box flight recorder (anomod.obs.flight) ----------------
 
     def _flight_tick(self, now: float, served: List[QueuedBatch],
@@ -1567,6 +1702,17 @@ class ServeEngine:
         perf_doc, self._perf_tick_doc = self._perf_tick_doc, None
         rec["perf"] = perf_doc if perf_doc is not None else \
             {"events": [], "headroom_s": 0.0, "wait_s": 0.0}
+        # the fleet census rides the VARIANT tier too (the "census"
+        # key in FLIGHT_VARIANT_KEYS): per-shard pool/scratch bytes
+        # follow the execution topology, so the key is excluded from
+        # the canonical surface — but unlike walls/perf its content is
+        # wall-free, so the census stream is byte-equal across
+        # same-seed reruns of one topology (pinned).  ALWAYS present
+        # (empty off-cadence or with the census off) — the
+        # every-record-carries-every-tier contract.
+        census_doc, self._census_tick_doc = self._census_tick_doc, None
+        rec["census"] = census_doc if census_doc is not None else \
+            {"planes": [], "hot": {}}
         if final:
             rec["final"] = True
         fr.record(rec)
@@ -2128,6 +2274,14 @@ class ServeEngine:
             # settle any lifecycle events the final drain window left
             # (and feed the settlement record's perf key below)
             self._perf_tick_doc = self._perf_drain()
+        if self._census_tracker is not None:
+            # run-end settlement census (the forced-digest idiom):
+            # every census-on run ends on a full resident-bytes +
+            # hot-set anchor regardless of the cadence, feeding the
+            # report fields and the settlement record's census key
+            t0 = time.perf_counter()
+            self._census_tick_doc = self._census_drain()
+            self.census_wall_s += time.perf_counter() - t0
         if self.flight_recorder is not None:
             # run-end settlement record: finish() alerts + drained RCA
             # verdicts land here, and the forced state digest gives every
@@ -2403,6 +2557,11 @@ class ServeEngine:
             bubble_fractions=(_perf_bubbles(
                 self.perf_wait_s, self.perf_headroom_s, fold_wall,
                 self.serve_wall_s) if self.perf else {}),
+            census_enabled=self.census,
+            census_ticks=self.census_ticks,
+            census_hot_set=dict(self.census_hot_set),
+            census_resident_bytes=dict(self.census_resident),
+            census_wall_s=round(self.census_wall_s, 4),
             serve_wall_s=round(self.serve_wall_s, 4),
             sustained_spans_per_sec=round(
                 self.n_spans_served / max(self.serve_wall_s, 1e-9), 1),

@@ -348,6 +348,24 @@ def serve_main(probe_fresh=False) -> int:
                 shards=1, chaos=surge_script, policy="auto",
                 min_shards=1, max_shards=2, cooldown_ticks=5,
                 **elastic_kw)
+            # the CENSUS leg (ISSUE-15): same seed, the fleet census
+            # observatory (anomod.obs.census) forced ON — deterministic
+            # resident-bytes per plane, the hot-set/Zipf census, the
+            # read-side parity bits, and the on/off overhead fraction
+            # (≤5% bar, the telemetry discipline)
+            set_registry(Registry(enabled=True))
+            eng_cen, rep_cen = run_power_law(census=True, shards=1,
+                                             **run_kw)
+            # the registered-fleet sweep: per-tick wall and resident-
+            # bytes slopes vs the REGISTERED count at fixed ~1e3-hot
+            # traffic — the committed O(registered) baseline curve the
+            # million-tenant tiering refactor must flatten (`anomod
+            # census diff` judges the before/after).  Own registry so
+            # the probe engines' gauges stay out of the headline
+            # journal.
+            set_registry(Registry(enabled=True))
+            from anomod.obs.census import fleet_probe
+            census_sweep = fleet_probe()
         finally:
             set_registry(prev_reg)
         set_registry(reg)
@@ -737,6 +755,56 @@ def serve_main(probe_fresh=False) -> int:
                 "shed_identical":
                     rep_el.shed_fraction == rep_els.shed_fraction,
                 "journal_canonical_identical": _el_journal_ok,
+            },
+        }
+        # fleet census (ISSUE-15): the deterministic resident-bytes and
+        # hot-set/Zipf census on the same seed, the registered-fleet
+        # sweep's fitted O(registered) wall and bytes slopes (the
+        # tiering baseline), one INFORMATIONAL /proc RSS sample beside
+        # the deterministic total (cross-check only — never a pin,
+        # never compared), and the read-side parity bits
+        from anomod.obs.census import process_resident_bytes
+        _cn_alerts_same, _cn_states_same = _engines_identical(
+            eng_head, eng_cen)
+        _cn_journal_ok = None
+        if eng_head.flight_recorder is not None \
+                and eng_cen.flight_recorder is not None:
+            _cn_journal_ok = _diff_journals(
+                eng_head.flight_recorder.journal(),
+                eng_cen.flight_recorder.journal()) is None
+        out["census"] = {
+            "enabled_headline": rep.census_enabled,
+            "census_ticks": rep_cen.census_ticks,
+            "census_every": eng_cen.census_every,
+            "resident_bytes": rep_cen.census_resident_bytes,
+            "hot_set": rep_cen.census_hot_set,
+            # ONE informational RSS sample: the order-of-magnitude
+            # cross-check on the deterministic total above — never a
+            # pin (allocator/runtime memory moves run to run)
+            "process_resident_memory_bytes": process_resident_bytes(),
+            "sweep": census_sweep,
+            # census overhead measured IN-RUN (census_wall / serve_wall
+            # — the ckpt_wall idiom: the drain is timed inside the
+            # tick, so the fraction is exact and immune to this box's
+            # ±35% A/B leg noise; acceptance bar: <= 5%).  The A/B
+            # spans/sec pair below is recorded informationally.
+            "census_wall_s": rep_cen.census_wall_s,
+            "census_overhead_in_run": round(
+                rep_cen.census_wall_s
+                / max(rep_cen.serve_wall_s, 1e-9), 4),
+            "spans_per_sec_on": rep_cen.sustained_spans_per_sec,
+            "spans_per_sec_off": rep.sustained_spans_per_sec,
+            "overhead_fraction": round(max(
+                0.0, 1.0 - rep_cen.sustained_spans_per_sec
+                / max(rep.sustained_spans_per_sec, 1e-9)), 4),
+            "parity": {
+                "alerts_identical": _cn_alerts_same,
+                "states_identical": _cn_states_same,
+                "p99_identical": rep_cen.latency.get("p99_latency_s")
+                == rep.latency.get("p99_latency_s"),
+                "shed_identical":
+                    rep_cen.shed_fraction == rep.shed_fraction,
+                "journal_canonical_identical": _cn_journal_ok,
             },
         }
         # enabled-vs-off telemetry overhead on the same seed (acceptance

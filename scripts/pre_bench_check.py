@@ -94,6 +94,12 @@ and the table in docs/BENCHMARKS.md mirrors them):
   semantics broke (a same-capture self-diff flagged something, or a
   doctored 2× slowdown went unflagged) — a capture's perf block /
   regression verdicts could not be trusted.
+- ``EXIT_CENSUS_DIVERGENCE`` (12): the fleet-census smoke (record →
+  report → on/off byte-parity → pool-bytes reconciliation,
+  anomod.obs.census) failed — the census recorder moved a decision
+  byte, recorded no census, or a state pool's array bytes stopped
+  reconciling with ``(capacity + 1) × per-slot nbytes`` — a capture's
+  census block (the tiering baseline) could not be trusted.
 
 Always prints one JSON line describing the decision (plus the contract
 gate's line).  ``--traces`` must match the bench invocation's span
@@ -122,6 +128,7 @@ EXIT_RECOVERY_DIVERGENCE = 8
 EXIT_LINT = 9
 EXIT_POLICY_DIVERGENCE = 10
 EXIT_PERF_DIVERGENCE = 11
+EXIT_CENSUS_DIVERGENCE = 12
 
 
 def _shard_fanout_smoke() -> dict:
@@ -424,6 +431,65 @@ def _perf_smoke():
     return info, None
 
 
+def _census_smoke():
+    """The fleet-census smoke (<5 s): record → report → on/off
+    byte-parity → pool-bytes reconciliation (anomod.obs.census).  A
+    tiny seeded run with the census ON must take censuses, reconcile
+    every state pool's bytes exactly with ``(capacity + 1) × per-slot
+    nbytes``, and leave every decision byte-identical to the same run
+    with it OFF (alert streams, SLO quantiles, shed, the canonical
+    flight journal — the read-side contract).  A failure means the
+    census block a capture commits (the million-tenant tiering
+    baseline) could not be trusted.  Returns
+    ``(info, problem_or_None)``."""
+    import dataclasses
+
+    from anomod.serve.engine import run_power_law
+
+    kw = dict(n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+              overload=2.0, duration_s=16, tick_s=1.0, seed=5,
+              window_s=5.0, baseline_windows=4, fault_tenants=0,
+              buckets=(64, 256), lane_buckets=(1, 2, 4),
+              max_backlog=1500, n_windows=16, shards=1, pipeline=2)
+    eng_off, rep_off = run_power_law(**kw)
+    eng_on, rep_on = run_power_law(census=True, census_every=4, **kw)
+    resident = rep_on.census_resident_bytes
+    info = {"census_ticks": rep_on.census_ticks,
+            "resident_bytes": resident.get("total"),
+            "pool_reconciled": resident.get("pool_reconciled"),
+            "hot_tenants": (rep_on.census_hot_set.get("hot_by_decay")
+                            or {}).get("4")}
+
+    def problem(what, detail):
+        return info, {"what": what, "detail": detail}
+
+    if rep_on.census_ticks < 1 or not resident.get("total"):
+        return problem("no-census", "the census run recorded no "
+                       "resident-bytes census")
+    if resident.get("pool_reconciled") is not True:
+        return problem("pool-reconciliation",
+                       "state-pool bytes do not reconcile with "
+                       "(capacity + 1) x per-slot nbytes")
+    for tid in eng_off._tenant_det:
+        if [dataclasses.asdict(a) for a in eng_off.alerts_for(tid)] != \
+                [dataclasses.asdict(a) for a in eng_on.alerts_for(tid)]:
+            return problem("decision-divergence",
+                           f"tenant {tid} alert stream diverges with "
+                           "the census on")
+    if rep_off.latency != rep_on.latency \
+            or rep_off.shed_fraction != rep_on.shed_fraction:
+        return problem("decision-divergence",
+                       "SLO/shed diverge with the census on")
+    if eng_off.flight_recorder is not None \
+            and eng_on.flight_recorder is not None \
+            and eng_off.flight_recorder.canonical_bytes() \
+            != eng_on.flight_recorder.canonical_bytes():
+        return problem("decision-divergence",
+                       "canonical flight journal diverges with the "
+                       "census on")
+    return info, None
+
+
 def check_serve() -> int:
     """Serve-bench preconditions: env contract parses, bucket set
     compiles, the shard fan-out reproduces the 1-shard output, and the
@@ -601,6 +667,23 @@ def check_serve() -> int:
                   "perf blocks or regression verdicts",
                   file=sys.stderr)
             return EXIT_PERF_DIVERGENCE
+        # the fleet-census smoke: record → report → on/off byte-parity
+        # → pool-bytes reconciliation — a census block (the tiering
+        # baseline curve) from a broken census would anchor the
+        # tiering refactor against fiction
+        census_info, census_problem = _census_smoke()
+        out["census_smoke"] = census_info
+        if census_problem is not None:
+            out["status"] = "census-divergence"
+            out["problem"] = census_problem
+            print(json.dumps(out))
+            print(f"pre_bench_check: fleet-census smoke failed "
+                  f"({census_problem['what']}): "
+                  f"{census_problem['detail']} — the census recorder "
+                  "broke its read-side or reconciliation contract; do "
+                  "not trust census blocks or `anomod census diff` "
+                  "verdicts", file=sys.stderr)
+            return EXIT_CENSUS_DIVERGENCE
         print(json.dumps(out))
         return EXIT_READY
     except Exception as e:

@@ -218,7 +218,14 @@ CANONICAL_REPORT_FIELDS = (
     # lifecycle timeline ran is config, identical at every shard
     # count; its event counts / headroom / wait / bubble numbers are
     # wall-clock+topology and live on SHARD_VARIANT_REPORT_FIELDS
-    "perf_enabled")
+    "perf_enabled",
+    # the fleet census (ISSUE-15): the enable bit is config, the
+    # census tick count is a pure function of cadence × run length,
+    # and the hot-set/Zipf census derives from coordinator admission
+    # decisions alone — all three shard-invariant (pinned in
+    # tests/test_census.py); the resident-bytes dict follows the
+    # pool/scratch topology and lives on SHARD_VARIANT_REPORT_FIELDS
+    "census_enabled", "census_ticks", "census_hot_set")
 
 
 def test_canonical_report_inventory_pinned():

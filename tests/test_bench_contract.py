@@ -101,6 +101,9 @@ def test_bench_serve_mode_contract(tmp_path):
     env["ANOMOD_SERVE_BENCH_CAPACITY"] = "1500"
     env["ANOMOD_SERVE_BENCH_DURATION"] = "45"
     env["ANOMOD_SERVE_BENCH_TENANTS"] = "12"
+    # small registered-fleet sweep keeps the census probe fast; the
+    # committed capture uses the 1e3/1e4/1e5 default
+    env["ANOMOD_CENSUS_SWEEP"] = "400,1600,6400"
     r = subprocess.run(
         [sys.executable, str(Path(__file__).parent.parent / "bench.py"),
          "--mode", "serve"],
@@ -310,6 +313,56 @@ def test_bench_serve_mode_contract(tmp_path):
     self_diff = diff_captures(out, json.loads(json.dumps(out)))
     assert self_diff["status"] == "ok"
     assert self_diff["decisions"]["identical"] is True
+    # fleet-census block (ISSUE-15): the deterministic resident-bytes
+    # census, the hot-set/Zipf census, the registered-fleet sweep's
+    # fitted O(registered) baseline slopes, one informational RSS
+    # sample (never a pin), and the read-side parity bits
+    cn = out["census"]
+    assert cn["enabled_headline"] is False     # deep-dive opt-in, off
+    assert cn["census_ticks"] >= 1
+    rb = cn["resident_bytes"]
+    assert rb["total"] > 0
+    assert rb["pool_reconciled"] is True
+    assert rb["by_plane"]["pool"] > 0
+    assert rb["by_plane"]["admission"] > 0
+    assert rb["total"] == sum(rb["by_plane"].values())
+    hs = cn["hot_set"]
+    assert hs["registered"] == out["n_tenants"]
+    assert 0 < hs["ever_served"] <= hs["registered"]
+    assert 0.0 < hs["occupancy_vs_registered"] <= 1.0
+    assert hs["hot_by_decay"]
+    assert hs["zipf_alpha"] is None or hs["zipf_alpha"] > 0
+    assert len(hs["coldest"]) >= 1
+    # informational cross-check only: present, never compared
+    assert cn["process_resident_memory_bytes"] is None \
+        or cn["process_resident_memory_bytes"] > 0
+    sweep = cn["sweep"]
+    assert sweep["sizes"] == [400, 1600, 6400]     # the env override
+    assert len(sweep["rows"]) == 3
+    bytes_by_size = [r["resident_bytes"] for r in sweep["rows"]]
+    assert bytes_by_size == sorted(bytes_by_size)  # O(registered) grows
+    assert all(r["pool_reconciled"] is True for r in sweep["rows"])
+    assert sweep["bytes_slope_per_registered"] > 0
+    assert "wall_slope_s_per_registered" in sweep
+    assert cn["spans_per_sec_on"] > 0
+    assert cn["spans_per_sec_off"] == out["value"]
+    # the authoritative overhead price is measured IN-RUN (the
+    # ckpt_wall idiom) — the A/B fraction is informational (box noise)
+    assert cn["census_wall_s"] >= 0
+    assert 0.0 <= cn["census_overhead_in_run"] < 0.05
+    assert 0.0 <= cn["overhead_fraction"] < 1.0
+    par = cn["parity"]
+    assert par["alerts_identical"] is True
+    assert par["states_identical"] is True
+    assert par["p99_identical"] is True
+    assert par["shed_identical"] is True
+    assert par["journal_canonical_identical"] is True
+    # a census self-diff of the finished capture must be clean (the
+    # tiering before/after judge's identity case)
+    from anomod.obs.census import diff_census
+    cen_diff = diff_census(out, json.loads(json.dumps(out)))
+    assert cen_diff["status"] == "ok"
+    assert cen_diff["sweep_comparable"] is True
     # elasticity block (ISSUE-13): the policy leg under the scripted
     # surge must complete a full scaling episode (>=1 up AND >=1 down)
     # and carry the elastic determinism parity bits — byte-identical
@@ -359,7 +412,7 @@ def test_pre_bench_exit_codes_named_and_unique():
         "EXIT_NATIVE_UNUSABLE": 5, "EXIT_STATE_POOL_UNUSABLE": 6,
         "EXIT_FLIGHT_DIVERGENCE": 7, "EXIT_RECOVERY_DIVERGENCE": 8,
         "EXIT_LINT": 9, "EXIT_POLICY_DIVERGENCE": 10,
-        "EXIT_PERF_DIVERGENCE": 11,
+        "EXIT_PERF_DIVERGENCE": 11, "EXIT_CENSUS_DIVERGENCE": 12,
     }
     # every literal return in the gate's source goes through a constant
     src = (Path(__file__).parent.parent / "scripts"
