@@ -43,7 +43,7 @@ from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Tuple
 
 #: rule-id grammar (also the directive parser's token shape)
-_RULE_ID = re.compile(r"^(LINT|[DESPL])\d{3}$")
+_RULE_ID = re.compile(r"^(LINT|[DESPLC])\d{3}$")
 
 _DIRECTIVE_HINT = re.compile(r"#\s*anomod-lint:")
 _DIRECTIVE = re.compile(
@@ -481,6 +481,14 @@ RULES: Dict[str, Rule] = {r.id: r for r in [
          "record",
          "every record carries every tier (the self-describing-shape "
          "contract the variant-key tests pin)"),
+    Rule("C601", "commit-barrier",
+         "read of deferred-commit state (tenant detectors/replays, "
+         "RCA queue, report/flight/perf/census/policy publishers) "
+         "between a deferred dispatch and _commit_deferred()",
+         "the async serve tick (ANOMOD_SERVE_ASYNC_COMMIT) keeps byte "
+         "parity only because nothing reads scored state while folds "
+         "are in flight — one read in the window is a silent parity "
+         "break the journal diff would catch hours later"),
     Rule("L501", "lock",
          "shared-state mutation outside `with self._lock` in a "
          "lock-owning class (Registry/Histogram/Tracer)",

@@ -374,5 +374,128 @@ def _scan_method(ctx: ModuleContext, cls_name: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# C6xx — the deferred-commit barrier (ANOMOD_SERVE_ASYNC_COMMIT)
+# ---------------------------------------------------------------------------
+
+#: state the deferred commit's barrier tail mutates or publishes:
+#: reading any of these while issued work is still in flight observes
+#: PRE-commit state — the exact leak the async-parity contract forbids
+_DEFER_STATE_ATTRS = {"_tenant_det", "_tenant_replay", "_rca_queue",
+                      "rca_verdicts"}
+
+#: engine methods that read or publish committed scoring state (the
+#: barrier tail itself runs them AFTER the drain)
+_DEFER_READ_CALLS = {"alerts_for", "report", "_perf_drain",
+                     "_census_drain", "_flight_tick", "_policy_step",
+                     "_rca_step"}
+
+#: the one sanctioned barrier
+_BARRIER_CALL = "_commit_deferred"
+
+
+def _iter_inline(node: ast.AST):
+    """Walk a statement's subtree SKIPPING nested function/lambda
+    bodies — a closure defined inside the window executes later (the
+    shard-worker submit idiom), so its reads are not window reads.  A
+    statement that IS a def is wholly inert."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        return
+    stack = list(ast.iter_child_nodes(node))
+    yield node
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _opens_defer_window(node: ast.AST) -> bool:
+    """A dispatch issued with ``defer=True``, or ``self._deferred``
+    armed with a live payload."""
+    for sub in _iter_inline(node):
+        if isinstance(sub, ast.Call):
+            for kw in sub.keywords:
+                if kw.arg == "defer" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is True:
+                    return True
+        elif isinstance(sub, ast.Assign):
+            if isinstance(sub.value, ast.Constant) \
+                    and sub.value.value is None:
+                continue
+            for t in sub.targets:
+                if isinstance(t, ast.Attribute) \
+                        and t.attr == "_deferred" \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    return True
+    return False
+
+
+def _closes_defer_window(node: ast.AST) -> bool:
+    for sub in _iter_inline(node):
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr == _BARRIER_CALL:
+            return True
+    return False
+
+
+def _defer_window_reads(node: ast.AST) -> List[tuple]:
+    reads = []
+    for sub in _iter_inline(node):
+        if isinstance(sub, ast.Attribute) \
+                and sub.attr in _DEFER_STATE_ATTRS \
+                and isinstance(sub.value, ast.Name) \
+                and sub.value.id == "self" \
+                and isinstance(sub.ctx, ast.Load):
+            reads.append((sub.lineno, f"self.{sub.attr}"))
+        elif isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in _DEFER_READ_CALLS:
+            reads.append((sub.lineno, f"{sub.func.attr}()"))
+    return reads
+
+
+def check_commit_barrier(ctx: ModuleContext) -> List[Finding]:
+    """C601: inside a function that issues deferred-commit work, no
+    statement between the issue and the next ``_commit_deferred()``
+    barrier may read scoring-committed state.  Function-local by
+    design (the window legitimately stays open across the tick
+    boundary; cross-function reads are the parity tests' job) — what
+    this catches is the easy regression: someone adding a report/
+    flight/RCA read into the issue half of the async tail."""
+    if not ctx.path.startswith("anomod/serve/"):
+        return []
+    out: List[Finding] = []
+    for node in ctx.nodes:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name == _BARRIER_CALL:
+            continue               # the barrier's own tail reads freely
+        window_open = False
+        for stmt in node.body:
+            if window_open:
+                # barrier-first within one compound statement is the
+                # legit commit-then-read pattern, so closes win ties
+                if _closes_defer_window(stmt):
+                    window_open = False
+                else:
+                    for line, what in _defer_window_reads(stmt):
+                        out.append(Finding(
+                            "C601", ctx.path, line,
+                            f"{node.name} reads {what} between the "
+                            "deferred dispatch and the commit barrier "
+                            "— the result observes PRE-commit state; "
+                            "move the read after _commit_deferred()"))
+            if _opens_defer_window(stmt):
+                window_open = True
+    return out
+
+
 ALL_CHECKS = (check_determinism, check_env_contract, check_seam,
-              check_lock_discipline)
+              check_lock_discipline, check_commit_barrier)

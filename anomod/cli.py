@@ -421,6 +421,30 @@ def main(argv=None) -> int:
                               "bubble accounting (anomod.obs.perf; "
                               "default: ANOMOD_PERF — pure read-side, "
                               "decisions byte-identical either way)")
+    p_serve.add_argument("--async-commit", action="store_true",
+                         help="deferred-commit tick: issue the fold/"
+                              "score dispatches without waiting, run "
+                              "the next tick's admission/drain/shed/SLO "
+                              "under the in-flight XLA work, commit at "
+                              "the next barrier — states/alerts/SLO/"
+                              "shed and the canonical flight journal "
+                              "byte-identical to the synchronous "
+                              "engine (default: "
+                              "ANOMOD_SERVE_ASYNC_COMMIT)")
+    p_serve.add_argument("--no-async-commit", action="store_true",
+                         help="force the synchronous tick (the parity "
+                              "oracle) even when "
+                              "ANOMOD_SERVE_ASYNC_COMMIT is on")
+    p_serve.add_argument("--native-drain",
+                         choices=["auto", "on", "off"], default=None,
+                         help="columnar SFQ drain/shed engine for the "
+                              "admission hot loop: auto = native C++ "
+                              "kernels when the toolchain has them, "
+                              "NumPy-columnar otherwise; off = the "
+                              "Python heap loop (the byte-parity "
+                              "oracle); on = require the native "
+                              "kernels (default: "
+                              "ANOMOD_SERVE_NATIVE_DRAIN)")
     p_serve.add_argument("--no-score", action="store_true",
                          help="replay-plane only (skip per-tenant window "
                               "scoring) — isolates the serving overhead")
@@ -1078,6 +1102,18 @@ def main(argv=None) -> int:
             parser.error("the elastic policy migrates tenants through "
                          "the bucket-runner state seams; --devices "
                          "runs with --policy off")
+        if args.async_commit and args.no_async_commit:
+            parser.error("--async-commit contradicts --no-async-commit")
+        if args.devices and args.async_commit:
+            # only an EXPLICIT --async-commit conflicts hard; an
+            # env-sourced ANOMOD_SERVE_ASYNC_COMMIT=1 degrades to the
+            # synchronous tick at the engine (the mesh plane manages
+            # its own sharded dispatch), so existing --devices
+            # workflows keep working under a globally exported knob
+            parser.error("the deferred-commit tick splits the bucket-"
+                         "runner issue/commit seam; --devices runs "
+                         "with the synchronous tick "
+                         "(drop --async-commit)")
         if args.chaos:
             from anomod.config import validate_chaos_script
             try:
@@ -1146,6 +1182,10 @@ def main(argv=None) -> int:
             ckpt_every=args.ckpt_every,
             policy=args.policy, policy_script=args.policy_script,
             min_shards=args.min_shards, max_shards=args.max_shards,
+            async_commit=(True if args.async_commit
+                          else (False if args.no_async_commit
+                                else None)),
+            native_drain=args.native_drain,
             # --no-score forces RCA off even when ANOMOD_SERVE_RCA=1
             # (the explicit CLI ask wins over the env default; the
             # --rca + --no-score combination already parser.error'd)

@@ -272,6 +272,65 @@ def _serve_state_env() -> str:
         f"ANOMOD_SERVE_STATE must be auto, host or device, got {raw!r}")
 
 
+def _serve_async_commit_env() -> bool:
+    """ANOMOD_SERVE_ASYNC_COMMIT: deferred-commit serve tick
+    (anomod.serve.engine).
+
+    Default OFF — the synchronous engine stays the parity oracle.  When
+    on, tick N's fold+score dispatch is issued but NOT waited on; the
+    XLA execute wait runs concurrent with tick N+1's coordinator phases
+    (admission, drain, shed, SLO accounting) and tick N's results drain
+    at a commit barrier placed just before they are first read.  Every
+    decision is a function of seed+config alone, so states, alerts,
+    SLO, shed and the canonical flight journal are pinned byte-identical
+    to the synchronous engine (``anomod audit replay`` crosses the two
+    freely); only the wall-time attribution moves — the hidden wait is
+    reported on the ``commit_defer`` perf leg (anomod.obs.perf).
+
+    Validated against the explicit token sets (not the legacy
+    anything-truthy bool idiom): the knob silently flips the engine's
+    whole tick structure, so ``ANOMOD_SERVE_ASYNC_COMMIT=treu`` must
+    fail at config construction, not serve synchronously all night.
+    """
+    raw = _env("ANOMOD_SERVE_ASYNC_COMMIT", "0").strip().lower()
+    if raw in ("1", "on", "true", "yes"):
+        return True
+    if raw in ("0", "off", "false", "no", ""):
+        return False
+    raise ValueError(
+        f"ANOMOD_SERVE_ASYNC_COMMIT must be 0/off/false/no or "
+        f"1/on/true/yes, got {raw!r}")
+
+
+def _serve_native_drain_env() -> str:
+    """ANOMOD_SERVE_NATIVE_DRAIN: the admission plane's SFQ drain/shed
+    engine (anomod.serve.queues).
+
+    ``off`` (``0``) is the per-span Python heap — the original drain
+    loop, kept as the parity oracle.  ``auto`` (the default) runs the
+    COLUMNAR engine: candidate selection over parallel NumPy arrays,
+    with the sort/select kernels in the native runtime
+    (``anomod_sfq_drain`` / ``anomod_sfq_victim``) when the .so loads
+    and a pure-NumPy fallback otherwise.  ``on`` (``1``) requires the
+    native kernels — the first drain raises with the recorded
+    build-failure reason instead of silently serving the slow path (the
+    ``ANOMOD_NATIVE=on`` contract).  All three engines are pinned
+    byte-identical: same served order, same shed/evict victims, same
+    SFQ virtual-time floats.  Validated here so a typo fails loudly at
+    config construction.
+    """
+    raw = _env("ANOMOD_SERVE_NATIVE_DRAIN", "auto").strip().lower()
+    if raw in ("auto", ""):
+        return "auto"
+    if raw in ("1", "on", "true", "yes"):
+        return "on"
+    if raw in ("0", "off", "false", "no"):
+        return "off"
+    raise ValueError(
+        f"ANOMOD_SERVE_NATIVE_DRAIN must be auto, on/1 or off/0, "
+        f"got {raw!r}")
+
+
 def _serve_rca_env() -> bool:
     """ANOMOD_SERVE_RCA: online root-cause inference in the serve tick.
 
@@ -1121,6 +1180,18 @@ class Config:
     # device-resident pool, scatter-add fold, bit-identical), host (the
     # per-tenant numpy seam; anomod.serve.batcher).
     serve_state: str = dataclasses.field(default_factory=_serve_state_env)
+    # ANOMOD_SERVE_ASYNC_COMMIT — deferred-commit serve tick
+    # (anomod.serve.engine; off = the synchronous parity oracle, on =
+    # tick N's fold/score commit drains under tick N+1's coordinator
+    # work, decisions pinned byte-identical either way).
+    serve_async_commit: bool = dataclasses.field(
+        default_factory=_serve_async_commit_env)
+    # ANOMOD_SERVE_NATIVE_DRAIN — SFQ drain/shed engine: auto (columnar,
+    # native kernels when the .so loads, NumPy fallback), on (native
+    # required, fail loud), off (the Python heap parity oracle;
+    # anomod.serve.queues).
+    serve_native_drain: str = dataclasses.field(
+        default_factory=_serve_native_drain_env)
     # ANOMOD_SERVE_RCA — online root-cause inference in the serve tick
     # (anomod.serve.rca; off = the serving plane stops at alerts).
     serve_rca: bool = dataclasses.field(default_factory=_serve_rca_env)

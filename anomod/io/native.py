@@ -118,6 +118,16 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint32),
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64]
+    lib.anomod_sfq_drain.restype = ctypes.c_int64
+    lib.anomod_sfq_drain.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int64, ctypes.c_double, ctypes.POINTER(ctypes.c_int64)]
+    lib.anomod_sfq_victim.restype = ctypes.c_int64
+    lib.anomod_sfq_victim.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int64]
 
 
 def available() -> bool:
@@ -170,6 +180,30 @@ def staging_enabled(override: Optional[bool] = None) -> bool:
             "native staging requested but the runtime is unusable: "
             f"{_BUILD_ERROR or 'unknown load failure'}")
     return True
+
+
+def sfq_kernels(require: bool = False):
+    """The admission plane's columnar SFQ drain/shed kernels
+    (``anomod_sfq_drain`` / ``anomod_sfq_victim``): the bound library
+    handle, or None when the columnar engine should fall back to its
+    pure-NumPy scans.
+
+    ``require=True`` is the ``ANOMOD_SERVE_NATIVE_DRAIN=on`` contract —
+    raise with the recorded build-failure reason instead of silently
+    serving the fallback (the ``staging_enabled(override=True)``
+    discipline); ``require=False`` defers to :func:`enabled`, so
+    ``ANOMOD_NATIVE=off`` forces the NumPy scans like every other
+    native consumer."""
+    if require:
+        if not available():
+            raise RuntimeError(
+                "ANOMOD_SERVE_NATIVE_DRAIN=on but the native runtime is "
+                f"unusable: {_BUILD_ERROR or 'unknown load failure'} — "
+                "rebuild with `make -C native` or set "
+                "ANOMOD_SERVE_NATIVE_DRAIN=auto to accept the NumPy "
+                "fallback")
+        return _LIB
+    return _LIB if enabled() else None
 
 
 def status() -> dict:

@@ -78,7 +78,12 @@ PERF_FORMAT = 1
 #: dispatch per slot)
 EVENT_FIELDS = ("seq", "tick", "shard", "width", "lanes", "slot",
                 "staged_t0", "staged", "submitted_t0", "submitted",
-                "retire_t0", "materialized", "folded", "refill")
+                "retire_t0", "materialized", "folded", "refill",
+                # the deferred-commit leg (ANOMOD_SERVE_ASYNC_COMMIT):
+                # issued-at / barrier-read-at stamps, None on a
+                # synchronous engine's events — appended, never
+                # reordered, so PERF_FORMAT 1 readers keep working
+                "deferred_t0", "deferred")
 
 
 class PerfRecorder:
@@ -123,7 +128,8 @@ class PerfRecorder:
             "width": int(width), "lanes": int(lanes), "slot": int(slot),
             "staged_t0": t0, "staged": t1,
             "submitted_t0": None, "submitted": None, "retire_t0": None,
-            "materialized": None, "folded": None, "refill": None}
+            "materialized": None, "folded": None, "refill": None,
+            "deferred_t0": None, "deferred": None}
         self.seq += 1
 
     def _rec(self, key: tuple) -> Optional[dict]:
@@ -144,6 +150,17 @@ class PerfRecorder:
         rec = self._rec(key)
         if rec is not None:
             rec["materialized"] = t
+
+    def note_deferred(self, key: tuple, t0: float, t1: float) -> None:
+        """The dispatch was left in flight under next-tick coordinator
+        work from ``t0`` (issue) until the commit barrier read it at
+        ``t1`` — the deferred-commit engine stamps every in-flight
+        record at the barrier (once: a record re-marked by a forced
+        synchronous commit keeps its first stamp)."""
+        rec = self._rec(key)
+        if rec is not None and rec.get("deferred_t0") is None:
+            rec["deferred_t0"] = t0
+            rec["deferred"] = t1
 
     def note_folded(self, key: tuple, t: float) -> None:
         rec = self._open.pop(key, None)
@@ -181,10 +198,11 @@ def fold_perf_records(parts: Sequence[Sequence[dict]]) -> List[dict]:
 # the bubble / critical-path analyzer
 # ---------------------------------------------------------------------------
 
-def _durations(ev: dict) -> Tuple[float, float, float, float]:
-    """(stage_s, dispatch_s, wait_s, fold_s) of one event record —
-    tolerant of partially-filled records (an event that never
-    materialized contributes zero to the legs it never reached)."""
+def _durations(ev: dict) -> Tuple[float, float, float, float, float]:
+    """(stage_s, dispatch_s, wait_s, fold_s, commit_defer_s) of one
+    event record — tolerant of partially-filled records (an event that
+    never materialized contributes zero to the legs it never reached;
+    ``commit_defer_s`` is zero on a synchronous engine's events)."""
 
     def span(a, b):
         if ev.get(a) is None or ev.get(b) is None:
@@ -194,7 +212,8 @@ def _durations(ev: dict) -> Tuple[float, float, float, float]:
     return (span("staged_t0", "staged"),
             span("submitted_t0", "submitted"),
             span("retire_t0", "materialized"),
-            span("retire_t0", "folded"))
+            span("retire_t0", "folded"),
+            span("deferred_t0", "deferred"))
 
 
 def analyze_events(events: Sequence[dict], pipeline: int = 1) -> dict:
@@ -212,15 +231,17 @@ def analyze_events(events: Sequence[dict], pipeline: int = 1) -> dict:
     for ev in events:
         groups.setdefault((ev["tick"], ev["shard"]), []).append(ev)
     stage_s = dispatch_s = wait_s = fold_s = headroom_s = 0.0
+    commit_defer_s = 0.0
     for key in sorted(groups):
         evs = groups[key]
         stages = []
         for ev in evs:
-            st, dp, wt, fd = _durations(ev)
+            st, dp, wt, fd, cd = _durations(ev)
             stage_s += st
             dispatch_s += dp
             wait_s += wt
             fold_s += fd
+            commit_defer_s += cd
             stages.append(st)
         claimed = [False] * len(evs)
         for i, ev in enumerate(evs):
@@ -255,7 +276,11 @@ def analyze_events(events: Sequence[dict], pipeline: int = 1) -> dict:
     return {"n_events": len(events),
             "stage_s": stage_s, "dispatch_s": dispatch_s,
             "wait_s": wait_s, "fold_s": fold_s,
-            "headroom_s": headroom_s}
+            "headroom_s": headroom_s,
+            # the deferred-commit leg: time dispatches spent executing
+            # under next-tick coordinator work before their barrier —
+            # the HIDDEN share of the wait (0.0 on a synchronous run)
+            "commit_defer_s": commit_defer_s}
 
 
 def bubble_fractions(wait_s: float, headroom_s: float,
@@ -308,6 +333,9 @@ def perf_tracer(events: Sequence[dict], service: str = "anomod-perf"):
     - ``lane.wait``      retire_t0 → materialized (host BLOCKED — the
       bubble the overlap analyzer prices; nested inside lane.inflight)
     - ``lane.fold``      materialized → folded    (state folds)
+    - ``lane.defer``     deferred_t0 → deferred   (deferred-commit mode
+      only: in flight under next-tick coordinator work — the hidden
+      wait)
     """
     from anomod.utils.tracing import Tracer
     tr = Tracer(service)
@@ -323,7 +351,8 @@ def perf_tracer(events: Sequence[dict], service: str = "anomod-perf"):
                            ("lane.dispatch", "submitted_t0", "submitted"),
                            ("lane.inflight", "submitted", "materialized"),
                            ("lane.wait", "retire_t0", "materialized"),
-                           ("lane.fold", "materialized", "folded")):
+                           ("lane.fold", "materialized", "folded"),
+                           ("lane.defer", "deferred_t0", "deferred")):
             if ev.get(a) is None or ev.get(b) is None:
                 continue
             tr.add_span(name, ev[a], max(0.0, ev[b] - ev[a]),

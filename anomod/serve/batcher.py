@@ -731,6 +731,18 @@ class BucketRunner:
         while self._inflight:
             self._retire_one()
 
+    def mark_deferred(self, t0: float, t1: float) -> None:
+        """Stamp every in-flight dispatch's ``deferred`` lifecycle leg
+        (anomod.obs.perf): issued at ``t0``, left executing under the
+        coordinator's next-tick work until the commit barrier read it
+        at ``t1`` — the deferred-commit engine calls this at the
+        barrier, before :meth:`drain_lanes`, so `anomod perf diff`
+        can attribute the hidden wait to the ``commit_defer`` leg."""
+        if self.perf is None:
+            return
+        for _, _, _, key in self._inflight:
+            self.perf.note_deferred(key, t0, t1)
+
     def abort_lanes(self) -> None:
         """Failed-tick cleanup: discard every in-flight dispatch WITHOUT
         folding.  Outputs are still materialized — the execute barrier;

@@ -167,6 +167,11 @@ SHARD_VARIANT_REPORT_FIELDS = (
     # (perf_enabled, the config bit, stays canonical)
     "perf_events_recorded", "overlap_headroom_s", "fold_wait_s",
     "bubble_fractions",
+    # the deferred-commit seam (ANOMOD_SERVE_ASYNC_COMMIT): how long
+    # dispatches were left executing under coordinator work is a wall
+    # measurement — consciously VARIANT (async_commit, the config bit,
+    # and async_ticks, its config-derived tick count, stay canonical)
+    "commit_defer_wall_s",
     # the fleet census observatory (anomod.obs.census): resident-bytes
     # totals follow the execution TOPOLOGY (per-shard pool capacity and
     # scratch grids depend on the shard count and residency), so the
@@ -342,6 +347,13 @@ class ServeReport:
     #                                              pool/scratch topology)
     census_wall_s: float                         # census drain wall (the
     #                                              in-run overhead price)
+    async_commit: bool                           # deferred-commit tick on?
+    async_ticks: int                             # ticks whose commit
+    #                                              deferred past issue
+    commit_defer_wall_s: float                   # wall dispatches spent
+    #                                              executing under next-tick
+    #                                              coordinator work (the
+    #                                              hidden fold wait)
     serve_wall_s: float
     sustained_spans_per_sec: float
 
@@ -409,7 +421,9 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   min_shards: Optional[int] = None,
                   max_shards: Optional[int] = None,
                   target_imbalance: Optional[float] = None,
-                  cooldown_ticks: Optional[int] = None
+                  cooldown_ticks: Optional[int] = None,
+                  async_commit: Optional[bool] = None,
+                  native_drain: Optional[str] = None
                   ) -> Tuple["ServeEngine", ServeReport]:
     """The canonical seeded serve run shared by ``anomod serve`` and
     ``bench.py --mode serve``: a power-law tenant fleet offering
@@ -450,7 +464,9 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          policy_script=policy_script,
                          min_shards=min_shards, max_shards=max_shards,
                          target_imbalance=target_imbalance,
-                         cooldown_ticks=cooldown_ticks)
+                         cooldown_ticks=cooldown_ticks,
+                         async_commit=async_commit,
+                         native_drain=native_drain)
     if engine.flight_recorder is not None:
         # the header's replay contract: `anomod audit replay` re-executes
         # this exact invocation from the journal alone.  Every
@@ -513,7 +529,19 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
             target_imbalance=(engine.policy.target_imbalance
                               if engine.policy is not None else None),
             cooldown_ticks=(engine.policy.cooldown_ticks
-                            if engine.policy is not None else None))
+                            if engine.policy is not None else None),
+            # the deferred-commit seam, RESOLVED: a replay of an
+            # async run re-defers and re-commits the same schedule —
+            # canonical journal byte-equal to the synchronous
+            # engine's (the parity pin), so replaying either mode
+            # against either journal matches
+            async_commit=engine.async_commit,
+            # ``native_drain`` stays raw — the ``native`` rationale:
+            # the columnar/native SFQ drain is byte-identical to the
+            # heap (it cannot move a canonical plane), and a resolved
+            # "native" would refuse to replay on a toolchain-less box
+            # for zero forensic benefit
+            native_drain=native_drain)
     report = engine.run(traffic, duration_s=duration_s)
     return engine, report
 
@@ -558,7 +586,9 @@ class ServeEngine:
                  min_shards: Optional[int] = None,
                  max_shards: Optional[int] = None,
                  target_imbalance: Optional[float] = None,
-                 cooldown_ticks: Optional[int] = None):
+                 cooldown_ticks: Optional[int] = None,
+                 async_commit: Optional[bool] = None,
+                 native_drain: Optional[str] = None):
         from anomod.config import get_config
         from anomod.utils.platform import enable_jit_cache
         if capacity_spans_per_s <= 0:
@@ -579,7 +609,8 @@ class ServeEngine:
                                else app_cfg.serve_max_backlog)
         self.admission = AdmissionController(
             self.specs, max_backlog=self.max_backlog,
-            max_tenant_backlog=max_tenant_backlog)
+            max_tenant_backlog=max_tenant_backlog,
+            drain_engine=native_drain)
         self.score = bool(score)
         self.mesh = mesh
         #: tenant-fused scoring (ANOMOD_SERVE_FUSE): per tick, drained
@@ -615,6 +646,41 @@ class ServeEngine:
             raise ValueError(
                 "the mesh plane manages its own sharded dispatch; "
                 "run it with shards=1 (ANOMOD_SERVE_SHARDS=1)")
+        #: deferred-commit tick (ANOMOD_SERVE_ASYNC_COMMIT): tick t's
+        #: fold/score dispatches are ISSUED but not waited on; while
+        #: the XLA executes run, the coordinator handles tick t+1's
+        #: admission/drain/shed/SLO phases against last-committed
+        #: state, and tick t commits at a barrier placed just before
+        #: its results are first read.  Every decision input is a
+        #: snapshot taken at tick t, so states / alerts / SLO / shed
+        #: and the canonical flight journal are byte-identical to the
+        #: synchronous engine (=0, the parity oracle) — only walls
+        #: move.  The mesh plane manages its own sharded dispatch
+        #: (there is no issue/commit seam to split), so the mode
+        #: auto-disables there and an explicit request is refused —
+        #: the policy/state idiom.
+        _async = (app_cfg.serve_async_commit if async_commit is None
+                  else bool(async_commit))
+        if mesh is not None and _async:
+            if async_commit is not None:
+                raise ValueError(
+                    "the deferred-commit tick splits the bucket-runner "
+                    "issue/commit seam; the mesh plane manages its own "
+                    "sharded dispatch (ANOMOD_SERVE_ASYNC_COMMIT=0)")
+            _async = False
+        self.async_commit = bool(_async)
+        self._async = self.async_commit
+        #: the in-flight deferred tick's snapshotted context (None
+        #: when nothing is deferred): every input its commit tail
+        #: will read, captured at issue time so the NEXT tick's
+        #: admission can never leak into this tick's journal/policy
+        self._deferred: Optional[dict] = None
+        #: ticks whose commit actually deferred past issue
+        self.async_ticks = 0
+        #: wall spent with dispatches left executing under coordinator
+        #: work before their barrier first read them — the hidden wait
+        #: `anomod perf diff` attributes to the ``commit_defer`` leg
+        self.commit_defer_wall_s = 0.0
         #: elastic scaling policy (ANOMOD_SERVE_POLICY, anomod.serve.
         #: policy): "off" (the default) is the static engine; "auto"/
         #: "script" evaluate an ElasticPolicy at every tick boundary on
@@ -964,6 +1030,8 @@ class ServeEngine:
                                if self.policy is not None else "off"),
                     "perf": self.perf,
                     "census": self.census,
+                    "async_commit": self.async_commit,
+                    "drain_engine": self.admission.drain_engine,
                  },
                  "config": config_snapshot(),
                  "versions": versions()},
@@ -1146,15 +1214,15 @@ class ServeEngine:
         sidecar batches first — their windows must be populated before
         any span push can close them), drain up to the tick's capacity
         budget in weighted-fair order, score every drained batch,
-        advance the clock.  Returns the served batches."""
+        advance the clock.  Returns the served batches.
+
+        Under ANOMOD_SERVE_ASYNC_COMMIT the second half of the tick
+        runs the deferred-commit seam instead (``_tick_async_tail``):
+        scoring dispatches are issued but not drained, and the
+        PREVIOUS tick commits at this tick's barrier — same decisions,
+        overlapped walls."""
         t_wall = time.perf_counter()
         now = self.clock.now_s + self.clock.tick_s   # decisions at tick end
-        if self._perf_recs:
-            # tick-boundary stamp (the workers are quiescent between
-            # ticks, so this cross-thread write races nothing): events
-            # the dispatch path records below key on this tick index
-            for rec_ in self._perf_recs:
-                rec_.tick = self.clock.ticks
         if self._chaos is not None:
             # scripted load surge (the chaos 'surge' kind): a pure
             # function of the tick index, so the amplified arrival
@@ -1206,6 +1274,18 @@ class ServeEngine:
             budget)
         if -1e-9 < self._credit < 1e-9:
             self._credit = 0.0
+        if self._async:
+            # deferred-commit mode: everything above (admission, drain,
+            # shed, credit) already ran OVERLAPPED with the previous
+            # tick's in-flight XLA work; the tail issues this tick's
+            # dispatches and defers their commit to the next barrier
+            return self._tick_async_tail(t_wall, now, served)
+        if self._perf_recs:
+            # tick-boundary stamp (the workers are quiescent between
+            # ticks, so this cross-thread write races nothing): events
+            # the dispatch path records below key on this tick index
+            for rec_ in self._perf_recs:
+                rec_.tick = self.clock.ticks
         if served:
             sup = self._supervisor
             if sup is not None:
@@ -1252,36 +1332,7 @@ class ServeEngine:
             self._slo[qb.tenant_id].record(now - qb.enqueued_s)
             self.n_spans_served += qb.n_spans
         if self.rca:
-            # evidence buffering on the COORDINATOR (shard-count-
-            # invariant content), then the alert→culprit pass; both
-            # inside the measured tick wall — RCA rides the serve SLO.
-            # Pruning floors at each tenant's OLDEST queued alert
-            # window, so a budget-delayed run still finds its full
-            # evidence window in the buffer (the determinism contract's
-            # "delayed run scores the same evidence" clause).  THIS
-            # tick's new alerts enqueue BEFORE the floor is computed:
-            # an alert fired across a traffic gap longer than the
-            # evidence window would otherwise have its pre-gap evidence
-            # pruned by the same tick's buffering, before its run sees
-            # it (the enqueue is _rca_seen-guarded, so _rca_tick's own
-            # enqueue pass below stays a no-op for these).
-            self._rca_enqueue(now)
-            floor: Dict[int, int] = {}
-            for _, tid, w, _ in self._rca_queue:
-                floor[tid] = min(floor.get(tid, w), w)
-            for qb in served:
-                plane = self._rca_planes[
-                    self.shard_of.get(qb.tenant_id, 0)
-                    if len(self._rca_planes) > 1 else 0]
-                plane.buffer(qb.tenant_id, qb.spans,
-                             keep_window=floor.get(qb.tenant_id))
-            # brownout level >= 1 (the elastic policy's degradation
-            # ladder) tightens the per-tick RCA budget to one run —
-            # the item set and verdict CONTENT are budget-invariant
-            # (the PR-6 pin); only the virtual scoring tick moves
-            self._rca_tick(now, budget=(
-                1 if self.policy is not None
-                and self.policy.brownout_level >= 1 else None))
+            self._rca_step(now, served)
         # the perf-timeline drain rides INSIDE the measured wall (the
         # bench perf block prices the recorder, never hides it); it
         # runs after the score barrier, so every dispatch of this tick
@@ -1334,6 +1385,337 @@ class ServeEngine:
         self.tick_walls.append(t_tick)
         return served
 
+    # -- the deferred-commit seam (ANOMOD_SERVE_ASYNC_COMMIT) -------------
+
+    def _tick_async_tail(self, t_wall: float, now: float,
+                         served: List[QueuedBatch]) -> List[QueuedBatch]:
+        """The deferred-commit second half of one tick.
+
+        Order of operations, and why each placement preserves byte
+        parity with the synchronous tick:
+
+        1. SLO accounting moves AHEAD of scoring: the latency samples
+           are pure functions of admission times and the tick clock
+           (never of scoring results), recorded in the same served
+           order — identical values, identical per-tenant sample
+           sequence.
+        2. THE COMMIT BARRIER (``_commit_deferred``): the PREVIOUS
+           tick's in-flight XLA work has been executing under this
+           tick's admission/drain/shed/SLO coordinator phases; its
+           results are about to be read (folds feed this tick's
+           staging), so it commits now, then runs the deferred tick's
+           tail (RCA, perf/census drains, flight record, policy)
+           against snapshotted inputs.
+        3. ISSUE: this tick's fused dispatches stage + submit but do
+           NOT drain (``defer=True``); the XLA executes stay in
+           flight until the next tick's barrier.  The unfused path
+           has no issue/commit seam to split (pushes are synchronous
+           host work), so it scores in place and only the tick tail
+           defers.
+        4. The deferred context snapshots every input the commit tail
+           will need — admission totals, backlog, the tick index —
+           so the next tick's admission cannot leak into this tick's
+           journal or policy view.
+
+        Stage/dispatch-phase faults surface at ISSUE time exactly as
+        in the synchronous engine; fold/score/commit-phase faults
+        surface one tick later at the barrier, keyed (and recovered)
+        at their ORIGIN tick, so chaos scripts and the recovery
+        ledger stay deterministic.  Checkpoint ticks force a
+        synchronous commit: the supervisor's snapshot must cover this
+        tick's folds, or a restore would lose them.
+        """
+        for qb in served:
+            self._slo[qb.tenant_id].record(now - qb.enqueued_s)
+            self.n_spans_served += qb.n_spans
+        self._commit_deferred()
+        if self._perf_recs:
+            # tick-boundary stamp, POST-barrier: the workers are
+            # quiescent only after the deferred commit has joined
+            for rec_ in self._perf_recs:
+                rec_.tick = self.clock.ticks
+        pending = None
+        sup = self._supervisor
+        if served:
+            if sup is not None:
+                # the recovery log must hold this tick's slices BEFORE
+                # issue: a barrier-time shard failure re-executes them
+                sup.begin_tick(served)
+            self._last_failures = None
+            try:
+                if self._fused:
+                    pending = self._dispatch_tick(served)
+                elif self._use_workers:
+                    with self._span("serve.score_sharded"):
+                        self._score_sharded(served)
+                else:
+                    self._score_shard(0, served)
+            except BaseException as e:
+                failures = self._last_failures or [(0, e)]
+                self._last_failures = None
+                if sup is None or not isinstance(e, Exception):
+                    # operator interrupts are not shard faults — the
+                    # synchronous tick's rule, unchanged
+                    raise
+                with self._span("serve.recover"):
+                    sup.recover(failures)
+                # recovery re-executed the tick synchronously (restore
+                # + full _score_shard replay): it is already committed
+                pending = None
+        tot = self.admission.totals()
+        t_issue = time.perf_counter()
+        self._deferred = {
+            "tick": self.clock.ticks,
+            "now": now,
+            "served": served,
+            "pending": pending,
+            "tot": tot,
+            "backlog": self.admission.backlog_spans,
+            "t_issue": t_issue,
+            "coord_wall": t_issue - t_wall,
+        }
+        self.async_ticks += 1
+        if sup is not None \
+                and (self.clock.ticks + 1) % self.ckpt_every == 0:
+            # end_tick() checkpoints on this cadence — force the
+            # commit so the snapshot covers this tick's folds (the one
+            # tick per ckpt_every that pays the synchronous wait)
+            self._commit_deferred()
+        if sup is not None:
+            sup.end_tick()
+        self.clock.advance()
+        self._obs_tick.observe(time.perf_counter() - t_wall)
+        self._obs_ticks.inc()
+        self._obs_tenants.set(len(self._tenant_det)
+                              or len(self._tenant_replay))
+        if self.clock.ticks % self._scrape_every == 0:
+            self._registry.scrape(now_s=now)
+        t_tick = time.perf_counter() - t_wall
+        self.serve_wall_s += t_tick
+        self.tick_walls.append(t_tick)
+        return served
+
+    def _commit_deferred(self) -> None:
+        """The deferred tick's COMMIT BARRIER (no-op when nothing is
+        deferred): drain the in-flight fold/score/commit phases, then
+        run the deferred tick's tail — RCA, perf/census drains, flight
+        record, elastic policy — against the exact state, and the
+        exact snapshotted inputs, the synchronous engine used at that
+        tick.  The tail order mirrors the synchronous tick body
+        (RCA → perf → census → flight → policy) line for line.  Chaos
+        hooks key on the ORIGIN tick, so scripted fold/score/commit
+        faults fire — and recover, via the supervisor's origin-keyed
+        retry ledger — exactly as scripted even though they surface
+        one tick later.  The policy executing here (not at issue)
+        keeps the sync ordering guarantee: a scale-down can never
+        remove a runner with un-journaled or in-flight work."""
+        d = self._deferred
+        if d is None:
+            return
+        self._deferred = None
+        t_barrier = time.perf_counter()
+        pending = d["pending"]
+        if pending is not None and any(pending):
+            # the hidden-wait leg: how long the dispatches were left
+            # executing under coordinator work before this barrier
+            # first read them (`anomod perf diff`'s commit_defer leg)
+            self.commit_defer_wall_s += max(0.0,
+                                            t_barrier - d["t_issue"])
+            if self.perf:
+                for r in self._runners:
+                    r.mark_deferred(d["t_issue"], t_barrier)
+            sup = self._supervisor
+            self._last_failures = None
+            try:
+                if self._use_workers:
+                    self._join_commits(pending, d["tick"])
+                else:
+                    self._commit_shard(0, pending[0], d["tick"])
+            except BaseException as e:
+                failures = self._last_failures or [(0, e)]
+                self._last_failures = None
+                if sup is None or not isinstance(e, Exception):
+                    raise
+                with self._span("serve.recover"):
+                    sup.recover(failures, origin_tick=d["tick"])
+        now, served = d["now"], d["served"]
+        if self.rca:
+            self._rca_step(now, served)
+        self._perf_tick_doc = self._perf_drain() if self.perf else None
+        if self._census_tracker is not None:
+            t0 = time.perf_counter()
+            self._census_tracker.observe(d["tick"], served)
+            self._census_tick_doc = (
+                self._census_drain(t_idx=d["tick"])
+                if self._census_tracker.due(d["tick"]) else None)
+            self.census_wall_s += time.perf_counter() - t0
+        if self.flight_recorder is not None:
+            self._flight_tick(now, served,
+                              d["coord_wall"]
+                              + (time.perf_counter() - t_barrier),
+                              t_idx=d["tick"], tot=d["tot"])
+        if self.policy is not None:
+            t0 = time.perf_counter()
+            with self._span("serve.policy"):
+                self._policy_step(served, tick=d["tick"],
+                                  backlog_spans=d["backlog"],
+                                  shed_spans=d["tot"].shed_spans)
+            self.policy_wall_s += time.perf_counter() - t0
+
+    def _dispatch_tick(self, served: List[QueuedBatch]) -> list:
+        """The ISSUE half of one fused tick: stage + submit every
+        shard's lane dispatches and return the per-shard pending work
+        lists WITHOUT draining — the XLA executes stay in flight until
+        the next barrier first reads them.  The sharded path keeps the
+        ``_submit_parts`` discipline (per-shard worker threads, shard
+        registries folded at the join, first failure re-raised with
+        the full failure list parked for the supervisor)."""
+        origin = self.clock.ticks
+        if not self._use_workers:
+            with self._span("serve.issue_tick"):
+                return [self._dispatch_shard(0, served, origin)]
+        from functools import partial
+        parts: List[List[QueuedBatch]] = [[] for _ in range(self.shards)]
+        for qb in served:
+            parts[self.shard_of[qb.tenant_id]].append(qb)
+        self._ensure_workers()
+        pending: list = [None] * self.shards
+
+        def _issue(s: int, part: List[QueuedBatch]) -> None:
+            pending[s] = self._dispatch_shard(s, part, origin)
+
+        with self._span("serve.issue_tick"):
+            submitted = []
+            for s, worker in enumerate(self._workers):
+                if parts[s]:
+                    worker.submit(partial(_issue, s, parts[s]))
+                    submitted.append((s, worker))
+            failures = []
+            for s, worker in submitted:
+                try:
+                    worker.join()
+                except BaseException as e:
+                    failures.append((s, e))
+        for s in range(self.shards):
+            self._proc_registry.fold_from(self._shard_regs[s],
+                                          self._fold_state[s],
+                                          shard=str(s))
+        if failures:
+            self._last_failures = failures
+            raise failures[0][1]
+        return pending
+
+    def _dispatch_shard(self, shard_id: int, served: List[QueuedBatch],
+                        origin_tick: Optional[int] = None) -> list:
+        """One shard's stage + submit (phases 1-2 of fused scoring)
+        with the drain DEFERRED; returns the pending work list
+        ``_commit_shard`` completes at the barrier.  Chaos phases
+        ``stage`` and ``dispatch`` fire here, at issue time, exactly
+        as in the synchronous ``_score_shard``."""
+        runner = self._runners[shard_id]
+        chaos = self._chaos
+        if chaos is not None:
+            tick = (self.clock.ticks if origin_tick is None
+                    else origin_tick)
+            hook = lambda phase: chaos.hit(phase, tick, shard_id)  # noqa: E731
+        else:
+            hook = None
+        if hook is not None:
+            hook("stage")
+        with self._span("serve.dispatch_shard", shard=shard_id,
+                        pipeline=self.pipeline):
+            pending = self._stage_pending(served)
+            self._dispatch_rounds(pending, runner, chaos_hook=hook,
+                                  defer=True)
+        return pending
+
+    def _commit_shard(self, shard_id: int, pending: list,
+                      origin_tick: int) -> None:
+        """One shard's barrier-time completion: drain the deferred
+        dispatches (the fold wait the seam hides), then phase 3
+        (window scoring).  Chaos phases ``fold`` / ``score`` /
+        ``commit`` fire here keyed on the ORIGIN tick — the same
+        injection points, tick keys and ordering the synchronous
+        ``_score_shard`` gives them."""
+        runner = self._runners[shard_id]
+        chaos = self._chaos
+        if chaos is not None:
+            hook = lambda phase: chaos.hit(phase, origin_tick, shard_id)  # noqa: E731
+        else:
+            hook = None
+        try:
+            with self._span("serve.commit_shard", shard=shard_id):
+                runner.drain_lanes()
+        except BaseException:
+            # the abort discipline (_dispatch_rounds): a failed commit
+            # must not park issued dispatches for a later drain to
+            # fold as stale deltas
+            runner.abort_lanes()
+            raise
+        if hook is not None:
+            hook("fold")
+        self._commit_pending(pending, runner, chaos_hook=hook)
+        if hook is not None:
+            hook("commit")
+
+    def _join_commits(self, pending: list, origin_tick: int) -> None:
+        """Barrier-time sharded commit: each shard with deferred work
+        commits on its own worker (the ``_submit_parts`` discipline —
+        join all, fold shard registries, park the failure list and
+        re-raise the first)."""
+        from functools import partial
+        self._ensure_workers()
+        submitted = []
+        for s, worker in enumerate(self._workers):
+            if s < len(pending) and pending[s]:
+                worker.submit(partial(self._commit_shard, s,
+                                      pending[s], origin_tick))
+                submitted.append((s, worker))
+        failures = []
+        for s, worker in submitted:
+            try:
+                worker.join()
+            except BaseException as e:
+                failures.append((s, e))
+        for s in range(self.shards):
+            self._proc_registry.fold_from(self._shard_regs[s],
+                                          self._fold_state[s],
+                                          shard=str(s))
+        if failures:
+            self._last_failures = failures
+            raise failures[0][1]
+
+    def _rca_step(self, now: float, served: List[QueuedBatch]) -> None:
+        """One tick's RCA pass: evidence buffering on the COORDINATOR
+        (shard-count-invariant content), then the alert→culprit pass;
+        both inside the measured tick wall — RCA rides the serve SLO.
+        Pruning floors at each tenant's OLDEST queued alert window, so
+        a budget-delayed run still finds its full evidence window in
+        the buffer (the determinism contract's "delayed run scores the
+        same evidence" clause).  THIS tick's new alerts enqueue BEFORE
+        the floor is computed: an alert fired across a traffic gap
+        longer than the evidence window would otherwise have its
+        pre-gap evidence pruned by the same tick's buffering, before
+        its run sees it (the enqueue is _rca_seen-guarded, so
+        _rca_tick's own enqueue pass below stays a no-op for these).
+        Brownout level >= 1 (the elastic policy's degradation ladder)
+        tightens the per-tick RCA budget to one run — the item set and
+        verdict CONTENT are budget-invariant (the PR-6 pin); only the
+        virtual scoring tick moves."""
+        self._rca_enqueue(now)
+        floor: Dict[int, int] = {}
+        for _, tid, w, _ in self._rca_queue:
+            floor[tid] = min(floor.get(tid, w), w)
+        for qb in served:
+            plane = self._rca_planes[
+                self.shard_of.get(qb.tenant_id, 0)
+                if len(self._rca_planes) > 1 else 0]
+            plane.buffer(qb.tenant_id, qb.spans,
+                         keep_window=floor.get(qb.tenant_id))
+        self._rca_tick(now, budget=(
+            1 if self.policy is not None
+            and self.policy.brownout_level >= 1 else None))
+
     def _score_fused(self, served: List[QueuedBatch]) -> None:
         """Tenant-fused scoring of one tick's drained batches.
 
@@ -1359,7 +1741,7 @@ class ServeEngine:
         self._score_shard(0, served)
 
     def _dispatch_rounds(self, pending: list, runner,
-                         chaos_hook=None) -> None:
+                         chaos_hook=None, defer: bool = False) -> None:
         """Phase 2 of fused scoring (STACK + DISPATCH), shared by the
         inline and sharded paths: per chunk round, same-width staged
         chunks lane-stack into fused dispatches through the runner's
@@ -1392,7 +1774,11 @@ class ServeEngine:
                 # exercises the abort path below with live in-flight
                 # work, the nastiest partial-tick state
                 chaos_hook("dispatch")
-            runner.drain_lanes()         # tick-end barrier: folds land
+            if not defer:
+                runner.drain_lanes()     # tick-end barrier: folds land
+            # defer=True (the async-commit issue path) leaves the
+            # in-flight dispatches for _commit_shard's barrier drain;
+            # the abort discipline below still owns the failure path
         except BaseException:
             # a failed tick must not park its issued dispatches in the
             # runner: a LATER tick's drain would fold the aborted
@@ -1504,19 +1890,24 @@ class ServeEngine:
 
     # -- the fleet census observatory (anomod.obs.census) -----------------
 
-    def _census_drain(self) -> dict:
+    def _census_drain(self, t_idx: Optional[int] = None) -> dict:
         """One tick-barrier census: the deterministic resident-bytes
         walk over every plane (shapes and container lengths only — the
         workers are quiescent at the barrier, so the per-shard pool/
         scratch reads race nothing), the hot-set/Zipf doc, the
         registry gauges, and the journal-shaped record the flight
         ``census`` variant key carries.  A pure read of engine state:
-        no clocks, no RNG, no mutation of any decision plane."""
+        no clocks, no RNG, no mutation of any decision plane.  The
+        deferred-commit barrier passes ``t_idx`` (the ORIGIN tick —
+        the live clock has already advanced by barrier time); the
+        synchronous tick reads the clock."""
         from anomod.obs.census import collect_resident_bytes
+        if t_idx is None:
+            t_idx = self.clock.ticks
         planes, by_plane, total, reconciled = \
             collect_resident_bytes(self)
         tracker = self._census_tracker
-        hot = tracker.hot_doc(self.clock.ticks, len(self.specs),
+        hot = tracker.hot_doc(t_idx, len(self.specs),
                               list(self._tenant_replay))
         self.census_ticks += 1
         self._census_reconciled = self._census_reconciled and reconciled
@@ -1538,14 +1929,16 @@ class ServeEngine:
             str(min(tracker.decay_ticks)), 0))
         g["occupancy"].set(hot["occupancy_vs_registered"])
         self._obs_census_ticks.inc()
-        return {"tick": self.clock.ticks, "planes": planes,
+        return {"tick": t_idx, "planes": planes,
                 "total_bytes": total, "pool_reconciled": reconciled,
                 "hot": hot}
 
     # -- the black-box flight recorder (anomod.obs.flight) ----------------
 
     def _flight_tick(self, now: float, served: List[QueuedBatch],
-                     tick_wall_s: float, final: bool = False) -> None:
+                     tick_wall_s: float, final: bool = False,
+                     t_idx: Optional[int] = None,
+                     tot=None) -> None:
         """Journal one tick into the flight recorder.
 
         The CANONICAL planes hold only seed-determined decisions (the
@@ -1562,12 +1955,21 @@ class ServeEngine:
         quiescent here, after the barrier).  ``final=True`` is the
         run-end settlement record: finish() alerts and budget-deferred
         RCA verdicts land in it, and a state digest is forced so every
-        journal ends on a full-state parity anchor."""
+        journal ends on a full-state parity anchor.
+
+        The deferred-commit barrier passes ``t_idx`` and ``tot``
+        snapshots taken at the ORIGIN tick (by barrier time the next
+        tick's admission has already mutated the live totals and the
+        clock has advanced); the synchronous tick reads them live —
+        identical values, so the canonical journal is
+        async-invariant."""
         from anomod.obs.flight import crc_text, state_digest
         from anomod.serve.shard import fold_leg_records
         fr = self.flight_recorder
-        t_idx = self.clock.ticks
-        tot = self.admission.totals()
+        if t_idx is None:
+            t_idx = self.clock.ticks
+        if tot is None:
+            tot = self.admission.totals()
         prev = self._flight_prev_tot
 
         def delta(field):
@@ -1753,7 +2155,15 @@ class ServeEngine:
         """Stop the shard worker threads (idempotent; the engine remains
         usable — the next sharded tick respawns them).  Every worker
         closes before a deferred task error propagates (the join_all
-        discipline)."""
+        discipline).  A close with an uncommitted deferred tick ABORTS
+        it (the _dispatch_rounds discipline): in-flight dispatches must
+        never park in the runners for a later drain to fold as stale
+        deltas — run() always commits before closing, so this only
+        fires on direct tick()+close() API use."""
+        if self._deferred is not None:
+            self._deferred = None
+            for r in self._runners:
+                r.abort_lanes()
         if self._workers is not None:
             errs = []
             for w in self._workers:
@@ -1881,16 +2291,29 @@ class ServeEngine:
 
     # -- the elastic-policy plane (anomod.serve.policy) --------------------
 
-    def _policy_step(self, served: List[QueuedBatch]) -> None:
+    def _policy_step(self, served: List[QueuedBatch],
+                     tick: Optional[int] = None,
+                     backlog_spans: Optional[int] = None,
+                     shed_spans: Optional[int] = None) -> None:
         """One tick-boundary policy evaluation on the coordinator:
         fold this tick's CANONICAL signals into the policy EWMAs,
         collect its decisions, execute them through the live-migration
         seams, and journal what actually happened.  Every input is a
         function of seed+config (served spans, staged-chunk books,
         backlog, shed — never a wall clock), so the whole scaling
-        schedule replays from the flight header."""
+        schedule replays from the flight header.  The deferred-commit
+        barrier passes ``tick`` / ``backlog_spans`` / ``shed_spans``
+        snapshots taken at the ORIGIN tick (by barrier time the next
+        tick's admission has already mutated the live values); the
+        synchronous tick reads them live — identical numbers, so the
+        scaling schedule is async-invariant."""
         from anomod.serve.policy import TickSignals
-        tick = self.clock.ticks
+        if tick is None:
+            tick = self.clock.ticks
+        if backlog_spans is None:
+            backlog_spans = self.admission.backlog_spans
+        if shed_spans is None:
+            shed_spans = self.admission.totals().shed_spans
         served_by_tenant: Dict[int, int] = {}
         for qb in served:
             served_by_tenant[qb.tenant_id] = \
@@ -1901,16 +2324,15 @@ class ServeEngine:
             prev = [0] * len(chunks)
         elif len(prev) != len(chunks):
             prev = (prev + [0] * len(chunks))[:len(chunks)]
-        tot = self.admission.totals()
         self.policy.observe(TickSignals(
             tick=tick, served_by_tenant=served_by_tenant,
             per_shard_chunks=[c - p for c, p in zip(chunks, prev)],
-            backlog_spans=self.admission.backlog_spans,
+            backlog_spans=backlog_spans,
             max_backlog=self.max_backlog,
-            shed_delta=tot.shed_spans - self._policy_prev_shed,
+            shed_delta=shed_spans - self._policy_prev_shed,
             budget_spans=self.capacity_spans_per_s
             * self.clock.tick_s))
-        self._policy_prev_shed = tot.shed_spans
+        self._policy_prev_shed = shed_spans
         topology_changed = False
         for d in self.policy.decide(tick, self.shards):
             topology_changed |= self._execute_decision(d, tick)
@@ -2255,6 +2677,14 @@ class ServeEngine:
                 hi = lo + self.clock.tick_s
                 self.tick(traffic.arrivals(lo, hi),
                           mod_src(lo, hi) if mod_src is not None else ())
+        if self._deferred is not None:
+            # the run-end barrier: the last tick's deferred commit must
+            # land before finish() reads any tenant state (its wall
+            # joins the serve wall — the seam hides waits, never drops
+            # them)
+            t0 = time.perf_counter()
+            self._commit_deferred()
+            self.serve_wall_s += time.perf_counter() - t0
         t_wall = time.perf_counter()
         if self.score:
             for det in self._tenant_det.values():
@@ -2562,6 +2992,9 @@ class ServeEngine:
             census_hot_set=dict(self.census_hot_set),
             census_resident_bytes=dict(self.census_resident),
             census_wall_s=round(self.census_wall_s, 4),
+            async_commit=self.async_commit,
+            async_ticks=self.async_ticks,
+            commit_defer_wall_s=round(self.commit_defer_wall_s, 6),
             serve_wall_s=round(self.serve_wall_s, 4),
             sustained_spans_per_sec=round(
                 self.n_spans_served / max(self.serve_wall_s, 1e-9), 1),

@@ -19,13 +19,25 @@ tag), and two backlog bounds provide backpressure:
 Everything is host-side bookkeeping over integers and floats — no wall
 clocks, no randomness — so a seeded overload replay is bit-reproducible
 (the determinism contract tests/test_serve.py pins).
+
+Two drain/shed engines implement the same contract
+(``ANOMOD_SERVE_NATIVE_DRAIN``): the original per-span Python heap pair
+(``off`` — kept as the parity oracle) and the columnar engine
+(:class:`_ColumnarSFQ`, the default) whose candidate scans run over
+parallel NumPy arrays — in the native runtime (``anomod_sfq_drain`` /
+``anomod_sfq_victim``) when it loads, pure ``lexsort`` otherwise.  All
+three paths are pinned byte-identical: same served order, same shed and
+eviction victims, same SFQ virtual-time floats.
 """
 
 from __future__ import annotations
 
+import ctypes
 import dataclasses
 import heapq
 from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from anomod import obs
 from anomod.schemas import SpanBatch
@@ -76,14 +88,164 @@ class TenantCounters:
     evicted_batches: int = 0
 
 
+class _ColumnarSFQ:
+    """Struct-of-arrays mirror of the SFQ drain/evict heap pair.
+
+    The heap engine pays per-batch Python on the serve hot path: one
+    heappush onto BOTH heaps per admitted batch, lazy-deletion pops,
+    and an amortized evict-heap compaction.  Here the pending-batch
+    book is five parallel columns (finish tag, admission seq, span
+    count, priority, alive mask) and the two hot scans become kernels
+    over them:
+
+    - drain selection: sort the alive slots by ``(finish_tag, seq)`` —
+      exactly the drain heap's pop order (seqs are unique) — then walk
+      the budget down with the SAME sequential float64 subtraction the
+      heap loop performs (serve while ``remaining > 0``, one-batch
+      overdraw included), and
+    - shed victim: lexicographic argmax of ``(priority, finish_tag,
+      seq)`` over the alive slots — exactly what the lazy evict heap's
+      top names.
+
+    Both kernels run in the native runtime (GIL released) when it
+    loads, with a pure-NumPy fallback (``lexsort`` + the same walk)
+    otherwise; the per-batch bookkeeping (counters, virtual-time floor,
+    :class:`QueuedBatch` emission) stays in the controller unchanged,
+    so all three engines are byte-identical (tests/test_serve.py pins
+    heap == columnar-numpy == columnar-native).
+    """
+
+    __slots__ = ("fin", "seq", "nsp", "pri", "alive", "engine", "_lib",
+                 "_slot_of", "_free", "_n", "_out",
+                 "_p_fin", "_p_seq", "_p_nsp", "_p_pri", "_p_alive",
+                 "_p_out")
+
+    def __init__(self, cap: int = 256, require_native: bool = False):
+        from anomod.io import native as io_native
+        self._lib = io_native.sfq_kernels(require=require_native)
+        self.engine = "native" if self._lib is not None else "numpy"
+        cap = max(int(cap), 16)
+        self.fin = np.zeros(cap, np.float64)
+        self.seq = np.zeros(cap, np.int64)
+        self.nsp = np.zeros(cap, np.int64)
+        self.pri = np.zeros(cap, np.int64)
+        self.alive = np.zeros(cap, np.uint8)
+        self._slot_of: Dict[int, int] = {}
+        self._free: List[int] = []
+        self._n = 0                       # slot high-water mark
+        self._out = np.empty(cap, np.int64)
+        self._rebind()
+
+    def _rebind(self) -> None:
+        # marshal the column pointers ONCE per (re)allocation — the
+        # StagePlan discipline: per-call ctypes extraction costs as much
+        # as the scan it wraps on a small backlog
+        self._p_fin = self.fin.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double))
+        self._p_seq = self.seq.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64))
+        self._p_nsp = self.nsp.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64))
+        self._p_pri = self.pri.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64))
+        self._p_alive = self.alive.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_uint8))
+        self._p_out = self._out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64))
+
+    def _grow(self) -> None:
+        cap = len(self.fin) * 2
+        for name in ("fin", "seq", "nsp", "pri", "alive"):
+            old = getattr(self, name)
+            new = np.zeros(cap, old.dtype)
+            new[:len(old)] = old
+            setattr(self, name, new)
+        self._out = np.empty(cap, np.int64)
+        self._rebind()
+
+    def add(self, qb: "QueuedBatch") -> None:
+        if self._free:
+            slot = self._free.pop()
+        else:
+            if self._n >= len(self.fin):
+                self._grow()
+            slot = self._n
+            self._n += 1
+        self.fin[slot] = qb.finish_tag
+        self.seq[slot] = qb.seq
+        self.nsp[slot] = qb.n_spans
+        self.pri[slot] = qb.priority
+        self.alive[slot] = 1
+        self._slot_of[qb.seq] = slot
+
+    def remove(self, seq: int) -> None:
+        slot = self._slot_of.pop(seq)
+        self.alive[slot] = 0
+        self._free.append(slot)
+
+    def select(self, budget: float) -> List[int]:
+        """Admission seqs served by ``budget`` spans, in drain order."""
+        n = self._n
+        if not self._slot_of:
+            return []
+        if self._lib is not None:
+            count = self._lib.anomod_sfq_drain(
+                self._p_fin, self._p_seq, self._p_nsp, self._p_alive,
+                n, ctypes.c_double(budget), self._p_out)
+            if count >= 0:
+                return [int(self.seq[s]) for s in self._out[:count]]
+        idx = np.flatnonzero(self.alive[:n])
+        order = np.lexsort((self.seq[idx], self.fin[idx]))
+        out: List[int] = []
+        remaining = float(budget)
+        for slot in idx[order]:
+            if not remaining > 0:
+                break
+            remaining -= int(self.nsp[slot])
+            out.append(int(self.seq[slot]))
+        return out
+
+    def victim(self) -> Optional[int]:
+        """Admission seq of the batch the evict heap's top would name
+        (lexicographic max of (priority, finish_tag, seq) over the
+        alive slots); None when nothing is queued."""
+        n = self._n
+        if not self._slot_of:
+            return None
+        if self._lib is not None:
+            got = self._lib.anomod_sfq_victim(
+                self._p_fin, self._p_seq, self._p_pri, self._p_alive, n)
+            if got >= 0:
+                return int(self.seq[got])
+        idx = np.flatnonzero(self.alive[:n])
+        k = np.lexsort((self.seq[idx], self.fin[idx], self.pri[idx]))[-1]
+        return int(self.seq[idx[k]])
+
+
 class AdmissionController:
     """Weighted-fair admission over a bounded multi-tenant backlog."""
 
     def __init__(self, tenants: Sequence[TenantSpec],
                  max_backlog: int = 200_000,
-                 max_tenant_backlog: Optional[int] = None):
+                 max_tenant_backlog: Optional[int] = None,
+                 drain_engine: Optional[str] = None):
         if max_backlog < 1:
             raise ValueError("max_backlog must be >= 1 span")
+        if drain_engine is None:
+            from anomod.config import get_config
+            drain_engine = get_config().serve_native_drain
+        if drain_engine not in ("auto", "on", "off"):
+            raise ValueError(
+                f"drain_engine must be auto, on or off, got "
+                f"{drain_engine!r}")
+        #: the resolved drain/shed engine: "heap" (the Python oracle),
+        #: "numpy" or "native" (both columnar) — what the flight header
+        #: and `anomod validate` surface
+        self.drain_engine = "heap"
+        self._col: Optional[_ColumnarSFQ] = None
+        if drain_engine != "off":
+            self._col = _ColumnarSFQ(require_native=(drain_engine == "on"))
+            self.drain_engine = self._col.engine
         self.specs: Dict[int, TenantSpec] = {t.tenant_id: t for t in tenants}
         if len(self.specs) != len(tenants):
             raise ValueError("duplicate tenant_id in tenant specs")
@@ -199,9 +361,12 @@ class AdmissionController:
                          enqueued_s=now_s, finish_tag=finish)
         self._seq += 1
         self._alive[qb.seq] = qb
-        heapq.heappush(self._drain_heap, (qb.finish_tag, qb.seq))
-        heapq.heappush(self._evict_heap,
-                       (-qb.priority, -qb.finish_tag, -qb.seq))
+        if self._col is not None:
+            self._col.add(qb)
+        else:
+            heapq.heappush(self._drain_heap, (qb.finish_tag, qb.seq))
+            heapq.heappush(self._evict_heap,
+                           (-qb.priority, -qb.finish_tag, -qb.seq))
         self.backlog_spans += n
         self._tenant_backlog[tenant_id] += n
         self._priority_backlog[spec.priority] = \
@@ -217,6 +382,15 @@ class AdmissionController:
         """The queued batch a higher-priority arrival may displace:
         strictly lower priority than the arrival, lowest class first,
         latest finish tag first.  None when nothing qualifies."""
+        if self._col is not None:
+            seq = self._col.victim()
+            if seq is None:
+                return None
+            qb = self._alive[seq]
+            # the columnar argmax is the GLOBAL max priority number —
+            # if even it is not strictly lower than the arrival,
+            # nothing queued is (the lazy heap's top-check, restated)
+            return qb if qb.priority > incoming_priority else None
         while self._evict_heap:
             neg_pri, neg_fin, neg_seq = self._evict_heap[0]
             qb = self._alive.get(-neg_seq)
@@ -234,6 +408,9 @@ class AdmissionController:
         self.backlog_spans -= qb.n_spans
         self._tenant_backlog[qb.tenant_id] -= qb.n_spans
         self._priority_backlog[qb.priority] -= qb.n_spans
+        if self._col is not None:
+            self._col.remove(qb.seq)
+            return
         # the evict heap prunes lazily only when overflow consults its
         # top; a long never-overloaded run would otherwise accumulate one
         # stale entry per drained batch forever — compact when stale
@@ -255,6 +432,22 @@ class AdmissionController:
         batch wider than a whole tick's budget still drains instead of
         deadlocking the queue.
         """
+        if self._col is not None:
+            out = []
+            for seq in self._col.select(float(budget_spans)):
+                qb = self._alive[seq]
+                self._remove(qb)
+                self._vtime = max(
+                    self._vtime, qb.finish_tag - qb.n_spans
+                    / self.specs[qb.tenant_id].effective_weight())
+                c = self.counters[qb.tenant_id]
+                c.served_spans += qb.n_spans
+                c.served_batches += 1
+                self._obs_served.inc(qb.n_spans)
+                out.append(qb)
+            if out:
+                self._obs_depths()
+            return out
         out: List[QueuedBatch] = []
         remaining = float(budget_spans)
         while remaining > 0 and self._drain_heap:

@@ -268,16 +268,22 @@ class ShardSupervisor:
 
     # -- recovery ----------------------------------------------------------
 
-    def recover(self, failures: List[Tuple[int, BaseException]]) -> None:
+    def recover(self, failures: List[Tuple[int, BaseException]],
+                origin_tick: Optional[int] = None) -> None:
         """Recover every shard that failed this tick's barrier.  Raises
         (the original error) only when recovery is impossible: retry +
-        quarantine exhausted AND no surviving shard to migrate to."""
+        quarantine exhausted AND no surviving shard to migrate to.
+        The deferred-commit barrier passes ``origin_tick`` (a
+        barrier-time failure belongs to the tick that ISSUED the work,
+        one tick behind the clock) so the retry ledger charges the
+        slice that actually failed."""
         t0 = time.perf_counter()
         try:
             for shard_id, exc in failures:
                 if not isinstance(exc, Exception):
                     raise exc     # operator interrupt, never a fault
-                self._recover_shard(shard_id, exc)
+                self._recover_shard(shard_id, exc,
+                                    origin_tick=origin_tick)
         finally:
             dt = time.perf_counter() - t0
             self.recovery_wall_s += dt

@@ -320,6 +320,19 @@ def serve_main(probe_fresh=False) -> int:
             set_registry(Registry(enabled=True))
             eng_perf, rep_perf = run_power_law(perf=True, shards=1,
                                                **run_kw)
+            # the ASYNC-COMMIT leg (ISSUE-16): same seed, the deferred-
+            # commit tick forced ON with the perf recorder — tick N's
+            # fold dispatch is issued un-waited, the coordinator runs
+            # tick N+1's admission/drain/shed/SLO under the in-flight
+            # XLA work, and the commit barrier lands just before the
+            # results are first read.  Runs right after the perf leg
+            # (its matched synchronous A side) so the hidden-wait
+            # numbers inherit identical warmup; the parity bits are
+            # the capture's own proof that the overlap moved only
+            # wall-clock, never a scored byte.
+            set_registry(Registry(enabled=True))
+            eng_async, rep_async = run_power_law(
+                async_commit=True, perf=True, shards=1, **run_kw)
             # the ELASTICITY legs: a sub-capacity fleet hit by a
             # scripted load surge (the chaos 'surge' kind), served
             # twice on the same seed — once static, once under the
@@ -706,6 +719,49 @@ def serve_main(probe_fresh=False) -> int:
                 == rep.latency.get("p99_latency_s"),
                 "shed_identical":
                     rep_perf.shed_fraction == rep.shed_fraction,
+            },
+        }
+        # the deferred-commit serve tick (ISSUE-16): the async leg vs
+        # its matched synchronous perf leg — the committed fold WAIT
+        # collapsing out of the serve wall (the `commit_defer` perf leg
+        # carries where it went), with states/alerts/p99/shed and the
+        # canonical flight journal pinned byte-identical.  The per-tick
+        # raw_wall_s sample list is what `anomod perf diff` bootstraps
+        # over to judge the overlap noise-aware.
+        _as_alerts_same, _as_states_same = _engines_identical(
+            eng_perf, eng_async)
+        _as_journal_ok = None
+        if eng_perf.flight_recorder is not None \
+                and eng_async.flight_recorder is not None:
+            _as_journal_ok = _diff_journals(
+                eng_perf.flight_recorder.journal(),
+                eng_async.flight_recorder.journal()) is None
+        out["async_commit"] = {
+            "enabled_headline": rep.async_commit,
+            "async_ticks": rep_async.async_ticks,
+            "commit_defer_wall_s": rep_async.commit_defer_wall_s,
+            "fold_wait_s_sync": rep_perf.fold_wait_s,
+            "fold_wait_s_async": rep_async.fold_wait_s,
+            "fold_wait_hidden_fraction": round(max(
+                0.0, 1.0 - rep_async.fold_wait_s
+                / max(rep_perf.fold_wait_s, 1e-9)), 4),
+            "serve_wall_s_sync": rep_perf.serve_wall_s,
+            "serve_wall_s_async": rep_async.serve_wall_s,
+            "spans_per_sec_sync": rep_perf.sustained_spans_per_sec,
+            "spans_per_sec_async": rep_async.sustained_spans_per_sec,
+            "speedup": round(rep_async.sustained_spans_per_sec
+                             / max(rep_perf.sustained_spans_per_sec,
+                                   1e-9), 2),
+            "async_leg": {"raw_wall_s": [round(t, 6) for t
+                                         in eng_async.tick_walls]},
+            "parity": {
+                "alerts_identical": _as_alerts_same,
+                "states_identical": _as_states_same,
+                "p99_identical": rep_async.latency.get("p99_latency_s")
+                == rep_perf.latency.get("p99_latency_s"),
+                "shed_identical":
+                    rep_async.shed_fraction == rep_perf.shed_fraction,
+                "journal_canonical_identical": _as_journal_ok,
             },
         }
         # elastic serving (ISSUE-13): the policy leg's scaling episodes

@@ -11,6 +11,7 @@
 //
 // Build: make -C native   (g++ -O3 -shared -fPIC -pthread)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <cstdio>
@@ -492,6 +493,70 @@ int64_t anomod_stage_lanes_mat(void* rt_ptr, void* const* dst,
         for (int32_t c = 0; c < n_cols; ++c) stage_col(c);
     }
     return (int64_t)n_cols * lanes * width;
+}
+
+// ---- admission-plane columnar SFQ kernels ---------------------------------
+//
+// The serve tick's admission drain/shed loop (anomod/serve/queues.py) keeps
+// its pending-batch book as parallel columns: finish tag (double), admission
+// seq (int64, unique), span count (int64), priority (int64) and an alive
+// mask (uint8), all n slots long (dead slots are skipped, the lazy-deletion
+// idiom of the Python heaps these kernels replace).  Both kernels are pure
+// functions over caller-owned arrays — no shared or static state — so
+// concurrent callers (the sanitize hammer drives them from N threads) race
+// only if the caller shares arrays.  The GIL is released for the whole call.
+//
+// Byte-parity contract with the Python heap oracle:
+// - drain: candidates sorted ascending by (fin, seq) == the drain heap's
+//   pop order; the budget walk is the SAME sequential float64 subtraction
+//   (select while remaining > 0, then remaining -= n_spans — the one-batch
+//   overdraw included), so the selected set and its order are identical.
+// - victim: lexicographic argmax of (pri, fin, seq) over alive slots ==
+//   the lazy evict heap's top (ordered by (-pri, -fin, -seq)).
+
+// Select the slots a drain of ``budget`` spans serves, in SFQ order.
+// Writes selected slot indices to out_idx; returns the count, or -1 on
+// malformed arguments — the Python caller treats -1 as "fall back to the
+// NumPy scan".
+int64_t anomod_sfq_drain(const double* fin, const int64_t* seq,
+                         const int64_t* nsp, const uint8_t* alive,
+                         int64_t n, double budget, int64_t* out_idx) {
+    if (!fin || !seq || !nsp || !alive || !out_idx || n < 0) return -1;
+    std::vector<int64_t> cand;
+    cand.reserve((size_t)n);
+    for (int64_t i = 0; i < n; ++i)
+        if (alive[i]) cand.push_back(i);
+    std::sort(cand.begin(), cand.end(), [&](int64_t a, int64_t b) {
+        if (fin[a] != fin[b]) return fin[a] < fin[b];
+        return seq[a] < seq[b];
+    });
+    double remaining = budget;
+    int64_t count = 0;
+    for (int64_t i : cand) {
+        if (!(remaining > 0.0)) break;
+        remaining -= (double)nsp[i];
+        out_idx[count++] = i;
+    }
+    return count;
+}
+
+// The eviction candidate's slot: lexicographic max of (pri, fin, seq) over
+// the alive slots.  Returns -1 when no slot is alive (or on malformed
+// arguments); the Python caller applies the strictly-lower-priority check.
+int64_t anomod_sfq_victim(const double* fin, const int64_t* seq,
+                          const int64_t* pri, const uint8_t* alive,
+                          int64_t n) {
+    if (!fin || !seq || !pri || !alive || n < 0) return -1;
+    int64_t best = -1;
+    for (int64_t i = 0; i < n; ++i) {
+        if (!alive[i]) continue;
+        if (best < 0 || pri[i] > pri[best] ||
+            (pri[i] == pri[best] &&
+             (fin[i] > fin[best] ||
+              (fin[i] == fin[best] && seq[i] > seq[best]))))
+            best = i;
+    }
+    return best;
 }
 
 // Multithreaded variant over pre-split chunks of one large buffer.

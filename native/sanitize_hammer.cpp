@@ -20,6 +20,7 @@
 //
 // Build + run: make -C native tsan   (or: make -C native asan)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -40,6 +41,12 @@ int64_t anomod_stage_lanes_mat(void* rt_ptr, void* const* dst,
                                const int64_t* strides, const int64_t* n_rows,
                                const uint32_t* fills, int32_t n_cols,
                                int32_t n_live, int64_t lanes, int64_t width);
+int64_t anomod_sfq_drain(const double* fin, const int64_t* seq,
+                         const int64_t* nsp, const uint8_t* alive,
+                         int64_t n, double budget, int64_t* out_idx);
+int64_t anomod_sfq_victim(const double* fin, const int64_t* seq,
+                          const int64_t* pri, const uint8_t* alive,
+                          int64_t n);
 }
 
 namespace {
@@ -149,6 +156,83 @@ int hammer(int n_workers, int iters, int depth, int32_t n_live,
     return failures.load();
 }
 
+// ---- SFQ drain/shed kernels (PR 16) ---------------------------------------
+//
+// anomod_sfq_drain / anomod_sfq_victim are pure functions over caller-owned
+// columns — the race-freedom claim is "no hidden shared/static state", so
+// the hammer drives them from N concurrent threads, each on its own arrays,
+// and checks the results against an independently-written O(n^2) reference
+// (repeated min-scan selection for the drain; a separate max-scan pass for
+// the victim).  Any cross-thread corruption breaks byte-parity; any shared
+// internals trip TSan.
+
+void sfq_worker(int tid, int iters, int64_t n) {
+    uint32_t seed = 0x85ebca6bu * (uint32_t)(tid + 1);
+    std::vector<double> fin((size_t)n);
+    std::vector<int64_t> seq((size_t)n), nsp((size_t)n), pri((size_t)n);
+    std::vector<uint8_t> alive((size_t)n);
+    std::vector<int64_t> out((size_t)n), want((size_t)n);
+    for (int it = 0; it < iters; ++it) {
+        for (int64_t i = 0; i < n; ++i) {
+            fin[(size_t)i] = (double)(lcg(seed) % 4096u) / 16.0;
+            seq[(size_t)i] = i;          // unique, the tie-break contract
+            nsp[(size_t)i] = 1 + (int64_t)(lcg(seed) % 200u);
+            pri[(size_t)i] = (int64_t)(lcg(seed) % 3u);
+            alive[(size_t)i] = (uint8_t)(lcg(seed) % 4u != 0);
+        }
+        const double budget = (double)(lcg(seed) % 2048u) + 0.5;
+        const int64_t got = anomod_sfq_drain(
+            fin.data(), seq.data(), nsp.data(), alive.data(), n, budget,
+            out.data());
+        // reference: repeated min-scan (selection sort, no std::sort) +
+        // the same sequential budget walk
+        std::vector<uint8_t> left(alive);
+        double remaining = budget;
+        int64_t n_want = 0;
+        for (;;) {
+            if (!(remaining > 0.0)) break;
+            int64_t best = -1;
+            for (int64_t i = 0; i < n; ++i) {
+                if (!left[(size_t)i]) continue;
+                if (best < 0 || fin[(size_t)i] < fin[(size_t)best] ||
+                    (fin[(size_t)i] == fin[(size_t)best] &&
+                     seq[(size_t)i] < seq[(size_t)best]))
+                    best = i;
+            }
+            if (best < 0) break;
+            left[(size_t)best] = 0;
+            remaining -= (double)nsp[(size_t)best];
+            want[(size_t)n_want++] = best;
+        }
+        if (got != n_want ||
+            !std::equal(out.begin(), out.begin() + (size_t)n_want,
+                        want.begin()))
+            ++failures;
+        const int64_t v = anomod_sfq_victim(
+            fin.data(), seq.data(), pri.data(), alive.data(), n);
+        int64_t vref = -1;
+        for (int64_t i = 0; i < n; ++i) {
+            if (!alive[(size_t)i]) continue;
+            if (vref < 0 ||
+                pri[(size_t)i] > pri[(size_t)vref] ||
+                (pri[(size_t)i] == pri[(size_t)vref] &&
+                 (fin[(size_t)i] > fin[(size_t)vref] ||
+                  (fin[(size_t)i] == fin[(size_t)vref] &&
+                   seq[(size_t)i] > seq[(size_t)vref]))))
+                vref = i;
+        }
+        if (v != vref) ++failures;
+    }
+}
+
+int sfq_hammer(int n_workers, int iters, int64_t n) {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < n_workers; ++t)
+        ts.emplace_back(sfq_worker, t, iters, n);
+    for (auto& t : ts) t.join();
+    return failures.load();
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -162,6 +246,10 @@ int main(int argc, char** argv) {
     // one queue, exactly the overlap the GIL-free path exists for
     hammer(n_workers, iters / 8 + 1, /*depth=*/2, /*n_live=*/6,
            /*lanes=*/8, /*width=*/8192);
+    // admission-plane SFQ kernels: N threads drain/shed concurrently on
+    // their own columns against an O(n^2) reference — byte-parity catches
+    // corruption, TSan catches any hidden shared state
+    sfq_hammer(n_workers, iters, /*n=*/512);
     const int f = failures.load();
     if (f) {
         std::fprintf(stderr, "sanitize_hammer: %d byte-parity failures\n",

@@ -9,7 +9,11 @@ turns it into a CI-checkable artifact: it builds the whole native layer
 with ``-fsanitize=thread`` (or ``address``) plus the staging hammer
 driver (``native/sanitize_hammer.cpp`` — N worker threads, each owning
 its own pipeline scratch slots, ALL sharing one Runtime pool: the
-StagePlan fill pattern) and runs it.
+StagePlan fill pattern) and runs it.  The hammer also covers the
+admission-plane columnar SFQ kernels (``anomod_sfq_drain`` /
+``anomod_sfq_victim``): each worker drives them against an O(n^2)
+repeated-scan reference oracle, so the serve drain/shed hot loop is
+proven race-free and byte-identical the same way the staging layer is.
 
 Why a native driver instead of the Python GIL-overlap hammer: a
 TSan-instrumented shared library cannot be dlopen'd into an

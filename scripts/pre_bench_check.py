@@ -100,6 +100,12 @@ and the table in docs/BENCHMARKS.md mirrors them):
   byte, recorded no census, or a state pool's array bytes stopped
   reconciling with ``(capacity + 1) × per-slot nbytes`` — a capture's
   census block (the tiering baseline) could not be trusted.
+- ``EXIT_ASYNC_DIVERGENCE`` (13): the deferred-commit smoke (the same
+  tiny seeded run served synchronous and with
+  ``ANOMOD_SERVE_ASYNC_COMMIT`` on) diverged on states, alerts, SLO,
+  shed or the canonical flight journal, or never actually deferred a
+  tick — the async engine broke the byte-parity contract and an
+  async capture's decision planes could not be trusted.
 
 Always prints one JSON line describing the decision (plus the contract
 gate's line).  ``--traces`` must match the bench invocation's span
@@ -129,6 +135,7 @@ EXIT_LINT = 9
 EXIT_POLICY_DIVERGENCE = 10
 EXIT_PERF_DIVERGENCE = 11
 EXIT_CENSUS_DIVERGENCE = 12
+EXIT_ASYNC_DIVERGENCE = 13
 
 
 def _shard_fanout_smoke() -> dict:
@@ -344,6 +351,42 @@ def _elastic_smoke():
             f"elastic smoke produced no full scaling episode: {info}")
     return info, diff_journals(eng_static.flight_recorder.journal(),
                                eng_elastic.flight_recorder.journal())
+
+
+def _async_commit_smoke():
+    """The deferred-commit byte-parity smoke (<5 s): the same tiny
+    seeded run served synchronous (the parity oracle) and again with
+    the deferred-commit tick on (``ANOMOD_SERVE_ASYNC_COMMIT``).  The
+    async leg must actually defer (``async_ticks > 0`` — a silently
+    synchronous "async" run would pass parity vacuously, raised as a
+    precondition failure) and must match the oracle on tenant states,
+    alerts, SLO, shed and the canonical flight journal — the deferred
+    barrier moves wall-clock attribution, never a scored byte.
+    Returns ``(info, divergence_or_None)``."""
+    from anomod.obs.flight import diff_journals
+    from anomod.serve.engine import run_power_law
+
+    kw = dict(n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+              overload=2.0, duration_s=20, tick_s=1.0, seed=5,
+              window_s=5.0, baseline_windows=4, fault_tenants=0,
+              buckets=(64, 256), lane_buckets=(1, 2, 4),
+              max_backlog=1500, n_windows=16, shards=2, pipeline=2,
+              flight=True, flight_digest_every=4, ckpt_every=4)
+    eng_sync, rep_sync = run_power_law(async_commit=False, **kw)
+    eng_async, rep_async = run_power_law(async_commit=True, **kw)
+    info = {"async_ticks": rep_async.async_ticks,
+            "commit_defer_wall_s": rep_async.commit_defer_wall_s,
+            "p99_identical": rep_async.latency.get("p99_latency_s")
+            == rep_sync.latency.get("p99_latency_s"),
+            "shed_identical":
+                rep_async.shed_fraction == rep_sync.shed_fraction}
+    if rep_async.async_ticks < 1:
+        raise RuntimeError(
+            f"async-commit smoke never deferred a tick: {info}")
+    if not (info["p99_identical"] and info["shed_identical"]):
+        return info, {"tick": -1, "plane": "slo/shed"}
+    return info, diff_journals(eng_sync.flight_recorder.journal(),
+                               eng_async.flight_recorder.journal())
 
 
 def _perf_smoke():
@@ -684,6 +727,22 @@ def check_serve() -> int:
                   "not trust census blocks or `anomod census diff` "
                   "verdicts", file=sys.stderr)
             return EXIT_CENSUS_DIVERGENCE
+        # the deferred-commit smoke: the async engine must be a pure
+        # wall-clock move — byte parity with the synchronous oracle on
+        # every decision plane, its own exit code so a driver can tell
+        # "async broke parity" from every other divergence
+        async_info, async_div = _async_commit_smoke()
+        out["async_commit_smoke"] = async_info
+        if async_div is not None:
+            out["status"] = "async-divergence"
+            out["divergence"] = async_div
+            print(json.dumps(out))
+            print(f"pre_bench_check: deferred-commit smoke diverged at "
+                  f"tick {async_div['tick']} in the "
+                  f"{async_div['plane']} plane — the async tick moved "
+                  "a scored byte; do not capture with "
+                  "ANOMOD_SERVE_ASYNC_COMMIT on", file=sys.stderr)
+            return EXIT_ASYNC_DIVERGENCE
         print(json.dumps(out))
         return EXIT_READY
     except Exception as e:

@@ -1,0 +1,26 @@
+"""Must-pass fixture for C601: the commit barrier lands before the
+first read of scoring-committed state (the async-tail ordering), and
+reads with no deferred work in flight are untouched."""
+
+
+class Engine:
+    def tick(self, served):
+        # reads BEFORE any deferred issue are free
+        n_before = len(self._tenant_det)
+        self._commit_deferred()                      # prior tick's barrier
+        pending = self._stage_pending(served)
+        self._dispatch_rounds(pending, defer=True)   # window opens
+        self._deferred = {"pending": pending}
+        if self.checkpoint_due():
+            self._commit_deferred()                  # barrier closes it
+        return n_before, len(self._tenant_det)       # post-barrier read
+
+    def closure_is_not_a_window_read(self, served, pending):
+        self._deferred = {"pending": pending}
+
+        def _later():
+            # executes at the barrier, on the worker — not a window read
+            return self.alerts_for(0)
+
+        self._commit_deferred()
+        return _later()

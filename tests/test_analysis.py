@@ -58,6 +58,8 @@ def _active_rules(findings):
      "", ["S302"]),
     ("lock_trip.py", "lock_pass.py", "anomod/obs/registry.py", "",
      ["L501"]),
+    ("commit_barrier_trip.py", "commit_barrier_pass.py",
+     "anomod/serve/fixture.py", "", ["C601"]),
 ])
 def test_fixture_family(trip, passes, pretend, corpus, rules):
     assert _active_rules(_lint_fixture(trip, pretend, corpus)) == rules
@@ -225,7 +227,13 @@ CANONICAL_REPORT_FIELDS = (
     # decisions alone — all three shard-invariant (pinned in
     # tests/test_census.py); the resident-bytes dict follows the
     # pool/scratch topology and lives on SHARD_VARIANT_REPORT_FIELDS
-    "census_enabled", "census_ticks", "census_hot_set")
+    "census_enabled", "census_ticks", "census_hot_set",
+    # the deferred-commit seam (ISSUE-16): the mode bit is config and
+    # the async tick count is a pure function of config × run length
+    # (every served tick defers except the forced-sync checkpoint
+    # cadence), so both are parity-checked; the hidden-wait wall
+    # (commit_defer_wall_s) lives on SHARD_VARIANT_REPORT_FIELDS
+    "async_commit", "async_ticks")
 
 
 def test_canonical_report_inventory_pinned():

@@ -413,6 +413,7 @@ def test_pre_bench_exit_codes_named_and_unique():
         "EXIT_FLIGHT_DIVERGENCE": 7, "EXIT_RECOVERY_DIVERGENCE": 8,
         "EXIT_LINT": 9, "EXIT_POLICY_DIVERGENCE": 10,
         "EXIT_PERF_DIVERGENCE": 11, "EXIT_CENSUS_DIVERGENCE": 12,
+        "EXIT_ASYNC_DIVERGENCE": 13,
     }
     # every literal return in the gate's source goes through a constant
     src = (Path(__file__).parent.parent / "scripts"
