@@ -489,6 +489,27 @@ def main(argv=None) -> int:
     p_serve.add_argument("--trace-out", default=None,
                          help="dump the engine's own Jaeger-shaped trace "
                               "(anomod.utils.tracing.Tracer)")
+    p_serve.add_argument("--from-live", default=None, metavar="URL",
+                         help="drive the tick from a LIVE Prometheus "
+                              "text-exposition endpoint instead of the "
+                              "synthetic fleet (anomod.serve.feed); "
+                              "'self' starts the embedded /metrics "
+                              "endpoint (anomod.obs.http) and scrapes "
+                              "this process's OWN registry — the "
+                              "dogfood closed loop")
+    p_serve.add_argument("--live-replay", default=None, metavar="JOURNAL",
+                         help="re-run a recorded live-feed wire journal "
+                              "(ANOMOD_FEED_JOURNAL) through the replay "
+                              "transport: byte-identical planes, no "
+                              "network; the feed shape comes from the "
+                              "journal header (--tenants/--services are "
+                              "ignored)")
+    p_serve.add_argument("--feed-lag", type=float, default=None,
+                         help="live-feed wall->virtual lag budget in "
+                              "seconds (default: ANOMOD_SERVE_FEED_LAG_S)")
+    p_serve.add_argument("--feed-journal", default=None,
+                         help="record the live feed's wire journal to "
+                              "this path (default: ANOMOD_FEED_JOURNAL)")
 
     p_obs = sub.add_parser(
         "obs", help="self-scraping telemetry plane (anomod.obs): snapshot "
@@ -1155,6 +1176,71 @@ def main(argv=None) -> int:
                      if p.strip()])
             except ValueError as e:
                 parser.error(f"--lane-buckets: {e}")
+        if args.from_live or args.live_replay:
+            if args.from_live and args.live_replay:
+                parser.error("--from-live contradicts --live-replay")
+            for flag, bad in (("--devices", args.devices),
+                              ("--chaos", args.chaos),
+                              ("--rca", args.rca),
+                              ("--policy", args.policy),
+                              ("--policy-script", args.policy_script),
+                              ("--async-commit", args.async_commit),
+                              ("--state", args.state),
+                              ("--ckpt-every", args.ckpt_every),
+                              ("--trace-out", args.trace_out),
+                              ("--perf", args.perf)):
+                if bad:
+                    parser.error(f"{flag} is not supported on the "
+                                 "live-feed path")
+            from anomod.serve.feed import run_live_feed
+            endpoint = None
+            scrape_url = args.from_live
+            if scrape_url and scrape_url.strip().lower() == "self":
+                # the dogfood closed loop: serve this process's own
+                # registry over real HTTP and point the feed at it
+                from anomod.obs.http import ObsHttpServer
+                endpoint = ObsHttpServer(
+                    port=get_config().obs_http_port).start()
+                scrape_url = f"{endpoint.url}/metrics"
+            elif scrape_url and "://" not in scrape_url:
+                parser.error("--from-live takes a URL (or 'self')")
+            try:
+                if args.live_replay:
+                    _, report, _ = run_live_feed(
+                        replay=args.live_replay,
+                        capacity_spans_per_s=args.capacity,
+                        duration_s=args.duration, tick_s=args.tick,
+                        lag_s=args.feed_lag,
+                        window_s=args.window_seconds,
+                        baseline_windows=args.baseline_windows,
+                        z_threshold=args.threshold, buckets=buckets,
+                        lane_buckets=lane_buckets,
+                        max_backlog=args.max_backlog,
+                        score=not args.no_score,
+                        fuse=False if args.no_fuse else None,
+                        shards=args.shards, pipeline=args.pipeline)
+                else:
+                    _, report, _ = run_live_feed(
+                        scrape_url=scrape_url,
+                        n_tenants=args.tenants,
+                        n_services=args.services,
+                        capacity_spans_per_s=args.capacity,
+                        duration_s=args.duration, tick_s=args.tick,
+                        lag_s=args.feed_lag,
+                        window_s=args.window_seconds,
+                        baseline_windows=args.baseline_windows,
+                        z_threshold=args.threshold, buckets=buckets,
+                        lane_buckets=lane_buckets,
+                        max_backlog=args.max_backlog,
+                        score=not args.no_score,
+                        fuse=False if args.no_fuse else None,
+                        shards=args.shards, pipeline=args.pipeline,
+                        journal=args.feed_journal)
+            finally:
+                if endpoint is not None:
+                    endpoint.stop()
+            print(json.dumps(report.to_dict(), indent=2))
+            return 0
         mesh = None
         if args.devices:
             from anomod.parallel import make_mesh
@@ -1163,6 +1249,10 @@ def main(argv=None) -> int:
         if args.trace_out:
             from anomod.utils.tracing import Tracer
             tracer = Tracer("anomod-serve")
+        # the endpoint plane rides any serve run when ANOMOD_OBS_HTTP is
+        # on: pure registry reads, decisions byte-identical either way
+        from anomod.obs.http import maybe_serve
+        _endpoint = maybe_serve()
         _, report = run_power_law(
             n_tenants=args.tenants, n_services=args.services,
             capacity_spans_per_s=args.capacity, overload=args.overload,
@@ -1190,6 +1280,8 @@ def main(argv=None) -> int:
             # (the explicit CLI ask wins over the env default; the
             # --rca + --no-score combination already parser.error'd)
             rca=True if args.rca else (False if args.no_score else None))
+        if _endpoint is not None:
+            _endpoint.stop()
         if tracer is not None:
             from pathlib import Path as _P
             tracer.dump(_P(args.trace_out))
@@ -1560,13 +1652,31 @@ def main(argv=None) -> int:
                     kw[name] = val
             kw["flight"] = True
         _probe_backend(args)
-        from anomod.serve.engine import run_power_law
-        eng, rep = run_power_law(**kw)
+        if kw.pop("traffic", None) == "live_feed":
+            # a live-feed run replays through its WIRE journal (the
+            # response sequence is the ground truth), not by re-polling
+            if args.state is not None:
+                parser.error("--state applies to power-law journals; "
+                             "live-feed replays take the engine shape "
+                             "from the journal header")
+            from pathlib import Path as _P
+            feed_journal = kw.pop("feed_journal", "")
+            if not feed_journal or not _P(feed_journal).exists():
+                parser.error(
+                    "the run's wire journal is missing "
+                    f"({feed_journal or 'not recorded'}) — record live "
+                    "runs with ANOMOD_FEED_JOURNAL/--feed-journal to "
+                    "make them replayable")
+            from anomod.serve.feed import run_live_feed
+            eng, rep, _ = run_live_feed(replay=feed_journal, **kw)
+        else:
+            from anomod.serve.engine import run_power_law
+            eng, rep = run_power_law(**kw)
         doc = eng.flight_recorder.dump(args.out)
         print(json.dumps({
             "action": args.action, "out": args.out,
             "ticks": doc["n_recorded"], "dropped": doc["n_dropped"],
-            "seed": doc["header"]["run"]["seed"],
+            "seed": doc["header"]["run"].get("seed"),
             "shards": doc["header"]["engine"]["shards"],
             "serve_state": doc["header"]["engine"]["serve_state"],
             "digest_every": doc["header"]["digest_every"],

@@ -1078,6 +1078,87 @@ def _jit_cache_env() -> bool:
         not in ("0", "false", "off", "no", "")
 
 
+def _obs_http_env() -> bool:
+    """ANOMOD_OBS_HTTP: embedded /metrics endpoint plane
+    (anomod.obs.http).
+
+    Default OFF — serving HTTP from a benchmark process is opt-in.
+    When on, ``anomod serve`` starts a localhost-bound stdlib
+    ``http.server`` thread exposing ``/metrics`` (Prometheus text
+    exposition), ``/healthz`` and ``/flight``.  Scrapes are pure
+    registry reads, so every decision plane stays byte-identical
+    endpoint-on vs off.  Validated against the explicit token sets:
+    a typo must fail at config construction, not silently skip the
+    endpoint all night.
+    """
+    raw = _env("ANOMOD_OBS_HTTP", "0").strip().lower()
+    if raw in ("1", "on", "true", "yes"):
+        return True
+    if raw in ("0", "off", "false", "no", ""):
+        return False
+    raise ValueError(
+        f"ANOMOD_OBS_HTTP must be 0/off/false/no or "
+        f"1/on/true/yes, got {raw!r}")
+
+
+def _obs_http_port_env() -> int:
+    """ANOMOD_OBS_HTTP_PORT: TCP port for the embedded endpoint plane.
+
+    ``9464`` (the OpenMetrics convention neighborhood) by default; ``0``
+    asks the OS for an ephemeral port — the test/dogfood mode, where the
+    bound port is read back off the server object rather than assumed.
+    """
+    raw = _env("ANOMOD_OBS_HTTP_PORT", "9464")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_OBS_HTTP_PORT must be an integer port, got {raw!r}")
+    if not 0 <= n <= 65535:
+        raise ValueError(
+            f"ANOMOD_OBS_HTTP_PORT must be in [0, 65535], got {n}")
+    return n
+
+
+def _serve_feed_lag_s_env() -> float:
+    """ANOMOD_SERVE_FEED_LAG_S: live-feed wall->virtual lag budget in
+    seconds (anomod.serve.feed).
+
+    A sample collected at wall time ``w`` maps to virtual time
+    ``w - t0_wall + lag``; the budget keeps the feed's virtual arrival
+    times ahead of the poll that discovers them, so a tick never asks
+    for spans the pollers have not fetched yet.  Walls are measured,
+    never consulted for decisions — the bridge itself is recorded in
+    the wire journal so replay reuses the live run's anchor.
+    """
+    raw = _env("ANOMOD_SERVE_FEED_LAG_S", "2.0")
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_FEED_LAG_S must be a number, got {raw!r}")
+    if not 0 <= v <= 3600:
+        raise ValueError(
+            f"ANOMOD_SERVE_FEED_LAG_S must be in [0, 3600], got {v}")
+    return v
+
+
+def _feed_journal_env() -> Optional[Path]:
+    """ANOMOD_FEED_JOURNAL: live-feed wire-journal path.
+
+    When set, every HTTP response the live feed consumes is recorded in
+    sequence and published atomically to this path at the end of the
+    run (anomod.serve.feed.FeedJournal); ``anomod serve --live-replay``
+    re-serves it through a replay transport, reproducing the live run's
+    states/alerts/SLO/shed byte-for-byte with no network.  Unset (the
+    default) disables recording.
+    """
+    raw = _env("ANOMOD_FEED_JOURNAL", "")
+    if not raw or raw.lower() in _CACHE_OFF:
+        return None
+    return Path(raw).expanduser()
+
+
 def _serve_max_backlog_env() -> int:
     """ANOMOD_SERVE_MAX_BACKLOG: global admission backlog bound (spans) —
     the serving plane's backpressure/shed budget."""
@@ -1318,6 +1399,21 @@ class Config:
     # (anomod.obs.registry; oldest samples drop past it).
     obs_max_samples: int = dataclasses.field(
         default_factory=_obs_max_samples_env)
+    # ANOMOD_OBS_HTTP — embedded /metrics endpoint plane switch
+    # (anomod.obs.http; localhost-bound, off by default).
+    obs_http: bool = dataclasses.field(default_factory=_obs_http_env)
+    # ANOMOD_OBS_HTTP_PORT — endpoint-plane TCP port; 0 = OS-assigned
+    # ephemeral (anomod.obs.http).
+    obs_http_port: int = dataclasses.field(
+        default_factory=_obs_http_port_env)
+    # ANOMOD_SERVE_FEED_LAG_S — live-feed wall->virtual lag budget in
+    # seconds (anomod.serve.feed; walls measured, never decisive).
+    serve_feed_lag_s: float = dataclasses.field(
+        default_factory=_serve_feed_lag_s_env)
+    # ANOMOD_FEED_JOURNAL — live-feed wire-journal path, or unset/off to
+    # disable recording (anomod.serve.feed.FeedJournal).
+    feed_journal: Optional[Path] = dataclasses.field(
+        default_factory=_feed_journal_env)
 
     @property
     def sn_data(self) -> Path:

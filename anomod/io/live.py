@@ -62,6 +62,17 @@ class HttpTransport:
 
     def request_json(self, url: str, payload: Optional[dict] = None,
                      params: Optional[dict] = None):
+        return self._request(url, payload, params,
+                             lambda raw: json.loads(raw.decode()))
+
+    def request_text(self, url: str, params: Optional[dict] = None) -> str:
+        """GET -> decoded body text (the Prometheus exposition-format
+        scrape path; same retry/backoff policy as the JSON surface)."""
+        return self._request(url, None, params,
+                             lambda raw: raw.decode(errors="replace"))
+
+    def _request(self, url: str, payload: Optional[dict],
+                 params: Optional[dict], decode: Callable[[bytes], object]):
         if params:
             url = f"{url}?{urllib.parse.urlencode(params)}"
         last: Optional[Exception] = None
@@ -74,7 +85,9 @@ class HttpTransport:
                         url, data=json.dumps(payload).encode(),
                         headers={"Content-Type": "application/json"})
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                    return json.loads(r.read().decode())
+                    # decode INSIDE the try: a truncated/garbled body is
+                    # retried like any other transient wire fault
+                    return decode(r.read())
             except urllib.error.HTTPError as e:
                 if 400 <= e.code < 500:
                     # client errors (bad PromQL, malformed GraphQL) are
@@ -152,6 +165,26 @@ class PrometheusClient:
                 except (TypeError, ValueError):
                     continue
         return rows
+
+    def query_range_since(
+            self, query: str, since_s: float, until_s: float,
+            step: str = "15s",
+    ) -> Tuple[List[Tuple[float, float, Dict[str, str]]], float]:
+        """Watermark-tailed incremental poll for the live feed
+        (anomod.serve.feed).
+
+        Runs ``query_range(query, since_s, until_s)`` and keeps only the
+        rows STRICTLY past the ``since_s`` watermark, so back-to-back
+        polls never re-deliver a sample (query_range windows are
+        inclusive on both ends).  Returns ``(fresh_rows,
+        new_watermark)`` where the new watermark is the max delivered
+        timestamp (or ``since_s`` unchanged on an empty poll) — always
+        monotone."""
+        rows = self.query_range(query, since_s, until_s, step)
+        fresh = [(ts, val, labels) for ts, val, labels in rows
+                 if ts > since_s]
+        mark = max([since_s] + [ts for ts, _, _ in fresh])
+        return fresh, mark
 
     def write_query_csv(self, query: str, metric_name: str, out_dir: Path,
                         start_s: float, end_s: float,
@@ -273,6 +306,32 @@ class JaegerClient:
                     "start": int((now - lookback_ms / 1000.0) * 1e6),
                     "end": int(now * 1e6)})
         return list(doc.get("data") or [])
+
+    def traces_since(self, service: str, since_us: int, until_us: int,
+                     limit: int = 2000) -> Tuple[List[dict], int]:
+        """Watermark-tailed incremental poll for the live feed
+        (anomod.serve.feed).
+
+        Queries the explicit ``[since_us, until_us]`` window (epoch µs)
+        and keeps only traces whose LATEST span starts strictly past the
+        watermark — a trace is delivered once, on the poll that first
+        sees it complete up to that point.  Returns ``(fresh_traces,
+        new_watermark_us)``; the watermark is the max span startTime
+        delivered (unchanged on an empty poll) — always monotone."""
+        doc = self.transport.request_json(
+            f"{self.base_url}/api/traces",
+            params={"service": service, "limit": limit,
+                    "start": int(since_us), "end": int(until_us)})
+        fresh: List[dict] = []
+        mark = int(since_us)
+        for tr in doc.get("data") or []:
+            starts = [int(sp.get("startTime", 0))
+                      for sp in (tr.get("spans") or [])]
+            if not starts or max(starts) <= since_us:
+                continue
+            fresh.append(tr)
+            mark = max(mark, max(starts))
+        return fresh, mark
 
     def collect_all(self, out_path: Path, limit: int = 2000,
                     lookback_ms: int = 3_600_000) -> CollectReport:

@@ -115,8 +115,16 @@ def to_metric_batch(registry: Registry):
     into ``MultimodalDetector.push_metrics`` with correct per-service
     attribution — no re-derivation needed on the direct (non-CSV) path.
     """
+    return rows_to_metric_batch(registry.journal())
+
+
+def rows_to_metric_batch(rows):
+    """Journal-shaped rows ``(t_s, sample_name, labels_str, value)`` ->
+    ``MetricBatch`` — the row-level core of :func:`to_metric_batch`,
+    shared with the live feed (anomod.serve.feed), whose rows come off a
+    scraped ``/metrics`` endpoint or a Prometheus ``query_range`` poll
+    rather than a local registry."""
     from anomod.schemas import MetricBatch
-    rows = registry.journal()
     metric_names: Dict[str, int] = {}
     series_keys: Dict[str, int] = {}
     services: Dict[str, int] = {}

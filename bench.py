@@ -379,6 +379,34 @@ def serve_main(probe_fresh=False) -> int:
             set_registry(Registry(enabled=True))
             from anomod.obs.census import fleet_probe
             census_sweep = fleet_probe()
+            # the LIVE-FEED leg (ISSUE-18): the closed telemetry loop —
+            # an embedded /metrics endpoint serving THIS process's
+            # registry, scraped by LiveFeed into the serve tick,
+            # wire-journaled, then replayed through ReplayTransport.
+            # Live-vs-replay byte parity is the --from-live
+            # reproducibility pin.  Own registry so the loop scrapes a
+            # stable, self-generated fleet.
+            import tempfile as _tempfile
+
+            from anomod.obs.http import ObsHttpServer
+            from anomod.serve.feed import run_live_feed
+            _feed_reg = Registry(enabled=True)
+            set_registry(_feed_reg)
+            _feed_kw = dict(capacity_spans_per_s=2000.0,
+                            duration_s=10.0, tick_s=1.0, window_s=2.0,
+                            baseline_windows=2, buckets=(64,),
+                            n_windows=16, flight=True,
+                            flight_digest_every=2)
+            with _tempfile.TemporaryDirectory() as _ftmp, \
+                    ObsHttpServer(port=0) as _fsrv:
+                _fjournal = os.path.join(_ftmp, "feed_wire.json")
+                eng_lf, rep_lf, feed_lf = run_live_feed(
+                    scrape_url=f"{_fsrv.url}/metrics", n_tenants=4,
+                    n_services=4, journal=_fjournal, **_feed_kw)
+                _feed_journal_entries = len(feed_lf.journal_entries())
+                _fsrv.stop()
+                eng_lfr, rep_lfr, _ = run_live_feed(
+                    replay=_fjournal, **_feed_kw)
         finally:
             set_registry(prev_reg)
         set_registry(reg)
@@ -861,6 +889,41 @@ def serve_main(probe_fresh=False) -> int:
                 "shed_identical":
                     rep_cen.shed_fraction == rep.shed_fraction,
                 "journal_canonical_identical": _cn_journal_ok,
+            },
+        }
+        # live-feed loop (ISSUE-18): closed-loop self-scrape throughput,
+        # the feed-lag histogram, and the live-vs-replay parity bits —
+        # all five true is the --from-live reproducibility pin the
+        # committed capture carries
+        _lf_alerts_same, _lf_states_same = _engines_identical(
+            eng_lf, eng_lfr)
+        _lf_journal_ok = None
+        if eng_lf.flight_recorder is not None \
+                and eng_lfr.flight_recorder is not None:
+            _lf_journal_ok = _diff_journals(
+                eng_lf.flight_recorder.journal(),
+                eng_lfr.flight_recorder.journal()) is None
+        _lf_lag = next((m for m in _feed_reg.metrics()
+                        if m.name == "anomod_feed_lag_s"), None)
+        out["live_feed"] = {
+            "spans_per_s": rep_lf.sustained_spans_per_sec,
+            "served_spans": rep_lf.served_spans,
+            "n_polls": feed_lf.n_polls,
+            "n_samples": feed_lf.n_samples,
+            "gaps": feed_lf.n_gaps,
+            "feed_lag": {
+                "p50": None if _lf_lag is None else _lf_lag.quantile(0.5),
+                "p99": None if _lf_lag is None else _lf_lag.quantile(0.99),
+            },
+            "journal_entries": _feed_journal_entries,
+            "parity": {
+                "alerts_identical": _lf_alerts_same,
+                "states_identical": _lf_states_same,
+                "p99_identical": rep_lfr.latency.get("p99_latency_s")
+                == rep_lf.latency.get("p99_latency_s"),
+                "shed_identical":
+                    rep_lfr.shed_fraction == rep_lf.shed_fraction,
+                "journal_canonical_identical": _lf_journal_ok,
             },
         }
         # enabled-vs-off telemetry overhead on the same seed (acceptance

@@ -106,6 +106,13 @@ and the table in docs/BENCHMARKS.md mirrors them):
   shed or the canonical flight journal, or never actually deferred a
   tick — the async engine broke the byte-parity contract and an
   async capture's decision planes could not be trusted.
+- ``EXIT_FEED_DIVERGENCE`` (14): the live-feed loop smoke (an
+  in-process ``/metrics`` endpoint scraped by ``LiveFeed``, the wire
+  journal replayed through ``ReplayTransport``, live vs replay
+  compared on states, alerts, SLO, shed and the canonical flight
+  journal) diverged, or the live leg consumed nothing — a
+  ``--from-live`` capture could not be reproduced from its wire
+  journal.
 
 Always prints one JSON line describing the decision (plus the contract
 gate's line).  ``--traces`` must match the bench invocation's span
@@ -136,6 +143,7 @@ EXIT_POLICY_DIVERGENCE = 10
 EXIT_PERF_DIVERGENCE = 11
 EXIT_CENSUS_DIVERGENCE = 12
 EXIT_ASYNC_DIVERGENCE = 13
+EXIT_FEED_DIVERGENCE = 14
 
 
 def _shard_fanout_smoke() -> dict:
@@ -387,6 +395,76 @@ def _async_commit_smoke():
         return info, {"tick": -1, "plane": "slo/shed"}
     return info, diff_journals(eng_sync.flight_recorder.journal(),
                                eng_async.flight_recorder.journal())
+
+
+def _feed_smoke():
+    """The live-feed loop smoke (<5 s): the serve tick fed from a REAL
+    socket.  An in-process ``/metrics`` endpoint (anomod.obs.http)
+    serves this process's own registry; a :class:`LiveFeed` scrapes it
+    through the recording transport while the engine runs; the wire
+    journal is then replayed through :class:`ReplayTransport` and the
+    two runs must be byte-identical on tenant states, alerts, SLO,
+    shed and the canonical flight journal — the ``--from-live``
+    reproducibility contract.  A live leg that consumed nothing is a
+    precondition failure (parity would pass vacuously).  Returns
+    ``(info, divergence_or_None)``."""
+    import tempfile
+
+    import numpy as np
+
+    from anomod.obs.flight import diff_journals
+    from anomod.obs.http import ObsHttpServer
+    from anomod.obs.registry import Registry, set_registry
+    from anomod.serve.feed import run_live_feed
+
+    kw = dict(n_tenants=4, n_services=4, capacity_spans_per_s=2000.0,
+              duration_s=8.0, tick_s=1.0, window_s=2.0,
+              baseline_windows=2, buckets=(64,), n_windows=16,
+              flight=True, flight_digest_every=2)
+    prev = set_registry(Registry(enabled=True))
+    try:
+        with tempfile.TemporaryDirectory() as tmp, \
+                ObsHttpServer(port=0) as srv:
+            jpath = Path(tmp) / "feed_wire.json"
+            eng_live, rep_live, feed = run_live_feed(
+                scrape_url=f"{srv.url}/metrics", journal=jpath, **kw)
+            srv.stop()
+            eng_rep, rep_rep, _ = run_live_feed(
+                replay=jpath,
+                **{k: v for k, v in kw.items()
+                   if k not in ("n_tenants", "n_services")})
+    finally:
+        set_registry(prev)
+    info = {"polls": feed.n_polls, "samples": feed.n_samples,
+            "spans": feed.n_spans, "gaps": feed.n_gaps,
+            "served_spans": rep_live.served_spans,
+            "p99_identical": rep_rep.latency.get("p99_latency_s")
+            == rep_live.latency.get("p99_latency_s"),
+            "shed_identical":
+                rep_rep.shed_fraction == rep_live.shed_fraction}
+    if feed.n_polls < 1 or feed.n_samples < 1 \
+            or rep_live.served_spans < 1:
+        raise RuntimeError(
+            f"live-feed smoke consumed nothing: {info}")
+    tids = sorted(set(eng_live._tenant_replay)
+                  | set(eng_rep._tenant_replay))
+    states_same = all(
+        t in eng_live._tenant_replay and t in eng_rep._tenant_replay
+        and np.array_equal(
+            np.asarray(eng_live._tenant_replay[t].state.agg),
+            np.asarray(eng_rep._tenant_replay[t].state.agg))
+        and np.array_equal(
+            np.asarray(eng_live._tenant_replay[t].state.hist),
+            np.asarray(eng_rep._tenant_replay[t].state.hist))
+        for t in tids)
+    alerts_same = all(eng_live.alerts_for(t) == eng_rep.alerts_for(t)
+                      for t in sorted(set(eng_live._tenant_det)
+                                      | set(eng_rep._tenant_det)))
+    if not (states_same and alerts_same
+            and info["p99_identical"] and info["shed_identical"]):
+        return info, {"tick": -1, "plane": "states/alerts/slo/shed"}
+    return info, diff_journals(eng_live.flight_recorder.journal(),
+                               eng_rep.flight_recorder.journal())
 
 
 def _perf_smoke():
@@ -743,6 +821,22 @@ def check_serve() -> int:
                   "a scored byte; do not capture with "
                   "ANOMOD_SERVE_ASYNC_COMMIT on", file=sys.stderr)
             return EXIT_ASYNC_DIVERGENCE
+        # the live-feed loop smoke: endpoint → LiveFeed → wire-journal
+        # replay must be a closed deterministic loop — its own exit
+        # code so a driver can tell "the live adapter broke replay"
+        # from every other divergence
+        feed_info, feed_div = _feed_smoke()
+        out["feed_smoke"] = feed_info
+        if feed_div is not None:
+            out["status"] = "feed-divergence"
+            out["divergence"] = feed_div
+            print(json.dumps(out))
+            print(f"pre_bench_check: live-feed smoke diverged at tick "
+                  f"{feed_div['tick']} in the {feed_div['plane']} "
+                  "plane — a live run and its wire-journal replay "
+                  "disagree; do not trust --from-live captures",
+                  file=sys.stderr)
+            return EXIT_FEED_DIVERGENCE
         print(json.dumps(out))
         return EXIT_READY
     except Exception as e:

@@ -357,6 +357,28 @@ def test_bench_serve_mode_contract(tmp_path):
     assert par["p99_identical"] is True
     assert par["shed_identical"] is True
     assert par["journal_canonical_identical"] is True
+    # live-feed block (ISSUE-18): the closed telemetry loop — the
+    # self-scrape leg's throughput/poll counters, the feed-lag
+    # histogram, and the five live-vs-replay parity bits (the
+    # --from-live reproducibility pin the capture carries)
+    lf = out["live_feed"]
+    assert lf["spans_per_s"] > 0
+    assert lf["served_spans"] > 0
+    assert lf["n_polls"] >= 1
+    assert lf["n_samples"] >= 1
+    assert lf["gaps"] >= 0
+    assert lf["journal_entries"] >= lf["n_polls"]
+    assert set(lf["feed_lag"]) == {"p50", "p99"}
+    # the scrape path observes the effective ingest lag per poll, so a
+    # consuming leg always populates the histogram
+    assert lf["feed_lag"]["p50"] is not None and lf["feed_lag"]["p50"] >= 0
+    assert lf["feed_lag"]["p99"] is not None and lf["feed_lag"]["p99"] >= 0
+    par = lf["parity"]
+    assert par["alerts_identical"] is True
+    assert par["states_identical"] is True
+    assert par["p99_identical"] is True
+    assert par["shed_identical"] is True
+    assert par["journal_canonical_identical"] is True
     # a census self-diff of the finished capture must be clean (the
     # tiering before/after judge's identity case)
     from anomod.obs.census import diff_census
@@ -413,7 +435,7 @@ def test_pre_bench_exit_codes_named_and_unique():
         "EXIT_FLIGHT_DIVERGENCE": 7, "EXIT_RECOVERY_DIVERGENCE": 8,
         "EXIT_LINT": 9, "EXIT_POLICY_DIVERGENCE": 10,
         "EXIT_PERF_DIVERGENCE": 11, "EXIT_CENSUS_DIVERGENCE": 12,
-        "EXIT_ASYNC_DIVERGENCE": 13,
+        "EXIT_ASYNC_DIVERGENCE": 13, "EXIT_FEED_DIVERGENCE": 14,
     }
     # every literal return in the gate's source goes through a constant
     src = (Path(__file__).parent.parent / "scripts"

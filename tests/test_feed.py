@@ -1,0 +1,391 @@
+"""Live telemetry loop tests (ISSUE-18): the embedded /metrics endpoint
+plane driven over a REAL socket, the LiveFeed's watermark-tailed polling
+against stub servers, and the wire-journal record→replay byte-parity pin
+— a live run and its journal replay must produce identical decision
+planes and equal canonical flight journals.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from anomod.io.live import (HttpTransport, JaegerClient, PrometheusClient,
+                            TransportError)
+from anomod.obs.export import to_prometheus_text
+from anomod.obs.http import PROM_CONTENT_TYPE, ObsHttpServer
+from anomod.obs.registry import Registry, render_labels, set_registry
+from anomod.serve.feed import (LiveFeed, RecordingTransport,
+                               ReplayTransport, load_feed_journal,
+                               parse_prometheus_text, run_live_feed)
+
+
+class JsonStub:
+    """The test_live.py stub: ``route(method, path, params, body) ->
+    (status, doc)``; records every request for assertions."""
+
+    def __init__(self, route):
+        stub = self
+        stub.requests = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def _serve(self, method):
+                import urllib.parse
+                parsed = urllib.parse.urlparse(self.path)
+                params = {k: v[0] for k, v in
+                          urllib.parse.parse_qs(parsed.query).items()}
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length)) if length \
+                    else None
+                stub.requests.append((method, parsed.path, params, body))
+                status, doc = route(method, parsed.path, params, body)
+                payload = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._serve("GET")
+
+            def do_POST(self):
+                self._serve("POST")
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.base_url = f"http://127.0.0.1:{self.server.server_port}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def stub_factory():
+    stubs = []
+
+    def make(route):
+        s = JsonStub(route)
+        stubs.append(s)
+        return s
+
+    yield make
+    for s in stubs:
+        s.close()
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = Registry(enabled=True)
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+def _fast_transport():
+    slept = []
+    return HttpTransport(timeout=5.0, sleep=slept.append), slept
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5.0) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+# ---------------------------------------------------------------------------
+# Config knobs
+# ---------------------------------------------------------------------------
+
+def test_feed_knob_validation(monkeypatch, tmp_path):
+    from anomod.config import Config
+    monkeypatch.setenv("ANOMOD_OBS_HTTP", "on")
+    monkeypatch.setenv("ANOMOD_OBS_HTTP_PORT", "0")
+    monkeypatch.setenv("ANOMOD_SERVE_FEED_LAG_S", "3.5")
+    monkeypatch.setenv("ANOMOD_FEED_JOURNAL", str(tmp_path / "w.json"))
+    cfg = Config()
+    assert cfg.obs_http is True
+    assert cfg.obs_http_port == 0
+    assert cfg.serve_feed_lag_s == 3.5
+    assert cfg.feed_journal == tmp_path / "w.json"
+
+    monkeypatch.setenv("ANOMOD_OBS_HTTP", "maybe")
+    with pytest.raises(ValueError, match="ANOMOD_OBS_HTTP must be"):
+        Config()
+    monkeypatch.setenv("ANOMOD_OBS_HTTP", "0")
+    monkeypatch.setenv("ANOMOD_OBS_HTTP_PORT", "http")
+    with pytest.raises(ValueError, match="ANOMOD_OBS_HTTP_PORT"):
+        Config()
+    monkeypatch.setenv("ANOMOD_OBS_HTTP_PORT", "70000")
+    with pytest.raises(ValueError, match=r"\[0, 65535\]"):
+        Config()
+    monkeypatch.setenv("ANOMOD_OBS_HTTP_PORT", "9464")
+    monkeypatch.setenv("ANOMOD_SERVE_FEED_LAG_S", "slow")
+    with pytest.raises(ValueError, match="ANOMOD_SERVE_FEED_LAG_S"):
+        Config()
+    monkeypatch.setenv("ANOMOD_SERVE_FEED_LAG_S", "-1")
+    with pytest.raises(ValueError, match=r"\[0, 3600\]"):
+        Config()
+    monkeypatch.setenv("ANOMOD_SERVE_FEED_LAG_S", "2.0")
+    monkeypatch.setenv("ANOMOD_FEED_JOURNAL", "off")
+    assert Config().feed_journal is None
+
+
+# ---------------------------------------------------------------------------
+# The endpoint plane over a real socket
+# ---------------------------------------------------------------------------
+
+def test_metrics_endpoint_matches_registry(fresh_registry):
+    reg = fresh_registry
+    reg.counter("anomod_serve_ticks_total").inc(7)
+    reg.histogram("anomod_serve_tick_wall_s").observe(0.25)
+    with ObsHttpServer(registry=reg, port=0) as srv:
+        status, headers, body = _get(f"{srv.url}/metrics")
+        assert status == 200
+        assert headers["Content-Type"] == PROM_CONTENT_TYPE
+        assert "version=0.0.4" in headers["Content-Type"]
+        assert body.decode() == to_prometheus_text(reg)
+        # HEAD: the scrape-probe verb — same headers, empty body
+        req = urllib.request.Request(f"{srv.url}/metrics", method="HEAD")
+        with urllib.request.urlopen(req, timeout=5.0) as r:
+            assert r.status == 200
+            assert int(r.headers["Content-Length"]) == len(body)
+            assert r.read() == b""
+        # /healthz liveness
+        status, _, hz = _get(f"{srv.url}/healthz")
+        doc = json.loads(hz)
+        assert status == 200 and doc["status"] == "ok"
+        assert doc["registry"]["enabled"] is True
+        assert doc["registry"]["n_metrics"] >= 2
+        # unknown route: structured 404 listing what exists
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{srv.url}/nope")
+        assert ei.value.code == 404
+        assert "/metrics" in json.loads(ei.value.read())["routes"]
+        # localhost-bound: the server never listens on other interfaces
+        assert srv._httpd.server_address[0] == "127.0.0.1"
+
+
+def test_adversarial_label_scrape_reparses_to_registry(fresh_registry):
+    """The acceptance pin's read half: labels with every exposition
+    metacharacter survive endpoint → wire → parse back to the
+    registry's canonical unescaped rendering, and the re-parsed rows
+    drive spans_from_metrics."""
+    reg = fresh_registry
+    nasty = 'multi\nline "quoted" back\\slash'
+    reg.gauge("anomod_serve_backlog_spans", pod=nasty,
+              plain="ok").set(42.5)
+    reg.counter("anomod_ingest_rows_total").inc(3)
+    with ObsHttpServer(registry=reg, port=0) as srv:
+        _, _, body = _get(f"{srv.url}/metrics")
+    rows = parse_prometheus_text(body.decode())
+    want = {(m.name, render_labels(m.labels), m.value)
+            for m in reg.metrics() if m.kind != "histogram"}
+    assert want <= set(rows)
+    # and the scraped rows feed the metric→span synthesis untouched
+    from anomod.obs.export import rows_to_metric_batch
+    from anomod.obs.selfscrape import spans_from_metrics
+    stamped = [(float(i), name, lab, val)
+               for i, (name, lab, val) in enumerate(rows * 3)]
+    batch = rows_to_metric_batch(stamped)
+    assert batch.n_samples == len(stamped)
+    spans_from_metrics(batch)  # must not raise on adversarial labels
+
+
+# ---------------------------------------------------------------------------
+# Watermark-tailed incremental polling
+# ---------------------------------------------------------------------------
+
+def test_prometheus_since_watermark_monotone_no_redelivery(stub_factory):
+    t0 = 1_700_000_000
+
+    def route(method, path, params, body):
+        return 200, {"status": "success", "data": {"resultType": "matrix",
+                     "result": [{"metric": {"__name__": "up", "pod": "a"},
+                                 "values": [[t0 + 15 * i, str(i)]
+                                            for i in range(4)]}]}}
+
+    stub = stub_factory(route)
+    tp, _ = _fast_transport()
+    client = PrometheusClient(stub.base_url, transport=tp)
+    fresh, mark = client.query_range_since("up", t0 + 10, t0 + 60)
+    assert [ts for ts, _, _ in fresh] == [t0 + 15, t0 + 30, t0 + 45]
+    assert mark == t0 + 45                      # max delivered ts
+    fresh2, mark2 = client.query_range_since("up", mark, t0 + 60)
+    assert fresh2 == []                          # no redelivery
+    assert mark2 == mark                         # monotone
+
+
+def test_jaeger_since_watermark_monotone_no_redelivery(stub_factory):
+    t0_us = 1_700_000_000_000_000
+
+    def route(method, path, params, body):
+        assert path == "/api/traces"
+        assert int(params["start"]) >= 0
+        return 200, {"data": [
+            {"spans": [{"startTime": t0_us + 1_000_000, "duration": 50,
+                        "operationName": "op"}]},
+            {"spans": [{"startTime": t0_us + 2_000_000, "duration": 60,
+                        "operationName": "op"}]},
+        ]}
+
+    stub = stub_factory(route)
+    tp, _ = _fast_transport()
+    client = JaegerClient(stub.base_url, transport=tp)
+    fresh, mark = client.traces_since("svc", t0_us + 1_500_000,
+                                      t0_us + 9_000_000)
+    assert len(fresh) == 1                       # only the newer trace
+    assert mark == t0_us + 2_000_000
+    fresh2, mark2 = client.traces_since("svc", mark, t0_us + 9_000_000)
+    assert fresh2 == [] and mark2 == mark
+
+
+def test_feed_transport_retry_journals_final_response_once(stub_factory):
+    calls = {"n": 0}
+
+    def route(method, path, params, body):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return 500, {"err": "boom"}
+        return 200, {"status": "success",
+                     "data": {"resultType": "matrix", "result": []}}
+
+    stub = stub_factory(route)
+    inner, slept = _fast_transport()
+    rec = RecordingTransport(inner=inner)
+    PrometheusClient(stub.base_url, transport=rec).query_range_since(
+        "up", 0, 60)
+    assert slept == [3.0]                        # the reference schedule
+    assert len(stub.requests) == 2               # retried on the wire...
+    assert len(rec.entries) == 1                 # ...journaled ONCE
+    assert rec.entries[0]["kind"] == "json"
+
+
+def test_gap_fill_clamps_stragglers_to_tick_edge(stub_factory,
+                                                 fresh_registry):
+    t0 = 1_700_000_000.0
+
+    def route(method, path, params, body):
+        return 200, {"status": "success", "data": {"resultType": "matrix",
+                     "result": [{"metric": {"__name__": "up"},
+                                 "values": [[t0 - 1.5, "1"]]}]}}
+
+    stub = stub_factory(route)
+    feed = LiveFeed(prom_url=stub.base_url, prom_queries=("up",),
+                    n_tenants=2, n_services=2, lag_s=2.0, t0_wall_s=t0)
+    # the row bridges to virtual 0.5s — behind a tick opening at 5.0s,
+    # so it clamps forward to the open edge and counts a gap
+    feed.arrivals(5.0, 6.0)
+    assert feed.n_gaps == 1
+    assert [r[0] for r in feed._mrows] == [5.0]  # clamped, not dropped
+
+
+# ---------------------------------------------------------------------------
+# The wire journal: record → replay byte parity (the acceptance pin)
+# ---------------------------------------------------------------------------
+
+def test_replay_transport_fails_loud():
+    rt = ReplayTransport([{"kind": "text", "path": "/metrics",
+                           "params": {}, "payload": None, "body": "x 1\n"}])
+    with pytest.raises(TransportError, match="divergence"):
+        rt.request_json("http://h/other")
+    rt2 = ReplayTransport([])
+    with pytest.raises(TransportError, match="exhausted"):
+        rt2.request_text("http://h/metrics")
+
+
+def test_load_feed_journal_refuses_foreign_docs(tmp_path):
+    p = tmp_path / "not_feed.json"
+    p.write_text(json.dumps({"flight_format": 1}))
+    with pytest.raises(ValueError, match="feed wire journal"):
+        load_feed_journal(p)
+
+
+def _dogfood_kw():
+    return dict(capacity_spans_per_s=2000.0, duration_s=6.0, tick_s=1.0,
+                window_s=2.0, baseline_windows=2, buckets=(64,),
+                n_windows=16, flight=True, flight_digest_every=2)
+
+
+def test_live_vs_replay_byte_parity(fresh_registry, tmp_path):
+    """THE acceptance pin: the dogfood closed loop (the framework
+    scraping its own /metrics) recorded and replayed must agree on
+    states, alerts, SLO, shed and the canonical flight journal."""
+    from anomod.obs.flight import diff_journals
+    jpath = tmp_path / "wire.json"
+    with ObsHttpServer(port=0) as srv:
+        eng_a, rep_a, feed = run_live_feed(
+            scrape_url=f"{srv.url}/metrics", n_tenants=4, n_services=4,
+            journal=jpath, **_dogfood_kw())
+    assert jpath.exists()
+    assert feed.n_polls >= 1 and rep_a.served_spans > 0
+    doc = load_feed_journal(jpath)
+    assert doc["header"]["n_tenants"] == 4
+    assert len(doc["entries"]) == feed.n_polls
+    eng_b, rep_b, feed_b = run_live_feed(replay=jpath, **_dogfood_kw())
+    assert isinstance(feed_b.transport, ReplayTransport)
+    assert feed_b.transport.n_served == len(doc["entries"])
+    assert rep_b.served_spans == rep_a.served_spans
+    assert rep_b.shed_fraction == rep_a.shed_fraction
+    assert rep_b.latency == rep_a.latency
+    for t in sorted(set(eng_a._tenant_replay) | set(eng_b._tenant_replay)):
+        np.testing.assert_array_equal(
+            np.asarray(eng_a._tenant_replay[t].state.agg),
+            np.asarray(eng_b._tenant_replay[t].state.agg))
+        np.testing.assert_array_equal(
+            np.asarray(eng_a._tenant_replay[t].state.hist),
+            np.asarray(eng_b._tenant_replay[t].state.hist))
+    for t in sorted(set(eng_a._tenant_det) | set(eng_b._tenant_det)):
+        assert eng_a.alerts_for(t) == eng_b.alerts_for(t)
+    assert diff_journals(eng_a.flight_recorder.journal(),
+                         eng_b.flight_recorder.journal()) is None
+    assert eng_a.flight_recorder.canonical_bytes() \
+        == eng_b.flight_recorder.canonical_bytes()
+    # the replay header sizes the fleet even with no explicit knobs
+    assert feed_b.n_tenants == 4 and len(feed_b.services) == 4
+
+
+@pytest.mark.slow
+def test_endpoint_on_vs_off_read_side_parity(fresh_registry):
+    """A scraped endpoint never moves a decision byte: the same seeded
+    run with the endpoint plane up (and scraped mid-run) matches the
+    endpoint-less run on the canonical flight journal."""
+    from anomod.serve.engine import run_power_law
+    kw = dict(n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+              overload=2.0, duration_s=10, tick_s=1.0, seed=5,
+              window_s=5.0, baseline_windows=2, fault_tenants=0,
+              buckets=(64,), n_windows=16, flight=True,
+              flight_digest_every=2)
+    eng_off, rep_off = run_power_law(**kw)
+    with ObsHttpServer(port=0) as srv:
+        stop = threading.Event()
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    _get(f"{srv.url}/metrics")
+                    _get(f"{srv.url}/healthz")
+                except Exception:
+                    pass
+                stop.wait(0.02)
+
+        t = threading.Thread(target=scraper, daemon=True)
+        t.start()
+        try:
+            eng_on, rep_on = run_power_law(**kw)
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+    assert rep_on.shed_fraction == rep_off.shed_fraction
+    assert rep_on.latency == rep_off.latency
+    assert eng_on.flight_recorder.canonical_bytes() \
+        == eng_off.flight_recorder.canonical_bytes()
