@@ -1671,6 +1671,11 @@ def main(argv=None) -> int:
             eng, rep, _ = run_live_feed(replay=feed_journal, **kw)
         else:
             from anomod.serve.engine import run_power_law
+            # pre-tiering journals (recorded before the state-tiering
+            # PR) carry no tier geometry: replay them tiering-OFF, never
+            # under the replaying process's env knobs — env drift must
+            # not masquerade as plane divergence
+            kw.setdefault("tier_hot", 0)
             eng, rep = run_power_law(**kw)
         doc = eng.flight_recorder.dump(args.out)
         print(json.dumps({

@@ -1024,8 +1024,10 @@ def _census_sweep_env() -> tuple:
 
 def _census_coldest_k_env() -> int:
     """ANOMOD_CENSUS_COLDEST_K: coldest-K eviction-candidate preview
-    length per census tick (observed-only; the future LRU demotion
-    policy's input)."""
+    length per census tick — since the tiering plane landed this is
+    ALSO the demotion policy's candidate-batch size (one ordering,
+    :meth:`anomod.obs.census.CensusTracker.coldest_candidates`, shared
+    by the preview and the policy so they can never disagree)."""
     raw = _env("ANOMOD_CENSUS_COLDEST_K", "8")
     try:
         n = int(raw)
@@ -1036,6 +1038,97 @@ def _census_coldest_k_env() -> int:
     if not 1 <= n <= 4096:
         raise ValueError(
             f"ANOMOD_CENSUS_COLDEST_K must be in [1, 4096], got {n}")
+    return n
+
+
+def _serve_tier_hot_env() -> int:
+    """ANOMOD_SERVE_TIER_HOT: tenant-state tiering hot capacity — the
+    max tenants resident in the device ``TenantStatePool`` before the
+    decay-driven demotion plane starts spilling the coldest to the host
+    warm tier (anomod.serve.tiering).  ``0`` (the default) disables
+    tiering entirely: every ever-served tenant stays pool-resident, the
+    pre-tiering engine byte-for-byte."""
+    raw = _env("ANOMOD_SERVE_TIER_HOT", "0")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_TIER_HOT must be a non-negative integer "
+            f"(0 = tiering off), got {raw!r}")
+    if n < 0:
+        raise ValueError(
+            f"ANOMOD_SERVE_TIER_HOT must be >= 0, got {n}")
+    return n
+
+
+def _serve_tier_demote_after_env() -> int:
+    """ANOMOD_SERVE_TIER_DEMOTE_AFTER: idle ticks (since a tenant's
+    last served batch, the census ``last_served`` signal) before a
+    pool-resident tenant is eligible for demotion.  The decay knob of
+    the demotion plane — small values demote aggressively, large ones
+    keep bursty tenants hot across their gaps."""
+    raw = _env("ANOMOD_SERVE_TIER_DEMOTE_AFTER", "8")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_TIER_DEMOTE_AFTER must be a positive "
+            f"integer (idle ticks), got {raw!r}")
+    if n < 1:
+        raise ValueError(
+            f"ANOMOD_SERVE_TIER_DEMOTE_AFTER must be >= 1, got {n}")
+    return n
+
+
+def _serve_tier_warm_bytes_env() -> int:
+    """ANOMOD_SERVE_TIER_WARM_BYTES: host warm-tier state-bytes budget.
+    Past it the warm tier spills its coldest entries' state arrays to
+    the content-addressed disk cold tier — which only acts when
+    ``ANOMOD_SERVE_TIER_COLD_DIR`` is set; without a cold dir the warm
+    tier is terminal and the budget is advisory (documented in
+    SERVING.md, never a silent data drop)."""
+    raw = _env("ANOMOD_SERVE_TIER_WARM_BYTES", str(64 * 1024 * 1024))
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_TIER_WARM_BYTES must be a non-negative "
+            f"integer (bytes), got {raw!r}")
+    if n < 0:
+        raise ValueError(
+            f"ANOMOD_SERVE_TIER_WARM_BYTES must be >= 0, got {n}")
+    return n
+
+
+def _serve_tier_cold_dir_env() -> Optional[Path]:
+    """ANOMOD_SERVE_TIER_COLD_DIR: content-addressed disk cold-tier
+    root for demoted tenant state (anomod.serve.tiering; the
+    io/cache.py atomic tmp-rename publish idiom).  Unset or
+    "0"/"off"/"none" disables the cold tier — the warm tier is then
+    terminal regardless of its bytes budget."""
+    raw = _env("ANOMOD_SERVE_TIER_COLD_DIR", "")
+    if not raw or raw.lower() in _CACHE_OFF:
+        return None
+    return Path(raw).expanduser()
+
+
+def _serve_tier_prefetch_env() -> int:
+    """ANOMOD_SERVE_TIER_PREFETCH: cold-tier prefetch lane depth — max
+    concurrent disk fetches issued at offer time so the read overlaps
+    the tick's admission/drain/SLO phases (the PR-16 deferred-commit
+    overlap idiom).  Promotion from cold always defers exactly one tick
+    (a counted, journaled ``tier_miss``) so the hot loop never blocks
+    on disk and the deferral count stays seed-deterministic."""
+    raw = _env("ANOMOD_SERVE_TIER_PREFETCH", "4")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_TIER_PREFETCH must be a positive integer, "
+            f"got {raw!r}")
+    if not 1 <= n <= 256:
+        raise ValueError(
+            f"ANOMOD_SERVE_TIER_PREFETCH must be in [1, 256], got {n}")
     return n
 
 
@@ -1381,6 +1474,26 @@ class Config:
     # length per census tick.
     census_coldest_k: int = dataclasses.field(
         default_factory=_census_coldest_k_env)
+    # ANOMOD_SERVE_TIER_HOT — tenant-state tiering hot capacity in
+    # tenants; 0 = tiering off (anomod.serve.tiering).
+    serve_tier_hot: int = dataclasses.field(
+        default_factory=_serve_tier_hot_env)
+    # ANOMOD_SERVE_TIER_DEMOTE_AFTER — idle ticks before a resident
+    # tenant is demotion-eligible (the census last-served decay signal).
+    serve_tier_demote_after: int = dataclasses.field(
+        default_factory=_serve_tier_demote_after_env)
+    # ANOMOD_SERVE_TIER_WARM_BYTES — host warm-tier state-bytes budget;
+    # past it the coldest warm entries spill to the disk cold tier.
+    serve_tier_warm_bytes: int = dataclasses.field(
+        default_factory=_serve_tier_warm_bytes_env)
+    # ANOMOD_SERVE_TIER_COLD_DIR — content-addressed disk cold-tier
+    # root (io/cache atomic publish idiom); unset/off = no cold tier.
+    serve_tier_cold_dir: Optional[Path] = dataclasses.field(
+        default_factory=_serve_tier_cold_dir_env)
+    # ANOMOD_SERVE_TIER_PREFETCH — cold-tier prefetch lane depth (max
+    # concurrent disk fetches overlapping the admission phases).
+    serve_tier_prefetch: int = dataclasses.field(
+        default_factory=_serve_tier_prefetch_env)
     # ANOMOD_NATIVE — C++ native runtime switch: auto (use when the .so
     # loads), on (required, fail loud with the build reason), off
     # (pure-Python paths; anomod.io.native).

@@ -46,8 +46,9 @@ journal — byte-identical; pinned in tests/test_census.py):
   rank-frequency skew estimate (:func:`fit_zipf` over cumulative
   served spans — the power-law design point, PAPERS.md arXiv
   1312.3020), the resident-vs-registered occupancy ratio, and a
-  coldest-K eviction-candidate preview — observed-only today, and
-  exactly the input the future LRU demotion policy will consume.
+  coldest-K eviction-candidate preview — promoted from observed-only
+  to the tiering demotion policy's actual input (one shared ordering,
+  :meth:`CensusTracker.coldest_candidates`; preview schema unchanged).
   Everything here derives from coordinator-side admission decisions,
   so the hot-set doc is CANONICAL: identical across shard counts,
   pipeline depths, residencies and elastic scaling episodes.
@@ -85,7 +86,7 @@ CENSUS_FORMAT = 1
 #: the census plane names, in the (shard, plane) drain order's plane
 #: axis — one row per (shard, plane) per census tick
 CENSUS_PLANES = ("admission", "flight", "perf", "pool", "rca",
-                 "scratch", "slo")
+                 "scratch", "slo", "tier")
 
 #: per-tick decay of the served-span EWMA (applied lazily per idle
 #: tick, so updates stay O(served) and reads O(reported))
@@ -109,9 +110,13 @@ CENSUS_EWMA_DECAY = 0.9
 #: tuples (drain + evict)
 QUEUE_ENTRY_BYTES = 224
 
-#: per REGISTERED tenant in the admission plane: the spec row, the
-#: TenantCounters row (8 ints), and the backlog / last-finish /
-#: priority bookkeeping dict entries
+#: per ACTIVE (ever-offered) tenant in the admission plane: the
+#: TenantCounters row (8 ints) and the backlog / last-finish
+#: bookkeeping dict entries — all LAZY since the tiering PR (created on
+#: a tenant's first offer), so this prices the active set.  The
+#: per-REGISTERED remainder is the columnar spec table, priced exactly
+#: from its array bytes (:meth:`anomod.serve.queues.AdmissionController.
+#: spec_table_nbytes`).
 ADMISSION_TENANT_BYTES = 256
 
 #: one lazily-deleted heap tuple (3 slots + tuple header)
@@ -134,6 +139,16 @@ FLIGHT_RECORD_BYTES = 2048
 #: one retained perf-timeline event: len(EVENT_FIELDS)=14 slots of
 #: 8 bytes plus dict overhead (anomod.obs.perf.EVENT_FIELDS)
 PERF_EVENT_BYTES = 256
+
+#: one warm-tier entry's bookkeeping beyond its exact state arrays:
+#: the dict entry, the record row and the detector-snapshot scaffolding
+#: (anomod.serve.tiering — alert rows inside the snapshot are already
+#: O(alerts), not per-tenant, and stay unpriced like the detector's own)
+TIER_WARM_ENTRY_BYTES = 192
+
+#: one cold-tier index entry: the content-address key string (64 hex
+#: chars) + its dict entry + the retained scalar meta
+TIER_COLD_INDEX_BYTES = 160
 
 def plane_nbytes(arr) -> int:
     """Exact byte size of one array plane from shape × itemsize —
@@ -244,26 +259,31 @@ def collect_resident_bytes(engine) -> Tuple[List[dict], Dict[str, int],
         planes.append({"shard": s, "plane": "scratch",
                        "bytes": scratch_b, "buffers": n_bufs})
 
-    # admission (coordinator): queued span arrays exact + registered
-    # bookkeeping at nominal entry sizes — the structure whose growth
-    # the tiering item must decouple from the registered count
+    # admission (coordinator): queued span arrays exact + the columnar
+    # spec table's array bytes exact (the per-REGISTERED remainder) +
+    # per-ACTIVE bookkeeping at nominal entry sizes — the lazification
+    # that collapsed the committed 384 B/registered baseline
     adm = engine.admission
     alive = list(adm._alive.values())
     queued_b = sum(span_batch_nbytes(qb.spans) for qb in alive) \
         + len(alive) * QUEUE_ENTRY_BYTES
     heap_b = (len(adm._drain_heap) + len(adm._evict_heap)) \
         * HEAP_ENTRY_BYTES
-    reg_b = len(adm.specs) * ADMISSION_TENANT_BYTES
+    reg_b = adm.spec_table_nbytes()
+    active_b = len(adm.counters) * ADMISSION_TENANT_BYTES
     planes.append({"shard": -1, "plane": "admission",
-                   "bytes": queued_b + heap_b + reg_b,
+                   "bytes": queued_b + heap_b + reg_b + active_b,
                    "queued_batches": len(alive),
                    "queued_spans": int(adm.backlog_spans),
                    "queued_bytes": queued_b,
                    "registered": len(adm.specs),
-                   "registered_bytes": reg_b})
+                   "registered_bytes": reg_b,
+                   "active": len(adm.counters),
+                   "active_bytes": active_b})
 
-    # SLO digests (coordinator): one _TenantSLO per REGISTERED tenant
-    # (built eagerly in the engine ctor — an O(registered) plane)
+    # SLO digests (coordinator): one _TenantSLO per tenant that has
+    # RECORDED a latency (lazy since the tiering PR — an O(active)
+    # plane; it was built eagerly per registered tenant before)
     slo_b = 0
     n_digests = 0
     for slo in engine._slo.values():
@@ -273,6 +293,17 @@ def collect_resident_bytes(engine) -> Tuple[List[dict], Dict[str, int],
         slo_b += d + len(slo._buf) * 8 + SLO_TENANT_BYTES
     planes.append({"shard": -1, "plane": "slo", "bytes": slo_b,
                    "tenants": len(engine._slo), "digests": n_digests})
+
+    # tenant-state tier (coordinator): warm entries' state arrays exact
+    # (the snapshot copies ARE the resident bytes) + nominal per-entry
+    # bookkeeping; cold entries live on disk and are priced as index
+    # entries only — that residency drop is the tier's whole point
+    tier = getattr(engine, "_tier", None)
+    if tier is not None:
+        planes.append({"shard": -1, "plane": "tier",
+                       "bytes": tier.resident_nbytes(),
+                       "warm": tier.n_warm, "cold": tier.n_cold,
+                       "warm_state_bytes": tier.warm_state_bytes})
 
     # RCA evidence buffers: per shard plane, buffered span arrays exact
     for s, plane in enumerate(engine._rca_planes):
@@ -355,6 +386,19 @@ class CensusTracker:
         digest-cadence contract."""
         return (tick + 1) % self.every == 0
 
+    def coldest_candidates(self, tick: int,
+                           resident: Sequence[int]) -> List[int]:
+        """Ever-served RESIDENT tenants, coldest first: oldest
+        last-served tick, then the weaker EWMA, then the tenant id.
+        THE one eviction ordering — the ``hot_doc`` coldest-K preview
+        and the tiering demotion policy (anomod.serve.tiering) both
+        read it here, so the preview can never disagree with what the
+        policy actually evicts."""
+        return sorted(
+            (tid for tid in resident if tid in self.last_served),
+            key=lambda tid: (self.last_served[tid],
+                             self.ewma_at(tid, tick), tid))
+
     def hot_doc(self, tick: int, registered: int,
                 resident: Sequence[int]) -> dict:
         """The hot-set census document (all-canonical content)."""
@@ -364,13 +408,10 @@ class CensusTracker:
             for th in self.decay_ticks}
         counts = sorted((c for c in self.served_total.values() if c > 0),
                         reverse=True)
-        # coldest-K among RESIDENT tenants: oldest last-served first,
-        # then the weaker EWMA, then the tenant id — the eviction-
-        # candidate preview the future LRU demotion policy consumes
-        cands = sorted(
-            (tid for tid in resident if tid in self.last_served),
-            key=lambda tid: (self.last_served[tid],
-                             self.ewma_at(tid, tick), tid))
+        # coldest-K among RESIDENT tenants — the eviction-candidate
+        # preview, and (since the tiering PR) the demotion policy's
+        # actual input: one shared ordering, unchanged output schema
+        cands = self.coldest_candidates(tick, resident)
         coldest = [{"tenant": int(t),
                     "last_served_tick": int(self.last_served[t]),
                     "idle_ticks": int(tick - self.last_served[t]),
@@ -415,7 +456,9 @@ def fit_slope(xs: Sequence[float],
 def fleet_probe(sizes: Optional[Sequence[int]] = None, hot: int = 1000,
                 ticks: int = 8, tick_s: float = 1.0,
                 capacity_spans_per_s: float = 2000.0, seed: int = 0,
-                n_services: int = 4, warmup_ticks: int = 2) -> dict:
+                n_services: int = 4, warmup_ticks: int = 2,
+                tier_hot: Optional[int] = None,
+                tier_demote_after: Optional[int] = None) -> dict:
     """The registered-fleet sweep: engines with ``registered`` tenants
     (``sizes``; default ``ANOMOD_CENSUS_SWEEP``) but a FIXED ``hot``-
     tenant traffic set, measuring per-tick wall and census resident
@@ -428,6 +471,9 @@ def fleet_probe(sizes: Optional[Sequence[int]] = None, hot: int = 1000,
     offer a span.  Host-seam state + score=False keep the probe about
     the bookkeeping planes (detector scoring is O(served) and already
     active-sized); wall medians drop ``warmup_ticks`` leading ticks.
+    ``tier_hot``/``tier_demote_after`` run the sweep with the
+    tenant-state tiering plane on (the TIERED capture's sweep —
+    demotion active, so the pool plane stays hot-bounded too).
     """
     from anomod.config import get_config
     from anomod.replay import ReplayConfig
@@ -451,13 +497,17 @@ def fleet_probe(sizes: Optional[Sequence[int]] = None, hot: int = 1000,
             for i in range(hot_n, registered)]
         cfg = ReplayConfig(n_services=n_services, n_windows=16,
                            window_us=int(5e6), chunk_size=4096)
+        tier_kw = {} if tier_hot is None else dict(
+            tier_hot=int(tier_hot),
+            tier_demote_after=int(tier_demote_after)
+            if tier_demote_after is not None else None)
         eng = ServeEngine(
             specs, traffic.services, cfg,
             capacity_spans_per_s=float(capacity_spans_per_s),
             tick_s=tick_s, buckets=(64, 256), lane_buckets=(1, 2, 4),
             max_backlog=int(8 * capacity_spans_per_s), score=False,
             rca=False, state="host", shards=1, census=True,
-            census_every=max(int(ticks), 1))
+            census_every=max(int(ticks), 1), **tier_kw)
         eng.runner.warm()                   # compiles outside the walls
         if eng._fused:
             eng.runner.warm_lanes()

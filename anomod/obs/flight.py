@@ -88,8 +88,16 @@ PLANES: Tuple[str, ...] = ("admission", "dispatch", "fold", "score", "rca")
 #: the flight twin of the serving plane's
 #: SHARD_VARIANT_REPORT_FIELDS (one definition, shared by
 #: canonical_ticks, the parity tests and the pre-bench flight smoke).
+#: ``tiering`` (anomod.serve.tiering) joins the variant tier for one
+#: precise reason: demote/promote/miss events are wall-free functions
+#: of seed+config (byte-equal across same-config reruns, pinned in
+#: tests/test_serve_tiering.py), but a cold promotion's one-tick
+#: deferral legitimately moves WHICH tick a tenant's fold/score deltas
+#: land in vs a never-evicted run of the same seed — content conserved,
+#: placement shifted — so the key cannot sit on the canonical surface.
 FLIGHT_VARIANT_KEYS: Tuple[str, ...] = ("walls", "topology", "recovery",
-                                        "scaling", "perf", "census")
+                                        "scaling", "perf", "census",
+                                        "tiering")
 
 
 def crc_text(text: str, prev: int = 0) -> int:

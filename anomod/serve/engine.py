@@ -40,6 +40,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
+from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -119,6 +120,17 @@ class _TenantSLO:
         return float(tdigest_quantile(self.digest, q))
 
 
+class _LazySLO(dict):
+    """Per-tenant SLO sketches created on first recorded sample — the
+    registered fleet never materializes a digest row (the tiering PR's
+    O(hot-set) registry contract; the report's priority merge walks the
+    rows that exist, and ``_merged_quantiles`` of none is None-safe)."""
+
+    def __missing__(self, tid: int) -> _TenantSLO:
+        s = self[tid] = _TenantSLO()
+        return s
+
+
 def _merged_quantiles(slos: Sequence[_TenantSLO],
                       qs=(0.5, 0.99)) -> Dict[str, Optional[float]]:
     digests = []
@@ -179,7 +191,13 @@ SHARD_VARIANT_REPORT_FIELDS = (
     # (census_hot_set) and the census tick count derive from
     # coordinator admission decisions alone and stay CANONICAL; the
     # census wall is a wall measurement (the in-run overhead price)
-    "census_resident_bytes", "census_wall_s")
+    "census_resident_bytes", "census_wall_s",
+    # the state-tiering plane (ANOMOD_SERVE_TIER_HOT): demotions /
+    # promotions / misses are functions of seed+config and stay
+    # CANONICAL; whether a cold fetch happened to finish before its
+    # one-tick deferral elapsed is wall luck, and the gate+demote wall
+    # is a wall measurement — consciously VARIANT
+    "tier_prefetch_hidden", "tier_wall_s")
 
 
 def _runner_stats(r) -> dict:
@@ -347,6 +365,17 @@ class ServeReport:
     #                                              pool/scratch topology)
     census_wall_s: float                         # census drain wall (the
     #                                              in-run overhead price)
+    tier_hot: int                                # hot-pool tenant capacity
+    #                                              (0 = tiering off)
+    n_tier_demotions_warm: int                   # device→host warm demotions
+    n_tier_demotions_cold: int                   # warm→disk cold spills
+    n_tier_promotions: int                       # tier→device re-admissions
+    n_tier_misses: int                           # deterministic one-tick
+    #                                              cold-promotion deferrals
+    tier_prefetch_hidden: int                    # cold joins whose disk read
+    #                                              had already finished
+    #                                              (variant: wall telemetry)
+    tier_wall_s: float                           # gate + demote-step wall
     async_commit: bool                           # deferred-commit tick on?
     async_ticks: int                             # ticks whose commit
     #                                              deferred past issue
@@ -423,7 +452,12 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   target_imbalance: Optional[float] = None,
                   cooldown_ticks: Optional[int] = None,
                   async_commit: Optional[bool] = None,
-                  native_drain: Optional[str] = None
+                  native_drain: Optional[str] = None,
+                  tier_hot: Optional[int] = None,
+                  tier_demote_after: Optional[int] = None,
+                  tier_warm_bytes: Optional[int] = None,
+                  tier_cold_dir=None,
+                  tier_prefetch: Optional[int] = None
                   ) -> Tuple["ServeEngine", ServeReport]:
     """The canonical seeded serve run shared by ``anomod serve`` and
     ``bench.py --mode serve``: a power-law tenant fleet offering
@@ -466,7 +500,12 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          target_imbalance=target_imbalance,
                          cooldown_ticks=cooldown_ticks,
                          async_commit=async_commit,
-                         native_drain=native_drain)
+                         native_drain=native_drain,
+                         tier_hot=tier_hot,
+                         tier_demote_after=tier_demote_after,
+                         tier_warm_bytes=tier_warm_bytes,
+                         tier_cold_dir=tier_cold_dir,
+                         tier_prefetch=tier_prefetch)
     if engine.flight_recorder is not None:
         # the header's replay contract: `anomod audit replay` re-executes
         # this exact invocation from the journal alone.  Every
@@ -536,6 +575,18 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
             # engine's (the parity pin), so replaying either mode
             # against either journal matches
             async_commit=engine.async_commit,
+            # the state-tiering knobs, RESOLVED: demotions/promotions/
+            # misses are functions of these values (warm_bytes and
+            # cold_dir decide cold-vs-warm, and a cold promotion's
+            # one-tick deferral moves which tick the tenant's canonical
+            # fold/score deltas land in), so a replay must serve with
+            # the ORIGINAL tiering geometry to reproduce the journal
+            tier_hot=engine.tier_hot,
+            tier_demote_after=engine.tier_demote_after,
+            tier_warm_bytes=engine.tier_warm_bytes,
+            tier_cold_dir=(str(engine.tier_cold_dir)
+                           if engine.tier_cold_dir is not None else None),
+            tier_prefetch=engine.tier_prefetch,
             # ``native_drain`` stays raw — the ``native`` rationale:
             # the columnar/native SFQ drain is byte-identical to the
             # heap (it cannot move a canonical plane), and a resolved
@@ -588,7 +639,12 @@ class ServeEngine:
                  target_imbalance: Optional[float] = None,
                  cooldown_ticks: Optional[int] = None,
                  async_commit: Optional[bool] = None,
-                 native_drain: Optional[str] = None):
+                 native_drain: Optional[str] = None,
+                 tier_hot: Optional[int] = None,
+                 tier_demote_after: Optional[int] = None,
+                 tier_warm_bytes: Optional[int] = None,
+                 tier_cold_dir=None,
+                 tier_prefetch: Optional[int] = None):
         from anomod.config import get_config
         from anomod.utils.platform import enable_jit_cache
         if capacity_spans_per_s <= 0:
@@ -769,6 +825,69 @@ class ServeEngine:
                     "(ANOMOD_SERVE_STATE=host or auto)")
             _state = "host"
         self.serve_state = "device" if _state == "auto" else _state
+        #: tenant-state tiering (ANOMOD_SERVE_TIER_HOT > 0; anomod.
+        #: serve.tiering): cold tenants demote out of the device pool
+        #: into a host warm tier (and past the warm budget, a
+        #: content-addressed disk cold tier), re-admitting transparently
+        #: on their next drained batch — pool bytes track the HOT set
+        #: while the registered fleet scales to millions.  The mesh
+        #: plane keeps state outside the snapshot seams, the multimodal
+        #: sidecar's modality planes have no demotion copier, and the
+        #: deferred-commit tick would demote states with uncommitted
+        #: in-flight folds at tick end — tiering auto-disables on all
+        #: three (an explicit request is refused): the policy idiom.
+        _tier_hot = (app_cfg.serve_tier_hot if tier_hot is None
+                     else int(tier_hot))
+        if tier_hot is not None and _tier_hot < 0:
+            raise ValueError("tier_hot must be >= 0 (0 = tiering off)")
+        if _tier_hot > 0 and (mesh is not None or multimodal
+                              or self.async_commit):
+            if tier_hot is not None:
+                raise ValueError(
+                    "state tiering demotes tenants through the "
+                    "bucket-runner snapshot seams; "
+                    + ("the mesh plane manages its own sharded state"
+                       if mesh is not None else
+                       "the multimodal sidecar planes are not covered "
+                       "by the demotion copier" if multimodal else
+                       "the deferred-commit tick leaves folds in "
+                       "flight at the demotion point")
+                    + " (ANOMOD_SERVE_TIER_HOT=0)")
+            _tier_hot = 0
+        self.tier_hot = int(_tier_hot)
+        self.tier_demote_after = int(
+            app_cfg.serve_tier_demote_after if tier_demote_after is None
+            else tier_demote_after)
+        if self.tier_demote_after < 1:
+            raise ValueError("tier_demote_after must be >= 1 tick")
+        self.tier_warm_bytes = int(
+            app_cfg.serve_tier_warm_bytes if tier_warm_bytes is None
+            else tier_warm_bytes)
+        if self.tier_warm_bytes < 0:
+            raise ValueError("tier_warm_bytes must be >= 0")
+        _tier_cold = (app_cfg.serve_tier_cold_dir if tier_cold_dir is None
+                      else tier_cold_dir)
+        self.tier_cold_dir = (Path(_tier_cold).expanduser()
+                              if _tier_cold else None)
+        self.tier_prefetch = int(app_cfg.serve_tier_prefetch
+                                 if tier_prefetch is None
+                                 else tier_prefetch)
+        if not 1 <= self.tier_prefetch <= 256:
+            raise ValueError("tier_prefetch must be in [1, 256]")
+        self._tier = None
+        #: a cold-promoting tenant's drained batches, parked exactly
+        #: one tick (the deterministic tier_miss deferral) — flushed
+        #: FIRST at the next tick's scoring gate, in park order
+        self._tier_parked: Dict[int, list] = {}
+        self.tier_wall_s = 0.0
+        if self.tier_hot:
+            from anomod.serve.tiering import TierPlane
+            self._tier = TierPlane(
+                self.tier_hot, self.tier_demote_after,
+                self.tier_warm_bytes, self.tier_cold_dir,
+                self.tier_prefetch,
+                slot_nbytes=self.cfg.sw
+                * (N_FEATS + self.cfg.n_hist_buckets) * 4)
         _buckets = (buckets if buckets is not None
                     else app_cfg.serve_buckets)
         self._proc_registry = obs.get_registry()
@@ -831,11 +950,16 @@ class ServeEngine:
         self.census_peak_bytes = 0
         self.census_wall_s = 0.0
         self._census_reconciled = True
-        if self.census:
+        if self.census or self.tier_hot:
+            # the tracker also runs under a census-off TIERED engine:
+            # its last-served/EWMA bookkeeping is the demotion policy's
+            # input (coldest_candidates — the eviction preview promoted
+            # to policy); the census DRAIN stays gated on self.census
             from anomod.obs.census import CensusTracker
             self._census_tracker = CensusTracker(
                 app_cfg.census_decay_ticks,
                 app_cfg.census_coldest_k, self.census_every)
+        if self.census:
             # metric handles only when the plane is live (the RCA/perf
             # discipline: a census-off run must not register
             # permanently-zero series)
@@ -878,9 +1002,15 @@ class ServeEngine:
                 for _ in range(self.shards)]
             owned = [sum(1 for t in self.shard_of.values() if t == s)
                      for s in range(self.shards)]
+            # with tiering on, each shard's pool sizes to its share of
+            # the HOT capacity, not its registered ownership (demotion
+            # returns slots; the pool's doubling growth covers
+            # transients between demote steps)
             self._runners = [
                 BucketRunner(self.cfg, _buckets, registry=reg,
-                             pool_slots=max(owned[s], 1),
+                             pool_slots=max(min(owned[s], self.tier_hot)
+                                            if self.tier_hot
+                                            else owned[s], 1),
                              perf=(self._perf_recs[s] if self.perf
                                    else None),
                              **self._runner_kw)
@@ -888,13 +1018,21 @@ class ServeEngine:
             self._fold_state = [dict() for _ in range(self.shards)]
             self.runner = self._runners[0]
         else:
-            self.shard_of = {s.tenant_id: 0 for s in self.specs}
+            # the inline engine owns every tenant on shard 0: keep the
+            # placement map EMPTY (every read is `.get(tid, 0)`) instead
+            # of materializing an O(registered) dict — the tiering PR's
+            # O(hot-set) registry contract
+            self.shard_of = {}
             self.runner = BucketRunner(self.cfg, _buckets,
                                        lane_buckets=lane_buckets,
                                        pipeline=self.pipeline,
                                        native_stage=native,
                                        state=self.serve_state,
-                                       pool_slots=max(len(self.specs), 1),
+                                       pool_slots=max(
+                                           min(len(self.specs),
+                                               self.tier_hot)
+                                           if self.tier_hot
+                                           else len(self.specs), 1),
                                        perf=(self._perf_recs[0]
                                              if self.perf else None))
             self._runners = [self.runner]
@@ -968,8 +1106,7 @@ class ServeEngine:
         self._tenant_replay: Dict[int, object] = {}
         self._tenant_det: Dict[int, object] = {}
         self._shared_sharded_fn = None
-        self._slo: Dict[int, _TenantSLO] = {s.tenant_id: _TenantSLO()
-                                            for s in self.specs}
+        self._slo: Dict[int, _TenantSLO] = _LazySLO()
         self._credit = 0.0
         #: widest batch ever served — the legitimate overdraw envelope
         #: the per-tick credit clamp must respect (a >budget batch's debt
@@ -1031,6 +1168,7 @@ class ServeEngine:
                     "perf": self.perf,
                     "census": self.census,
                     "async_commit": self.async_commit,
+                    "tier_hot": self.tier_hot,
                     "drain_engine": self.admission.drain_engine,
                  },
                  "config": config_snapshot(),
@@ -1202,6 +1340,95 @@ class ServeEngine:
             raise ValueError(f"unknown modality kind {kind!r}")
         self.modality_events[kind] = self.modality_events.get(kind, 0) + n
 
+    # -- the state-tiering planes (anomod.serve.tiering) ------------------
+
+    def _tier_gate(self, served: List[QueuedBatch]) -> List[QueuedBatch]:
+        """The promotion gate between drain and scoring (synchronous
+        tick path only — tiering refuses the deferred-commit engine).
+        Returns the list that actually scores this tick: last tick's
+        parked batches FIRST in park order (their tenants' prefetches
+        join here — the one-tick deferral ending), then this tick's
+        drained batches, minus any batch whose tenant is still cold
+        (parked + prefetch issued + ONE counted `tier_miss` per
+        tenant-tick).  Warm tenants promote synchronously in place."""
+        tier = self._tier
+        score_list: List[QueuedBatch] = []
+        if self._tier_parked:
+            parked, self._tier_parked = self._tier_parked, {}
+            for tid, batches in parked.items():
+                # a supervised restore may have re-installed the tenant
+                # from a checkpoint (no longer tiered): its batches
+                # still score, the promotion is simply a no-op
+                if tid in tier:
+                    self._tier_promote(tid, deferred=True)
+                score_list.extend(batches)
+        fresh = self._tier_parked
+        for qb in served:
+            tid = qb.tenant_id
+            if tid in fresh:
+                fresh[tid].append(qb)
+            elif tid not in tier:
+                score_list.append(qb)
+            elif tier.status(tid) == "warm":
+                self._tier_promote(tid, deferred=False)
+                score_list.append(qb)
+            else:
+                tier.prefetch(tid)
+                fresh[tid] = [qb]
+        for tid, batches in fresh.items():
+            tier.miss(self.clock.ticks, tid, len(batches),
+                      sum(qb.n_spans for qb in batches))
+        return score_list
+
+    def _tier_promote(self, tid: int, deferred: bool) -> None:
+        """Re-admit one demoted tenant through the official seams: take
+        its snapshot from the tier (joining the prefetch future for a
+        cold entry), rebuild the pool-resident replay via the
+        always-copy restore, and repoint the RETAINED detector at the
+        new plane — the ``_move_tenant`` discipline, so re-admission
+        cannot shift a scored byte."""
+        from anomod.serve.supervise import restore_replay
+        snap, det = self._tier.take(self.clock.ticks, tid, deferred)
+        rep = self._replay_for(tid)
+        restore_replay(rep, snap)
+        if det is not None:
+            det.replay = rep
+            self._tenant_det[tid] = det
+
+    def _tier_demote_step(self) -> None:
+        """Decay-driven eviction at tick end: while more than
+        ``tier_hot`` tenants are pool-resident, demote the coldest
+        residents past ``tier_demote_after`` idle ticks — the census
+        ``coldest_candidates`` ordering, the PR-15 eviction preview
+        promoted from observed-only to policy.  Tenants with queued
+        backlog or parked batches are skipped (a demote would promote
+        right back next tick — thrash), so every input is coordinator
+        state and the demotion schedule is a pure function of
+        seed+config."""
+        resident = self._tenant_replay
+        n_over = len(resident) - self.tier_hot
+        if n_over <= 0:
+            return
+        from anomod.serve.supervise import snapshot_replay
+        tracker = self._census_tracker
+        t_idx = self.clock.ticks
+        for tid in tracker.coldest_candidates(t_idx, resident):
+            idle = t_idx - tracker.last_served[tid]
+            if idle < self.tier_demote_after:
+                break                  # coldest-first: the rest is hotter
+            if (self.admission.tenant_backlog(tid)
+                    or tid in self._tier_parked):
+                continue
+            rep = resident.pop(tid)
+            snap = snapshot_replay(rep)
+            if hasattr(rep, "release"):
+                rep.release()          # hand the pool slot back
+            det = self._tenant_det.pop(tid, None)
+            self._tier.demote(t_idx, tid, snap, det, idle)
+            n_over -= 1
+            if n_over <= 0:
+                return
+
     # -- the tick loop ----------------------------------------------------
 
     def _span(self, name: str, **tags):
@@ -1280,33 +1507,56 @@ class ServeEngine:
             # tick's in-flight XLA work; the tail issues this tick's
             # dispatches and defers their commit to the next barrier
             return self._tick_async_tail(t_wall, now, served)
+        # the state-tiering gate (ANOMOD_SERVE_TIER_HOT): any drained
+        # tenant the decay plane demoted must be pool-resident before
+        # its batches score.  Warm entries re-admit synchronously (a
+        # host memcpy through the PR-10 restore seam); cold entries'
+        # batches PARK for exactly one tick while the disk fetch runs
+        # on the prefetch lane (issued here, joined by the NEXT tick's
+        # gate) — a counted, journaled `tier_miss`, never a blocking
+        # read in the hot loop.  Only the SCORING list is re-shaped:
+        # `served` keeps feeding every admission-time consumer below
+        # (SLO, RCA evidence, perf, census, flight, policy), and
+        # parked batches score ahead of the next tick's drain in park
+        # order, so per-tenant push order — and therefore every final
+        # state/alert byte — matches the never-evicted run.
+        if self._tier is not None:
+            t0 = time.perf_counter()
+            with self._span("serve.tier"):
+                score_list = self._tier_gate(served)
+            self.tier_wall_s += time.perf_counter() - t0
+        else:
+            score_list = served
         if self._perf_recs:
             # tick-boundary stamp (the workers are quiescent between
             # ticks, so this cross-thread write races nothing): events
             # the dispatch path records below key on this tick index
             for rec_ in self._perf_recs:
                 rec_.tick = self.clock.ticks
-        if served:
+        if score_list:
             sup = self._supervisor
             if sup is not None:
                 # the recovery log must hold this tick's slices BEFORE
-                # scoring: a mid-tick shard failure re-executes them
-                sup.begin_tick(served)
+                # scoring: a mid-tick shard failure re-executes them.
+                # The log holds what SCORES (score_list), not what
+                # drained: a parked batch logs at the tick it actually
+                # folds, which is the tick a restore must re-execute.
+                sup.begin_tick(score_list)
             self._last_failures = None
             try:
                 if self._use_workers:
                     with self._span("serve.score_sharded"):
-                        self._score_sharded(served)
+                        self._score_sharded(score_list)
                 elif self._fused:
                     with self._span("serve.score_fused"):
-                        self._score_fused(served)
+                        self._score_fused(score_list)
                 else:
                     # ONE unfused definition (chaos injection ordering
                     # included): _score_shard's unfused branch — the
                     # same unification _score_fused got, so original
                     # execution and recovery re-execution can never
                     # inject or score differently
-                    self._score_shard(0, served)
+                    self._score_shard(0, score_list)
             except BaseException as e:
                 failures = self._last_failures or [(0, e)]
                 self._last_failures = None
@@ -1351,8 +1601,15 @@ class ServeEngine:
             self._census_tracker.observe(self.clock.ticks, served)
             self._census_tick_doc = (
                 self._census_drain()
-                if self._census_tracker.due(self.clock.ticks) else None)
-            self.census_wall_s += time.perf_counter() - t0
+                if self.census
+                and self._census_tracker.due(self.clock.ticks) else None)
+            if self.census:
+                self.census_wall_s += time.perf_counter() - t0
+            else:
+                # the tracker is alive only to feed the tiering decay
+                # plane (coldest_candidates): its bookkeeping wall is
+                # tiering overhead, never a census price
+                self.tier_wall_s += time.perf_counter() - t0
         if self.flight_recorder is not None:
             # the journal entry rides INSIDE the measured wall (the
             # serve_wall_s accumulation below) — the bench's flight
@@ -1370,6 +1627,17 @@ class ServeEngine:
             with self._span("serve.policy"):
                 self._policy_step(served)
             self.policy_wall_s += time.perf_counter() - t0
+        if self._tier is not None:
+            # decay-driven demotion rides the tick END — after this
+            # tick's journal record (a demoted tenant's tick-t deltas
+            # are already journaled; its demote event rides the NEXT
+            # record's `tiering` variant key, the scaling-key idiom)
+            # and after the policy step (a migration decision saw the
+            # live residency map)
+            t0 = time.perf_counter()
+            with self._span("serve.tier_demote"):
+                self._tier_demote_step()
+            self.tier_wall_s += time.perf_counter() - t0
         self.clock.advance()
         # telemetry work stays INSIDE the measured wall: the bench's
         # enabled-vs-off overhead number must price the scrape, not
@@ -2029,10 +2297,24 @@ class ServeEngine:
                                "fold_s": round(dfold, 6),
                                "score_s": round(dscore, 6)})
         self._flight_prev_legs = legs
-        fold = {"tenants": len(self._tenant_replay),
-                "state_digest": (state_digest(self._tenant_replay)
-                                 if final or fr.digest_tick(t_idx)
-                                 else None)}
+        # the fold plane covers the WHOLE fleet's states: pool-resident
+        # replays plus (under tiering) the demoted set, read through
+        # the tier's digest shims — warm snapshots by reference, cold
+        # entries loaded from disk on digest ticks only.  The merged
+        # map is built ONLY when the digest actually runs, so the
+        # per-tick cost stays O(resident).
+        do_digest = final or fr.digest_tick(t_idx)
+        reps = self._tenant_replay
+        n_states = len(reps)
+        if self._tier is not None and len(self._tier):
+            n_states += len(self._tier)
+            if do_digest:
+                reps = dict(reps)
+                for tid_ in self._tier.tids():
+                    reps[tid_] = self._tier.state_shim(tid_)
+        fold = {"tenants": n_states,
+                "state_digest": (state_digest(reps)
+                                 if do_digest else None)}
         new_alerts = 0
         crc = self._flight_score_crc
         for tid in sorted(self._tenant_det):
@@ -2115,6 +2397,19 @@ class ServeEngine:
         census_doc, self._census_tick_doc = self._census_tick_doc, None
         rec["census"] = census_doc if census_doc is not None else \
             {"planes": [], "hot": {}}
+        # the state-tiering plane rides the VARIANT tier too (the
+        # "tiering" key in FLIGHT_VARIANT_KEYS): demote/promote/miss
+        # events are wall-free functions of seed+config — byte-equal
+        # across same-config reruns (pinned), excluded from the
+        # canonical surface only because a `tier_miss` legitimately
+        # moves WHICH tick a deferred tenant's fold/score deltas land
+        # in vs the never-evicted journal.  Demotions ride the record
+        # AFTER their tick (the step runs post-journal — the
+        # scaling-key placement); promotions/misses ride their own
+        # tick's.  ALWAYS present (empty with tiering off) — the
+        # every-record-carries-every-tier contract.
+        rec["tiering"] = (self._tier.drain_events()
+                          if self._tier is not None else [])
         if final:
             rec["final"] = True
         fr.record(rec)
@@ -2164,6 +2459,8 @@ class ServeEngine:
             self._deferred = None
             for r in self._runners:
                 r.abort_lanes()
+        if self._tier is not None:
+            self._tier.close()         # join/park the prefetch lane
         if self._workers is not None:
             errs = []
             for w in self._workers:
@@ -2686,6 +2983,37 @@ class ServeEngine:
             self._commit_deferred()
             self.serve_wall_s += time.perf_counter() - t0
         t_wall = time.perf_counter()
+        if self._tier is not None:
+            # run-end tier settlement: batches whose one-tick cold
+            # deferral crossed the run end still score (through the
+            # NORMAL per-tick scoring paths, in park order), and every
+            # tiered tenant promotes back to residency — finish() must
+            # close the whole fleet's last windows, the report counts
+            # the whole fleet's alerts, and the settlement record's
+            # forced digest anchors FULL state.  Sorted promotion order
+            # keeps the event stream deterministic; the events land in
+            # the settlement record's `tiering` key below.
+            if self._tier_parked:
+                parked, self._tier_parked = self._tier_parked, {}
+                leftovers: List[QueuedBatch] = []
+                for tid, batches in parked.items():
+                    if tid in self._tier:
+                        self._tier_promote(tid, deferred=True)
+                    leftovers.extend(batches)
+                if leftovers:
+                    sup = self._supervisor
+                    if sup is not None:
+                        sup.begin_tick(leftovers)
+                    if self._use_workers:
+                        self._score_sharded(leftovers)
+                    elif self._fused:
+                        self._score_fused(leftovers)
+                    else:
+                        self._score_shard(0, leftovers)
+                    if sup is not None:
+                        sup.end_tick()
+            for tid in sorted(self._tier.tids()):
+                self._tier_promote(tid, deferred=False)
         if self.score:
             for det in self._tenant_det.values():
                 det.finish()
@@ -2704,7 +3032,7 @@ class ServeEngine:
             # settle any lifecycle events the final drain window left
             # (and feed the settlement record's perf key below)
             self._perf_tick_doc = self._perf_drain()
-        if self._census_tracker is not None:
+        if self.census and self._census_tracker is not None:
             # run-end settlement census (the forced-digest idiom):
             # every census-on run ends on a full resident-bytes +
             # hot-set anchor regardless of the cadence, feeding the
@@ -2821,10 +3149,14 @@ class ServeEngine:
         shed_fraction = (tot.shed_spans / tot.offered_spans
                          if tot.offered_spans else 0.0)
         per_pri = {}
+        # walk the SLO rows that exist (the lazy map holds only
+        # ever-served tenants), never the registered fleet — a
+        # spec-driven walk would materialize O(registered) digest rows
+        # right here
         pri_slos: Dict[int, List[_TenantSLO]] = {}
-        for spec in self.specs:
-            pri_slos.setdefault(spec.priority, []).append(
-                self._slo[spec.tenant_id])
+        for tid, slo in self._slo.items():
+            pri_slos.setdefault(
+                self.admission.specs.priority_of(tid), []).append(slo)
         for pri, c in sorted(self.admission.per_priority().items()):
             per_pri[pri] = {
                 "offered_spans": c.offered_spans,
@@ -2871,11 +3203,16 @@ class ServeEngine:
             score_wall += st["score_wall_s"]
         shard_tenants: Dict[int, int] = {s: 0 for s in range(self.shards)}
         shard_spans: Dict[int, int] = {s: 0 for s in range(self.shards)}
-        for spec in self.specs:
-            sh = self.shard_of.get(spec.tenant_id, 0)
+        # the inline engine's placement map is empty (everyone defaults
+        # to shard 0): count the unplaced arithmetically, walk only the
+        # placed — never the registered fleet
+        shard_tenants[0] += len(self.specs) - len(self.shard_of)
+        for tid, sh in self.shard_of.items():
             shard_tenants[sh] += 1
-            shard_spans[sh] += \
-                self.admission.counters[spec.tenant_id].served_spans
+        for tid, c in self.admission.counters.items():
+            # only ever-offered tenants hold a counter row (the lazy
+            # map): a [] walk over specs would materialize O(registered)
+            shard_spans[self.shard_of.get(tid, 0)] += c.served_spans
         total_shard_spans = sum(shard_spans.values())
         shard_imbalance = (max(shard_spans.values())
                            / (total_shard_spans / self.shards)
@@ -2992,6 +3329,18 @@ class ServeEngine:
             census_hot_set=dict(self.census_hot_set),
             census_resident_bytes=dict(self.census_resident),
             census_wall_s=round(self.census_wall_s, 4),
+            tier_hot=self.tier_hot,
+            n_tier_demotions_warm=(self._tier.demotions_warm
+                                   if self._tier is not None else 0),
+            n_tier_demotions_cold=(self._tier.demotions_cold
+                                   if self._tier is not None else 0),
+            n_tier_promotions=(self._tier.promotions
+                               if self._tier is not None else 0),
+            n_tier_misses=(self._tier.misses
+                           if self._tier is not None else 0),
+            tier_prefetch_hidden=(self._tier.prefetch_hits
+                                  if self._tier is not None else 0),
+            tier_wall_s=round(self.tier_wall_s, 4),
             async_commit=self.async_commit,
             async_ticks=self.async_ticks,
             commit_defer_wall_s=round(self.commit_defer_wall_s, 6),

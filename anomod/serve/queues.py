@@ -20,6 +20,16 @@ Everything is host-side bookkeeping over integers and floats — no wall
 clocks, no randomness — so a seeded overload replay is bit-reproducible
 (the determinism contract tests/test_serve.py pins).
 
+Registry costs scale with the ACTIVE tenant set, not the registered one
+(the tiering PR's O(hot-set) contract): the per-tenant counters, backlog
+depths and SFQ last-finish tags are created lazily on a tenant's first
+offer, the registered fleet lives in one columnar spec table
+(:class:`_SpecTable` — id/priority/weight arrays, ~26 exact bytes per
+registered tenant instead of a spec-dict entry), and the admission
+totals are maintained as a RUNNING sum at every mutation site, so
+``totals()`` — called per tick by the flight recorder — is O(1) instead
+of an O(registered) walk.  Same integers on every path (pinned).
+
 Two drain/shed engines implement the same contract
 (``ANOMOD_SERVE_NATIVE_DRAIN``): the original per-span Python heap pair
 (``off`` — kept as the parity oracle) and the columnar engine
@@ -86,6 +96,94 @@ class TenantCounters:
     # (displaced by a higher-priority arrival) — counted separately so
     # the flight recorder's admission plane journals them per tick
     evicted_batches: int = 0
+
+
+class _LazyCounters(dict):
+    """Per-tenant counters created on first touch — the registered
+    fleet never materializes a row (the O(hot-set) registry contract);
+    external readers of a never-offered tenant see zeros, same as the
+    eager dict before."""
+
+    def __missing__(self, tid: int) -> TenantCounters:
+        c = self[tid] = TenantCounters()
+        return c
+
+
+class _SpecTable:
+    """The registered fleet as columns: tenant id, priority, resolved
+    SFQ weight and the rate hint as parallel arrays, names as a tuple
+    of references — ~26 exact bytes per registered tenant where the
+    spec dict paid a dict entry + bookkeeping rows each.  Dense ids
+    (0..n-1, every generated fleet) index straight into the arrays;
+    anything else goes through a side index.  ``__getitem__``
+    rematerializes a :class:`TenantSpec` for report/test callers —
+    never on the offer/drain hot path, which reads
+    :meth:`priority_of` / :meth:`weight_of`."""
+
+    __slots__ = ("ids", "pri", "wt", "rate", "names", "_index")
+
+    def __init__(self, tenants: Sequence[TenantSpec]):
+        self.ids = np.asarray([t.tenant_id for t in tenants], np.int64)
+        if len(np.unique(self.ids)) != len(self.ids):
+            raise ValueError("duplicate tenant_id in tenant specs")
+        self.pri = np.asarray([t.priority for t in tenants], np.int16)
+        self.wt = np.asarray([t.effective_weight() for t in tenants],
+                             np.float64)
+        self.rate = np.asarray([t.rate_spans_per_s for t in tenants],
+                               np.float64)
+        self.names = tuple(t.name for t in tenants)
+        n = len(self.ids)
+        dense = n > 0 and self.ids[0] == 0 and self.ids[n - 1] == n - 1 \
+            and bool((self.ids == np.arange(n, dtype=np.int64)).all())
+        self._index: Optional[Dict[int, int]] = None if dense \
+            else {int(t): i for i, t in enumerate(self.ids)}
+
+    def _row(self, tid: int) -> int:
+        if self._index is None:
+            if 0 <= tid < len(self.ids):
+                return tid
+            raise KeyError(tid)
+        return self._index[tid]
+
+    def priority_of(self, tid: int) -> int:
+        return int(self.pri[self._row(tid)])
+
+    def weight_of(self, tid: int) -> float:
+        return float(self.wt[self._row(tid)])
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def __contains__(self, tid: int) -> bool:
+        try:
+            self._row(tid)
+        except KeyError:
+            return False
+        return True
+
+    def __iter__(self):
+        return iter(int(t) for t in self.ids)
+
+    def __getitem__(self, tid: int) -> TenantSpec:
+        i = self._row(tid)
+        return TenantSpec(tenant_id=int(self.ids[i]),
+                          name=self.names[i],
+                          priority=int(self.pri[i]),
+                          weight=0.0 if self.wt[i]
+                          == PRIORITY_WEIGHTS.get(int(self.pri[i]), 1.0)
+                          else float(self.wt[i]),
+                          rate_spans_per_s=float(self.rate[i]))
+
+    def nbytes(self) -> int:
+        """Exact column bytes + 8 nominal per name reference (the
+        strings are owned by the caller's spec objects) + the sparse
+        index entries where ids are not dense — the census admission
+        plane's per-REGISTERED price."""
+        b = int(self.ids.nbytes + self.pri.nbytes + self.wt.nbytes
+                + self.rate.nbytes) + 8 * len(self.names)
+        if self._index is not None:
+            b += 64 * len(self._index)
+        return b
 
 
 class _ColumnarSFQ:
@@ -246,27 +344,32 @@ class AdmissionController:
         if drain_engine != "off":
             self._col = _ColumnarSFQ(require_native=(drain_engine == "on"))
             self.drain_engine = self._col.engine
-        self.specs: Dict[int, TenantSpec] = {t.tenant_id: t for t in tenants}
-        if len(self.specs) != len(tenants):
-            raise ValueError("duplicate tenant_id in tenant specs")
+        # registered fleet: one columnar table, not a dict of specs —
+        # O(registered) exact bytes, O(1)-ish lookups; raises the same
+        # duplicate-id ValueError the dict comprehension used to
+        self.specs = _SpecTable(tenants)
         self.max_backlog = int(max_backlog)
         self.max_tenant_backlog = int(max_tenant_backlog
                                       if max_tenant_backlog is not None
                                       else max(max_backlog // 8, 1))
-        self.counters: Dict[int, TenantCounters] = {
-            t.tenant_id: TenantCounters() for t in tenants}
+        # ACTIVE-tenant registries: rows materialize on first offer, so
+        # a million-registered fleet with a thousand live feeds pays for
+        # a thousand rows (the tiering PR's O(hot-set) contract)
+        self.counters: Dict[int, TenantCounters] = _LazyCounters()
+        # running totals, bumped at every counter mutation site below —
+        # totals() is O(1), the flight recorder calls it every tick
+        self._tot = TenantCounters()
         self.backlog_spans = 0
         self.peak_backlog_spans = 0
-        self._tenant_backlog: Dict[int, int] = {t.tenant_id: 0
-                                                for t in tenants}
+        self._tenant_backlog: Dict[int, int] = {}
         # per-priority backlog totals: the eviction feasibility check
         # must know how much strictly-lower-priority work is queued
         # BEFORE destroying any of it
         self._priority_backlog: Dict[int, int] = {}
         # SFQ state: system virtual time + per-tenant last finish tag
+        # (lazy: a tenant that never offers never gets a tag)
         self._vtime = 0.0
-        self._last_finish: Dict[int, float] = {t.tenant_id: 0.0
-                                               for t in tenants}
+        self._last_finish: Dict[int, float] = {}
         self._seq = 0
         self._alive: Dict[int, QueuedBatch] = {}      # seq -> batch
         # drain heap: smallest finish tag first (seq breaks ties
@@ -303,11 +406,13 @@ class AdmissionController:
         strictly-lower-priority queued work first and sheds the arrival
         only when none exists.
         """
-        spec = self.specs[tenant_id]
+        priority = self.specs.priority_of(tenant_id)
         n = spans.n_spans
         c = self.counters[tenant_id]
         c.offered_spans += n
         c.offered_batches += 1
+        self._tot.offered_spans += n
+        self._tot.offered_batches += 1
         self._obs_offered.inc(n)
         if n == 0:
             return False
@@ -315,11 +420,12 @@ class AdmissionController:
         # (the admission mirror of drain()'s one-batch overdraw): a batch
         # wider than a bound must still admit against an empty queue, or
         # it would be starved forever at ANY load
-        if self._tenant_backlog[tenant_id] \
-                and self._tenant_backlog[tenant_id] + n \
-                > self.max_tenant_backlog:
+        backlog = self._tenant_backlog.get(tenant_id, 0)
+        if backlog and backlog + n > self.max_tenant_backlog:
             c.shed_spans += n
             c.shed_batches += 1
+            self._tot.shed_spans += n
+            self._tot.shed_batches += 1
             self._obs_shed.inc(n)
             return False
         if self.backlog_spans and self.backlog_spans + n > self.max_backlog:
@@ -332,17 +438,21 @@ class AdmissionController:
             needed = min(self.backlog_spans + n - self.max_backlog,
                          self.backlog_spans)
             evictable = sum(v for p, v in self._priority_backlog.items()
-                            if p > spec.priority)
+                            if p > priority)
             if evictable < needed:
                 c.shed_spans += n
                 c.shed_batches += 1
+                self._tot.shed_spans += n
+                self._tot.shed_batches += 1
                 self._obs_shed.inc(n)
                 return False
         while self.backlog_spans and self.backlog_spans + n > self.max_backlog:
-            victim = self._pop_eviction_candidate(spec.priority)
+            victim = self._pop_eviction_candidate(priority)
             if victim is None:           # unreachable given the check above
                 c.shed_spans += n
                 c.shed_batches += 1
+                self._tot.shed_spans += n
+                self._tot.shed_batches += 1
                 self._obs_shed.inc(n)
                 return False
             vc = self.counters[victim.tenant_id]
@@ -350,14 +460,18 @@ class AdmissionController:
             vc.shed_batches += 1
             vc.evicted_batches += 1
             vc.admitted_spans -= victim.n_spans
+            self._tot.shed_spans += victim.n_spans
+            self._tot.shed_batches += 1
+            self._tot.evicted_batches += 1
+            self._tot.admitted_spans -= victim.n_spans
             self._obs_shed.inc(victim.n_spans)
             self._obs_evicted.inc()
             self._remove(victim)
-        start = max(self._vtime, self._last_finish[tenant_id])
-        finish = start + n / spec.effective_weight()
+        start = max(self._vtime, self._last_finish.get(tenant_id, 0.0))
+        finish = start + n / self.specs.weight_of(tenant_id)
         self._last_finish[tenant_id] = finish
         qb = QueuedBatch(tenant_id=tenant_id, seq=self._seq, spans=spans,
-                         n_spans=n, priority=spec.priority,
+                         n_spans=n, priority=priority,
                          enqueued_s=now_s, finish_tag=finish)
         self._seq += 1
         self._alive[qb.seq] = qb
@@ -368,12 +482,13 @@ class AdmissionController:
             heapq.heappush(self._evict_heap,
                            (-qb.priority, -qb.finish_tag, -qb.seq))
         self.backlog_spans += n
-        self._tenant_backlog[tenant_id] += n
-        self._priority_backlog[spec.priority] = \
-            self._priority_backlog.get(spec.priority, 0) + n
+        self._tenant_backlog[tenant_id] = backlog + n
+        self._priority_backlog[priority] = \
+            self._priority_backlog.get(priority, 0) + n
         self.peak_backlog_spans = max(self.peak_backlog_spans,
                                       self.backlog_spans)
         c.admitted_spans += n
+        self._tot.admitted_spans += n
         self._obs_admitted.inc(n)
         self._obs_depths()
         return True
@@ -439,10 +554,12 @@ class AdmissionController:
                 self._remove(qb)
                 self._vtime = max(
                     self._vtime, qb.finish_tag - qb.n_spans
-                    / self.specs[qb.tenant_id].effective_weight())
+                    / self.specs.weight_of(qb.tenant_id))
                 c = self.counters[qb.tenant_id]
                 c.served_spans += qb.n_spans
                 c.served_batches += 1
+                self._tot.served_spans += qb.n_spans
+                self._tot.served_batches += 1
                 self._obs_served.inc(qb.n_spans)
                 out.append(qb)
             if out:
@@ -459,11 +576,13 @@ class AdmissionController:
             heapq.heappop(self._drain_heap)
             self._remove(qb)
             self._vtime = max(self._vtime, fin - qb.n_spans
-                              / self.specs[qb.tenant_id].effective_weight())
+                              / self.specs.weight_of(qb.tenant_id))
             remaining -= qb.n_spans
             c = self.counters[qb.tenant_id]
             c.served_spans += qb.n_spans
             c.served_batches += 1
+            self._tot.served_spans += qb.n_spans
+            self._tot.served_batches += 1
             self._obs_served.inc(qb.n_spans)
             out.append(qb)
         if out:
@@ -473,19 +592,27 @@ class AdmissionController:
     # -- report helpers ---------------------------------------------------
 
     def totals(self) -> TenantCounters:
-        tot = TenantCounters()
-        for c in self.counters.values():
-            for f in dataclasses.fields(TenantCounters):
-                setattr(tot, f.name,
-                        getattr(tot, f.name) + getattr(c, f.name))
-        return tot
+        # O(1): the running sum, not a walk over per-tenant rows — the
+        # flight recorder calls this every tick against fleets where
+        # registered ≫ active
+        return dataclasses.replace(self._tot)
 
     def per_priority(self) -> Dict[int, TenantCounters]:
         out: Dict[int, TenantCounters] = {}
         for tid, c in self.counters.items():
-            pri = self.specs[tid].priority
+            pri = self.specs.priority_of(tid)
             acc = out.setdefault(pri, TenantCounters())
             for f in dataclasses.fields(TenantCounters):
                 setattr(acc, f.name,
                         getattr(acc, f.name) + getattr(c, f.name))
         return out
+
+    def tenant_backlog(self, tenant_id: int) -> int:
+        """Queued spans for one tenant (0 when it never offered) — the
+        demotion plane's skip-if-queued check."""
+        return self._tenant_backlog.get(tenant_id, 0)
+
+    def spec_table_nbytes(self) -> int:
+        """Exact resident bytes of the registered-fleet spec table —
+        the census admission plane's per-REGISTERED price."""
+        return self.specs.nbytes()

@@ -257,6 +257,21 @@ class ShardSupervisor:
             tenants[tid] = (snapshot_replay(rep),
                             snapshot_detector(det)
                             if det is not None else None)
+        tier = getattr(eng, "_tier", None)
+        if tier is not None:
+            # demoted tenants are fleet state too: a tenant demoted
+            # before this checkpoint and promoted (then scored) after
+            # it must restore from ITS state, not re-derive from zero.
+            # Warm snapshots ride by reference (immutable after
+            # demotion), cold entries by content-address key (the
+            # store is append-only); the detector's host bookkeeping
+            # is COPIED — it mutates again the moment the tenant
+            # promotes and scores
+            for tid in tier.tids():
+                det = tier.ckpt_det(tid)
+                tenants[tid] = (tier.ckpt_snap(tid),
+                                snapshot_detector(det)
+                                if det is not None else None)
         books = [r.book_snapshot() for r in eng._runners]
         self._ckpt = _Checkpoint(eng.clock.ticks, tenants, books)
         self._log = []
@@ -386,6 +401,16 @@ class ShardSupervisor:
         and install the checkpoint snapshot through the state seams."""
         eng = self.engine
         rep_snap, det_snap = snap
+        tier = getattr(eng, "_tier", None)
+        if tier is not None:
+            # the checkpoint view supersedes any live tier entry
+            # (demoted before OR after the snapshot): the restore
+            # rebuilds the tenant RESIDENT and the re-executed log
+            # advances that state — a stale entry left behind would
+            # shadow it at the tenant's next scoring gate
+            tier.discard(tid)
+            if "__tier_cold__" in rep_snap:
+                rep_snap = tier.load_cold(rep_snap["__tier_cold__"])
         rep = eng._replay_for(tid)
         restore_replay(rep, rep_snap)
         if det_snap is not None:

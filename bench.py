@@ -379,6 +379,62 @@ def serve_main(probe_fresh=False) -> int:
             set_registry(Registry(enabled=True))
             from anomod.obs.census import fleet_probe
             census_sweep = fleet_probe()
+            # the TIERING legs (ISSUE-19): (a) the registered-fleet
+            # sweep re-run with the tenant-state tiering plane ON —
+            # the same ~1e3-hot traffic against up to 1e6 REGISTERED
+            # tenants, the O(hot-set) curve the committed PR-15
+            # O(registered) baseline must collapse to (`anomod census
+            # diff OLD NEW` judges the pair); (b) a sub-capacity
+            # tiered-vs-never-evicted parity pair on the same seed —
+            # sub-capacity because the power-law tail must idle whole
+            # ticks for the decay plane to demote at all (an
+            # overloaded fleet keeps every tenant backlogged and the
+            # anti-thrash exclusion never fires); the tiny warm budget
+            # pushes most demotions through the content-addressed
+            # disk cold tier, so the counters below evidence all four
+            # event legs (warm demote, cold spill, promote, miss) and
+            # the prefetch lane.  Own registries throughout.
+            import tempfile as _tempfile
+            set_registry(Registry(enabled=True))
+            # one extra 10x-the-max top point past the untiered sweep:
+            # on the default 1e3/1e4/1e5 sweep that is the committed
+            # capture's 1e6-registered / 1e3-hot mode; a down-sized
+            # ANOMOD_CENSUS_SWEEP (the bench contract test) scales the
+            # same shape without the minute-class top row
+            _tier_sizes = [*census_sweep["sizes"],
+                           10 * max(census_sweep["sizes"])]
+            tiered_sweep = fleet_probe(
+                sizes=_tier_sizes,
+                tier_hot=1_000, tier_demote_after=2)
+            tier_kw = dict(
+                n_tenants=48, n_services=8,
+                capacity_spans_per_s=800.0, overload=0.5,
+                duration_s=24.0, tick_s=1.0, seed=7, window_s=5.0,
+                baseline_windows=2, fault_tenants=2,
+                buckets=(64, 256), lane_buckets=(1, 2, 4),
+                max_backlog=6400, n_windows=16)
+            set_registry(Registry(enabled=True))
+            eng_toff, rep_toff = run_power_law(shards=1, **tier_kw)
+            with _tempfile.TemporaryDirectory() as _tier_cold:
+                set_registry(Registry(enabled=True))
+                eng_ton, rep_ton = run_power_law(
+                    shards=1, tier_hot=12, tier_demote_after=2,
+                    tier_warm_bytes=4096, tier_cold_dir=_tier_cold,
+                    tier_prefetch=2, **tier_kw)
+                _tier_left = len(eng_ton._tier)
+                _tier_joins = eng_ton._tier.prefetch_joins
+            # the same-config rerun: a deferred cold fold legitimately
+            # moves spans one tick later, so the tiered journal is NOT
+            # tick-for-tick equal to the never-evicted twin's — the
+            # journal determinism pin is instead that the SAME tiered
+            # config replays byte-identically (what `anomod audit
+            # replay` relies on)
+            with _tempfile.TemporaryDirectory() as _tier_cold2:
+                set_registry(Registry(enabled=True))
+                eng_ton2, rep_ton2 = run_power_law(
+                    shards=1, tier_hot=12, tier_demote_after=2,
+                    tier_warm_bytes=4096, tier_cold_dir=_tier_cold2,
+                    tier_prefetch=2, **tier_kw)
             # the LIVE-FEED leg (ISSUE-18): the closed telemetry loop —
             # an embedded /metrics endpoint serving THIS process's
             # registry, scraped by LiveFeed into the serve tick,
@@ -889,6 +945,63 @@ def serve_main(probe_fresh=False) -> int:
                 "shed_identical":
                     rep_cen.shed_fraction == rep.shed_fraction,
                 "journal_canonical_identical": _cn_journal_ok,
+            },
+        }
+        # state tiering (ISSUE-19): the tiered registered-fleet sweep
+        # (device hot pool → host warm tier → content-addressed disk
+        # cold tier) beside the untiered census baseline above, the
+        # demote/spill/promote/miss counters and prefetch-hidden
+        # fraction from the sub-capacity parity pair, and the parity
+        # bits — the capture's own proof that tiering moved only
+        # resident bytes and wall-clock, never a scored byte.  The
+        # journal bit compares the tiered run against its SAME-config
+        # rerun (deferred cold folds move tick placement vs the
+        # never-evicted twin, deterministically — that determinism IS
+        # the audit-replay pin).
+        _tr_alerts_same, _tr_states_same = _engines_identical(
+            eng_toff, eng_ton)
+        _tr_journal_ok = None
+        if eng_ton.flight_recorder is not None \
+                and eng_ton2.flight_recorder is not None:
+            _tr_journal_ok = _diff_journals(
+                eng_ton.flight_recorder.journal(),
+                eng_ton2.flight_recorder.journal()) is None
+        out["tiering"] = {
+            "tier_hot": rep_ton.tier_hot,
+            "sweep": tiered_sweep,
+            # the committed-baseline collapse, restated locally: the
+            # tiered sweep's deterministic bytes slope vs THIS
+            # capture's untiered sweep (the cross-capture judgement —
+            # 384 B/registered on the PR-15 curve — is `anomod census
+            # diff OLD NEW`'s job)
+            "bytes_slope_per_registered":
+                tiered_sweep["bytes_slope_per_registered"],
+            "wall_slope_s_per_registered":
+                tiered_sweep["wall_slope_s_per_registered"],
+            "baseline_bytes_slope_per_registered":
+                census_sweep["bytes_slope_per_registered"],
+            "counters": {
+                "demotions_warm": rep_ton.n_tier_demotions_warm,
+                "demotions_cold": rep_ton.n_tier_demotions_cold,
+                "promotions": rep_ton.n_tier_promotions,
+                "tier_misses": rep_ton.n_tier_misses,
+            },
+            "prefetch_hidden": rep_ton.tier_prefetch_hidden,
+            "prefetch_joins": _tier_joins,
+            "prefetch_hidden_fraction": round(
+                rep_ton.tier_prefetch_hidden / max(_tier_joins, 1), 4),
+            "tier_wall_s": rep_ton.tier_wall_s,
+            "tier_empty_at_end": _tier_left == 0,
+            "parity": {
+                "alerts_identical": _tr_alerts_same,
+                "states_identical": _tr_states_same,
+                "p99_identical": rep_ton.latency.get("p99_latency_s")
+                == rep_toff.latency.get("p99_latency_s"),
+                "shed_identical":
+                    rep_ton.shed_fraction == rep_toff.shed_fraction,
+                "served_identical":
+                    rep_ton.served_spans == rep_toff.served_spans,
+                "journal_rerun_identical": _tr_journal_ok,
             },
         }
         # live-feed loop (ISSUE-18): closed-loop self-scrape throughput,

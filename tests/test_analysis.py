@@ -233,7 +233,15 @@ CANONICAL_REPORT_FIELDS = (
     # (every served tick defers except the forced-sync checkpoint
     # cadence), so both are parity-checked; the hidden-wait wall
     # (commit_defer_wall_s) lives on SHARD_VARIANT_REPORT_FIELDS
-    "async_commit", "async_ticks")
+    "async_commit", "async_ticks",
+    # state tiering (ISSUE-19): the hot capacity is config and every
+    # demote/spill/promote/miss count is a pure function of
+    # seed+config (the deferral is deterministic, never wall-clock —
+    # pinned in tests/test_serve_tiering.py); the prefetch-hidden
+    # count and the tier wall are wall-clock telemetry and live on
+    # SHARD_VARIANT_REPORT_FIELDS
+    "tier_hot", "n_tier_demotions_warm", "n_tier_demotions_cold",
+    "n_tier_promotions", "n_tier_misses")
 
 
 def test_canonical_report_inventory_pinned():

@@ -385,6 +385,44 @@ def test_bench_serve_mode_contract(tmp_path):
     cen_diff = diff_census(out, json.loads(json.dumps(out)))
     assert cen_diff["status"] == "ok"
     assert cen_diff["sweep_comparable"] is True
+    # state-tiering block (ISSUE-19): the tiered registered-fleet
+    # sweep (one extra 10x top point past the census sweep — the
+    # committed capture's 1e6-registered / 1e3-hot mode), the
+    # demote/spill/promote/miss counters and prefetch-hidden fraction
+    # from the sub-capacity parity pair, and the parity bits — every
+    # decision plane identical to the never-evicted twin, the journal
+    # byte-equal across the same-config rerun
+    tr = out["tiering"]
+    assert tr["tier_hot"] > 0
+    tsw = tr["sweep"]
+    assert tsw["sizes"] == sweep["sizes"] + [10 * max(sweep["sizes"])]
+    assert len(tsw["rows"]) == len(tsw["sizes"])
+    assert all(r["pool_reconciled"] is True for r in tsw["rows"])
+    assert tr["bytes_slope_per_registered"] \
+        == tsw["bytes_slope_per_registered"]
+    assert tr["bytes_slope_per_registered"] > 0
+    assert tr["baseline_bytes_slope_per_registered"] \
+        == sweep["bytes_slope_per_registered"]
+    # tiering must never COST resident bytes per registered tenant
+    assert tr["bytes_slope_per_registered"] \
+        <= tr["baseline_bytes_slope_per_registered"]
+    assert "wall_slope_s_per_registered" in tr
+    ctr = tr["counters"]
+    assert ctr["demotions_warm"] >= 1
+    assert ctr["demotions_cold"] >= 1
+    assert ctr["promotions"] >= 1
+    assert ctr["tier_misses"] >= 1
+    assert tr["prefetch_joins"] >= 1
+    assert 0.0 <= tr["prefetch_hidden_fraction"] <= 1.0
+    assert tr["tier_wall_s"] >= 0
+    assert tr["tier_empty_at_end"] is True
+    par = tr["parity"]
+    assert par["alerts_identical"] is True
+    assert par["states_identical"] is True
+    assert par["p99_identical"] is True
+    assert par["shed_identical"] is True
+    assert par["served_identical"] is True
+    assert par["journal_rerun_identical"] is True
     # elasticity block (ISSUE-13): the policy leg under the scripted
     # surge must complete a full scaling episode (>=1 up AND >=1 down)
     # and carry the elastic determinism parity bits — byte-identical
@@ -436,6 +474,7 @@ def test_pre_bench_exit_codes_named_and_unique():
         "EXIT_LINT": 9, "EXIT_POLICY_DIVERGENCE": 10,
         "EXIT_PERF_DIVERGENCE": 11, "EXIT_CENSUS_DIVERGENCE": 12,
         "EXIT_ASYNC_DIVERGENCE": 13, "EXIT_FEED_DIVERGENCE": 14,
+        "EXIT_TIERING_DIVERGENCE": 15,
     }
     # every literal return in the gate's source goes through a constant
     src = (Path(__file__).parent.parent / "scripts"

@@ -121,10 +121,11 @@ def test_census_planes_schema_and_reconciliation(census_pair):
     last = docs[-1]
     order = [(p["shard"], p["plane"]) for p in last["planes"]]
     assert order == sorted(order)
-    # CENSUS_PLANES is the one plane inventory: this RCA-off run emits
-    # exactly the other six planes, and nothing outside the inventory
+    # CENSUS_PLANES is the one plane inventory: this RCA-off,
+    # tiering-off run emits exactly the other planes, and nothing
+    # outside the inventory
     assert {p["plane"] for p in last["planes"]} \
-        == set(CENSUS_PLANES) - {"rca"}
+        == set(CENSUS_PLANES) - {"rca", "tier"}
     assert last["pool_reconciled"] is True
     by_plane = {}
     for p in last["planes"]:

@@ -476,7 +476,10 @@ def test_env_contract_script_passes_on_repo():
     assert r.returncode == 0, r.stdout + r.stderr
     out = json.loads(r.stdout)
     assert out["status"] == "ok"
-    assert out["n_vars"] >= 25            # the real inventory is scanned
+    # the PINNED inventory size: a new ANOMOD_* knob must land here and
+    # in docs/CONFIGURATION.md in the same PR (ISSUE-19 took it to 76
+    # with the five ANOMOD_SERVE_TIER_* knobs)
+    assert out["n_vars"] == 76
 
 
 def test_env_contract_script_catches_rogue_var(tmp_path):

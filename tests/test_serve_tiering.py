@@ -1,0 +1,285 @@
+"""Million-tenant state tiering: device hot pool → host warm tier →
+content-addressed disk cold tier (anomod.serve.tiering, ISSUE-19).
+
+The central pin: a tiered run — tenants demoting out of the device
+pool on idle decay, spilling to disk past the warm budget, re-admitting
+transparently on their next span — produces states, alerts, SLO and
+shed BYTE-identical to the never-evicted run of the same seed, with
+the tier empty at run end (the promote-all settlement).  Cold
+promotion defers exactly one tick as a counted, journaled
+``tier_miss`` (never a blocking read in the hot loop), so every tier
+decision is a function of seed+config alone: same-config reruns are
+pinned byte-equal on the canonical journal AND the tiering event
+stream.  The cold tier's publish-before-drop protocol is pinned
+crash-safe, and tiering composes with the PR-13 migration seam.
+"""
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from anomod.obs.flight import canonical_ticks, state_digest
+from anomod.serve.engine import (SHARD_VARIANT_REPORT_FIELDS, ServeEngine,
+                                 run_power_law)
+
+#: the compact seeded scenario: SUB-capacity (overload 0.5), because
+#: the power-law tail must go idle whole ticks for the decay plane to
+#: demote at all — an overloaded fleet keeps every tenant backlogged
+#: and the anti-thrash exclusion never fires
+KW = dict(n_tenants=24, n_services=4, capacity_spans_per_s=400,
+          overload=0.4, duration_s=24, tick_s=1.0, seed=7,
+          window_s=5.0, baseline_windows=2, fault_tenants=0,
+          buckets=(64, 256), lane_buckets=(1, 2, 4), max_backlog=1500,
+          n_windows=16, flight_digest_every=4)
+
+#: a hot capacity well under the fleet and a warm budget under one
+#: state slot: every demotion spills cold, so all four event legs
+#: (warm demote, cold spill, promote, miss) fire several times per
+#: run (9 each on this seed — enough that the crash test still has
+#: spills left AFTER its killed one)
+TIER_KW = dict(tier_hot=4, tier_demote_after=2, tier_warm_bytes=4096,
+               tier_prefetch=2)
+
+#: report fields that legitimately differ between a tiered and a
+#: never-evicted leg: the tiering config + its canonical counters
+#: (everything else in the report must match byte-for-byte)
+TIERING_REPORT_FIELDS = ("tier_hot", "n_tier_demotions_warm",
+                         "n_tier_demotions_cold", "n_tier_promotions",
+                         "n_tier_misses")
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    """ONE never-evicted reference run of the module scenario."""
+    return run_power_law(**KW)
+
+
+def run_tiered(cold_dir, **overrides):
+    kw = dict(KW, **TIER_KW, tier_cold_dir=str(cold_dir))
+    kw.update(overrides)
+    return run_power_law(**kw)
+
+
+def assert_tier_parity(oracle, eng, rep, extra_skip=()):
+    """Byte-identical tenant states + alert streams, identical
+    SLO/shed/served, report equal outside the declared tiering and
+    shard-variant fields."""
+    ref_eng, ref_rep = oracle
+    assert sorted(eng._tenant_det) == sorted(ref_eng._tenant_det)
+    for tid in sorted(ref_eng._tenant_det):
+        assert [dataclasses.asdict(a) for a in ref_eng.alerts_for(tid)] \
+            == [dataclasses.asdict(a) for a in eng.alerts_for(tid)], \
+            f"tenant {tid} alert stream diverges under tiering"
+    assert state_digest(ref_eng._tenant_replay) \
+        == state_digest(eng._tenant_replay)
+    skip = set(SHARD_VARIANT_REPORT_FIELDS) \
+        | set(TIERING_REPORT_FIELDS) | set(extra_skip)
+    # the one-tick deferral moves WHICH tick a parked batch scores in,
+    # so the fused lane packing regroups around it: per-width dispatch
+    # counts are a dispatch-topology artifact (content conserved — the
+    # state/alert/SLO planes above are byte-checked; same-config reruns
+    # pin it deterministic in the journal test)
+    skip.add("dispatches_by_width")
+    a = {k: v for k, v in ref_rep.to_dict().items() if k not in skip}
+    b = {k: v for k, v in rep.to_dict().items() if k not in skip}
+    assert a == b, sorted(k for k in a if a[k] != b[k])
+
+
+def test_tiered_run_byte_identical_to_never_evicted(oracle, tmp_path):
+    """The headline parity pin — and the tier actually worked for it:
+    warm demotions, cold spills, promotions and misses all fired, the
+    prefetch lane carried every cold fetch, and the run-end settlement
+    left the tier empty."""
+    eng, rep = run_tiered(tmp_path / "cold")
+    assert rep.tier_hot == TIER_KW["tier_hot"]
+    assert rep.n_tier_demotions_warm > 0
+    assert rep.n_tier_demotions_cold > 0
+    assert rep.n_tier_promotions > 0
+    assert rep.n_tier_misses > 0
+    assert len(eng._tier) == 0, "promote-all settlement left tenants tiered"
+    # the cold tier is real: content-addressed payloads were published
+    assert list((tmp_path / "cold").rglob("*.npc"))
+    assert_tier_parity(oracle, eng, rep)
+
+
+def test_tier_events_journaled_and_rerun_deterministic(tmp_path):
+    """Every demote/spill/promote/miss is flight-journaled (the
+    ``tiering`` variant key `anomod audit replay` reconstructs from),
+    the journaled stream reconciles exactly with the report counters,
+    a deferred promote lands one tick after its miss — and because the
+    deferral is deterministic (never wall-clock), a same-config rerun
+    reproduces the canonical journal AND the event stream byte-equal,
+    prefetch timing notwithstanding."""
+    eng_a, rep_a = run_tiered(tmp_path / "cold_a")
+    eng_b, rep_b = run_tiered(tmp_path / "cold_b")
+    recs = eng_a.flight_recorder.records()
+    events = [ev for rec in recs for ev in rec["tiering"]]
+    by_kind = {}
+    for ev in events:
+        by_kind.setdefault((ev["kind"], ev.get("tier")), []).append(ev)
+
+    def of(kind, tier=None):
+        return by_kind.get((kind, tier), [])
+
+    assert len(of("demote", "warm")) == rep_a.n_tier_demotions_warm
+    assert len(of("demote", "cold")) == rep_a.n_tier_demotions_cold
+    assert len(of("promote", "warm")) + len(of("promote", "cold")) \
+        == rep_a.n_tier_promotions
+    assert len(of("miss")) == rep_a.n_tier_misses
+    # one miss ↔ one deferred promote, exactly one tick later (the
+    # run-end settlement's promotes are the non-deferred remainder)
+    deferred = [ev for tier in ("warm", "cold")
+                for ev in of("promote", tier) if ev["deferred"]]
+    missed = {(ev["tenant"], ev["tick"]) for ev in of("miss")}
+    assert len(deferred) == len(missed)
+    for ev in deferred:
+        assert (ev["tenant"], ev["tick"] - 1) in missed
+    # the replay-determinism pin: same config, byte-equal journal and
+    # byte-equal event stream (misses included — the deferral never
+    # consults wall clock)
+    assert canonical_ticks(recs) \
+        == canonical_ticks(eng_b.flight_recorder.records())
+    assert events == [ev for rec in eng_b.flight_recorder.records()
+                      for ev in rec["tiering"]]
+    assert (rep_a.n_tier_demotions_warm, rep_a.n_tier_demotions_cold,
+            rep_a.n_tier_promotions, rep_a.n_tier_misses) \
+        == (rep_b.n_tier_demotions_warm, rep_b.n_tier_demotions_cold,
+            rep_b.n_tier_promotions, rep_b.n_tier_misses)
+
+
+def test_cold_tier_crash_between_tmp_write_and_rename(
+        oracle, tmp_path, monkeypatch):
+    """A kill between the cold entry's tmp write and its rename leaves
+    NO torn published file: the publish-before-drop protocol keeps the
+    victim warm (its host copy is only dropped after the rename lands),
+    the orphaned ``.tmp`` is never read, the next demotion re-derives
+    the spill cleanly, and the run's decisions stay byte-identical."""
+    import anomod.io.cache as io_cache
+    real_replace = io_cache.os.replace
+    killed = {"n": 0}
+
+    def killing_replace(src, dst):
+        if str(dst).endswith(".npc") and killed["n"] == 0:
+            killed["n"] += 1
+            raise OSError("simulated kill between tmp write and rename")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(io_cache.os, "replace", killing_replace)
+    cold = tmp_path / "cold"
+    eng, rep = run_tiered(cold)
+    assert killed["n"] == 1                  # the kill actually fired
+    # the torn tmp is still on disk — and every PUBLISHED payload is
+    # whole (the reader never opens tmp paths)
+    assert list(cold.rglob("*.tmp"))
+    from anomod.io.cache import _read_payload
+    published = list(cold.rglob("*.npc"))
+    assert published                          # later spills re-derived
+    for p in published:
+        _read_payload(p.read_bytes())         # raises on any torn file
+    assert rep.n_tier_demotions_cold > 0
+    assert len(eng._tier) == 0
+    assert_tier_parity(oracle, eng, rep)
+
+
+def test_tiering_composed_with_migration_seam(tmp_path):
+    """Tiering × the PR-13 migration seam: a 2-shard supervised tiered
+    run whose shard 0 dies past the respawn budget migrates its tenants
+    (demoted ones included — the checkpoint covers tier entries, warm
+    by reference and cold by content address) to the survivor, where
+    they re-admit and keep scoring — byte-identical to the fault-free
+    never-evicted 1-shard run of the same seed (placement invariance ×
+    tiering parity × recovery, one oracle)."""
+    ref_eng, ref_rep = run_power_law(**KW)
+    eng, rep = run_tiered(
+        tmp_path / "cold", shards=2, pipeline=2, ckpt_every=4,
+        retries=2, max_respawns=1,
+        chaos=";".join(f"crash@{t}:shard=0:phase=stage:repeat=-1"
+                       for t in range(10, 24)))
+    assert rep.n_migrated_tenants > 0
+    assert rep.n_tier_demotions_warm > 0
+    assert rep.n_tier_demotions_cold > 0
+    assert rep.n_tier_promotions > 0
+    assert len(eng._tier) == 0
+    for tid in sorted(ref_eng._tenant_det):
+        assert [dataclasses.asdict(a) for a in ref_eng.alerts_for(tid)] \
+            == [dataclasses.asdict(a) for a in eng.alerts_for(tid)], \
+            f"tenant {tid} alert stream diverges (tiering × migration)"
+    assert state_digest(ref_eng._tenant_replay) \
+        == state_digest(eng._tenant_replay)
+    assert rep.latency == ref_rep.latency
+    assert rep.shed_fraction == ref_rep.shed_fraction
+    assert rep.served_spans == ref_rep.served_spans
+
+
+def test_tier_knobs_validated(monkeypatch):
+    """Every ANOMOD_SERVE_TIER_* knob fails loud on garbage with the
+    pinned message, and the engine refuses nonsense kwargs."""
+    from anomod.config import Config
+    for var, bad, msg in (
+            ("ANOMOD_SERVE_TIER_HOT", "-1", r"must be >= 0, got -1"),
+            ("ANOMOD_SERVE_TIER_HOT", "lots",
+             r"non-negative integer.*'lots'"),
+            ("ANOMOD_SERVE_TIER_DEMOTE_AFTER", "0", r"must be >= 1"),
+            ("ANOMOD_SERVE_TIER_DEMOTE_AFTER", "soon",
+             r"positive.*'soon'"),
+            ("ANOMOD_SERVE_TIER_WARM_BYTES", "-4096",
+             r"must be >= 0, got -4096"),
+            ("ANOMOD_SERVE_TIER_PREFETCH", "0", r"in \[1, 256\], got 0"),
+            ("ANOMOD_SERVE_TIER_PREFETCH", "many",
+             r"positive integer.*'many'")):
+        monkeypatch.setenv(var, bad)
+        with pytest.raises(ValueError, match=msg):
+            Config()
+        monkeypatch.delenv(var)
+    cfg = Config()
+    assert cfg.serve_tier_hot == 0            # tiering off by default
+    assert cfg.serve_tier_demote_after == 8
+    assert cfg.serve_tier_warm_bytes == 64 * 1024 * 1024
+    assert cfg.serve_tier_cold_dir is None
+    assert cfg.serve_tier_prefetch == 4
+    from anomod.replay import ReplayConfig
+    rcfg = ReplayConfig(n_services=1)
+    with pytest.raises(ValueError, match="tier_hot"):
+        ServeEngine([], ["a"], rcfg, tier_hot=-1)
+    with pytest.raises(ValueError, match="tier_demote_after"):
+        ServeEngine([], ["a"], rcfg, tier_hot=4, tier_demote_after=0)
+    with pytest.raises(ValueError, match="tier_prefetch"):
+        ServeEngine([], ["a"], rcfg, tier_hot=4, tier_prefetch=0)
+
+
+def test_tiering_refused_on_uncovered_planes(monkeypatch):
+    """The policy idiom: an EXPLICIT tiering request on a plane the
+    demotion copier cannot cover (the deferred-commit tick) is refused
+    with the reason; the env-derived default silently degrades to
+    untiered instead."""
+    from anomod.replay import ReplayConfig
+    rcfg = ReplayConfig(n_services=1)
+    with pytest.raises(ValueError, match="deferred-commit"):
+        ServeEngine([], ["a"], rcfg, tier_hot=4, async_commit=True)
+    monkeypatch.setenv("ANOMOD_SERVE_TIER_HOT", "4")
+    eng = ServeEngine([], ["a"], rcfg, async_commit=True)
+    assert eng.tier_hot == 0 and eng._tier is None
+    eng.close()
+
+
+def test_tier_misses_deferred_exactly_one_tick_and_counted(tmp_path):
+    """The stall-free contract, mechanized: every cold promotion rides
+    the prefetch lane and defers exactly one tick (asserted per-event
+    in the journal test above); here the miss COUNT is pinned equal to
+    the number of deferred promotes and bounded by cold demotions —
+    a tenant never parks longer than one tick."""
+    eng, rep = run_tiered(tmp_path / "cold")
+    events = [ev for rec in eng.flight_recorder.records()
+              for ev in rec["tiering"]]
+    deferred = [ev for ev in events
+                if ev["kind"] == "promote" and ev["deferred"]]
+    assert rep.n_tier_misses == len(deferred)
+    assert rep.n_tier_misses > 0
+    # nothing parks at run end, and nothing ever parked twice: each
+    # miss's tenant promoted at the very next tick
+    assert not eng._tier_parked
+    misses = {(ev["tenant"], ev["tick"]) for ev in events
+              if ev["kind"] == "miss"}
+    assert {(ev["tenant"], ev["tick"] - 1) for ev in deferred} == misses
