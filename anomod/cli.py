@@ -435,6 +435,25 @@ def main(argv=None) -> int:
                          help="force the synchronous tick (the parity "
                               "oracle) even when "
                               "ANOMOD_SERVE_ASYNC_COMMIT is on")
+    p_serve.add_argument("--worker", choices=["thread", "process"],
+                         default=None,
+                         help="shard worker engine: thread = in-process "
+                              "shard threads (the byte-parity oracle); "
+                              "process = spawn-context worker processes "
+                              "owning their shard's detectors/replays/"
+                              "runner — escapes the GIL; states/alerts/"
+                              "SLO/shed and the canonical flight journal "
+                              "byte-identical to the thread engine "
+                              "(default: ANOMOD_SERVE_WORKER)")
+    p_serve.add_argument("--fold", choices=["dense", "sparse"],
+                         default=None,
+                         help="per-tick cross-shard registry barrier "
+                              "fold: sparse = touched-key deltas "
+                              "combined through a deterministic binary "
+                              "fold tree; dense = full-walk snapshots "
+                              "(the parity oracle) — scrape output "
+                              "byte-identical either way (default: "
+                              "ANOMOD_SERVE_FOLD)")
     p_serve.add_argument("--native-drain",
                          choices=["auto", "on", "off"], default=None,
                          help="columnar SFQ drain/shed engine for the "
@@ -1135,6 +1154,15 @@ def main(argv=None) -> int:
                          "runner issue/commit seam; --devices runs "
                          "with the synchronous tick "
                          "(drop --async-commit)")
+        if args.devices and args.worker == "process":
+            # only an EXPLICIT --worker process conflicts hard; an
+            # env-sourced ANOMOD_SERVE_WORKER=process degrades to the
+            # thread engine at the engine (the mesh plane owns its own
+            # device-sharded dispatch), so existing --devices workflows
+            # keep working under a globally exported knob
+            parser.error("the mesh plane shards across devices inside "
+                         "one process; --devices runs with the thread "
+                         "worker engine (drop --worker process)")
         if args.chaos:
             from anomod.config import validate_chaos_script
             try:
@@ -1185,6 +1213,8 @@ def main(argv=None) -> int:
                               ("--policy", args.policy),
                               ("--policy-script", args.policy_script),
                               ("--async-commit", args.async_commit),
+                              ("--worker", args.worker),
+                              ("--fold", args.fold),
                               ("--state", args.state),
                               ("--ckpt-every", args.ckpt_every),
                               ("--trace-out", args.trace_out),
@@ -1275,6 +1305,7 @@ def main(argv=None) -> int:
             async_commit=(True if args.async_commit
                           else (False if args.no_async_commit
                                 else None)),
+            worker=args.worker, fold=args.fold,
             native_drain=args.native_drain,
             # --no-score forces RCA off even when ANOMOD_SERVE_RCA=1
             # (the explicit CLI ask wins over the env default; the
@@ -1676,6 +1707,11 @@ def main(argv=None) -> int:
             # under the replaying process's env knobs — env drift must
             # not masquerade as plane divergence
             kw.setdefault("tier_hot", 0)
+            # likewise pre-procshard journals carry no worker/fold
+            # keys: replay them on the thread engine with the dense
+            # fold, never under the replaying process's env knobs
+            kw.setdefault("worker", "thread")
+            kw.setdefault("fold", "dense")
             eng, rep = run_power_law(**kw)
         doc = eng.flight_recorder.dump(args.out)
         print(json.dumps({

@@ -331,6 +331,72 @@ def _serve_native_drain_env() -> str:
         f"got {raw!r}")
 
 
+def _serve_worker_env() -> str:
+    """ANOMOD_SERVE_WORKER: the serving plane's shard-worker kind
+    (anomod.serve.shard / anomod.serve.procshard).
+
+    ``thread`` (the default) is the PR-5 in-process worker — shared
+    memory, GIL-bound, the byte-parity oracle.  ``process`` hosts each
+    shard's scoring plane (detectors, replays, BucketRunner, RCA plane,
+    obs registry) in a spawn-context worker PROCESS driven by a
+    picklable per-tick command protocol — the GIL leaves the dispatch
+    path entirely.  States, alerts, SLO, shed and the canonical flight
+    journal are pinned byte-identical across the two (and across
+    process counts); only wall attribution moves.  Validated here so a
+    typo fails at config construction, not after a fleet spawn.
+    """
+    raw = _env("ANOMOD_SERVE_WORKER", "thread").strip().lower()
+    if raw in ("thread", ""):
+        return "thread"
+    if raw == "process":
+        return "process"
+    raise ValueError(
+        f"ANOMOD_SERVE_WORKER must be thread or process, got {raw!r}")
+
+
+def _serve_worker_start_timeout_s_env() -> float:
+    """ANOMOD_SERVE_WORKER_START_TIMEOUT_S: how long the coordinator
+    waits for a spawned process worker's ready handshake (spawn +
+    imports + sub-plane construction) before failing the run loudly.
+    Generous default — a cold jax import on a busy box is slow — but
+    bounded, so a wedged child can never hang a serve run forever."""
+    raw = _env("ANOMOD_SERVE_WORKER_START_TIMEOUT_S", "120")
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"ANOMOD_SERVE_WORKER_START_TIMEOUT_S must be a number, "
+            f"got {raw!r}")
+    if not 1 <= v <= 3600:
+        raise ValueError(
+            f"ANOMOD_SERVE_WORKER_START_TIMEOUT_S must be in [1, 3600], "
+            f"got {v}")
+    return v
+
+
+def _serve_fold_env() -> str:
+    """ANOMOD_SERVE_FOLD: the tick barrier's cross-shard registry merge
+    mode (anomod.obs.registry.Registry.delta_snapshot).
+
+    ``sparse`` (the default) serializes only families TOUCHED since the
+    previous barrier — Zipf traffic leaves most families idle most
+    ticks, so barrier payload follows active tenants, not registered
+    fleet size (the Sparse Allreduce observation, PAPERS.md).  ``dense``
+    walks and serializes every registered family every barrier — the
+    payload-accounting oracle the sparse win is measured against.  The
+    two are pinned byte-identical on every scrape surface; only
+    ``fold_payload_bytes`` moves.  Validated here so a typo fails at
+    config construction.
+    """
+    raw = _env("ANOMOD_SERVE_FOLD", "sparse").strip().lower()
+    if raw in ("sparse", ""):
+        return "sparse"
+    if raw == "dense":
+        return "dense"
+    raise ValueError(
+        f"ANOMOD_SERVE_FOLD must be dense or sparse, got {raw!r}")
+
+
 def _serve_rca_env() -> bool:
     """ANOMOD_SERVE_RCA: online root-cause inference in the serve tick.
 
@@ -1360,6 +1426,18 @@ class Config:
     # work, decisions pinned byte-identical either way).
     serve_async_commit: bool = dataclasses.field(
         default_factory=_serve_async_commit_env)
+    # ANOMOD_SERVE_WORKER — shard-worker kind: thread (in-process, the
+    # byte-parity oracle) or process (spawn-context worker processes
+    # behind the same submit/join seam; anomod.serve.procshard).
+    serve_worker: str = dataclasses.field(default_factory=_serve_worker_env)
+    # ANOMOD_SERVE_WORKER_START_TIMEOUT_S — process-worker ready
+    # handshake deadline in seconds (spawn + imports + plane build).
+    serve_worker_start_timeout_s: float = dataclasses.field(
+        default_factory=_serve_worker_start_timeout_s_env)
+    # ANOMOD_SERVE_FOLD — tick-barrier registry merge mode: sparse
+    # (touched-family deltas, payload follows active tenants) or dense
+    # (full-registry walk, the payload oracle; anomod.obs.registry).
+    serve_fold: str = dataclasses.field(default_factory=_serve_fold_env)
     # ANOMOD_SERVE_NATIVE_DRAIN — SFQ drain/shed engine: auto (columnar,
     # native kernels when the .so loads, NumPy fallback), on (native
     # required, fail loud), off (the Python heap parity oracle;

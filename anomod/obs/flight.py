@@ -130,6 +130,85 @@ def state_digest(replays: Dict[int, object], prev: int = 0) -> int:
     return crc
 
 
+def _gf2_matrix_times(mat: List[int], vec: int) -> int:
+    s = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            s ^= mat[i]
+        vec >>= 1
+        i += 1
+    return s
+
+
+def _gf2_matrix_square(mat: List[int]) -> List[int]:
+    return [_gf2_matrix_times(mat, mat[n]) for n in range(32)]
+
+
+def crc32_combine(crc1: int, crc2: int, len2: int) -> int:
+    """zlib's crc32_combine in pure Python: the crc of ``A + B`` from
+    ``crc32(A)``, ``crc32(B)`` and ``len(B)`` alone (GF(2) matrix
+    shift).  This is what lets a worker PROCESS hand the coordinator
+    per-tenant digest fragments — ``(crc, length)`` pairs, a few bytes
+    each — instead of shipping whole state pytrees across the pipe,
+    while the folded digest stays bit-equal to :func:`state_digest`'s
+    sequential walk (pinned in tests/test_serve_procshard.py)."""
+    if len2 <= 0:
+        return crc1 & 0xFFFFFFFF
+    odd = [0xEDB88320]          # CRC-32 polynomial, reflected
+    row = 1
+    for _ in range(31):
+        odd.append(row)
+        row <<= 1
+    even = _gf2_matrix_square(odd)
+    odd = _gf2_matrix_square(even)
+    while True:
+        even = _gf2_matrix_square(odd)
+        if len2 & 1:
+            crc1 = _gf2_matrix_times(even, crc1)
+        len2 >>= 1
+        if len2 == 0:
+            break
+        odd = _gf2_matrix_square(even)
+        if len2 & 1:
+            crc1 = _gf2_matrix_times(odd, crc1)
+        len2 >>= 1
+        if len2 == 0:
+            break
+    return (crc1 ^ crc2) & 0xFFFFFFFF
+
+
+def state_digest_parts(replays: Dict[int, object]) -> List[Tuple[int, int,
+                                                                 int]]:
+    """The worker-side half of :func:`state_digest`: per-tenant
+    ``(tenant_id, chunk_crc, chunk_len)`` fragments over exactly the
+    bytes the sequential walk would consume (prefix + agg + hist).
+    Each fragment is computed where the state lives; the coordinator
+    folds fragments from every shard in global sorted-tenant order with
+    :func:`fold_digest_parts`."""
+    parts = []
+    for tid in sorted(replays):
+        rep = replays[tid]
+        st = rep.get_state() if hasattr(rep, "get_state") else rep.state
+        chunk = (f"{tid}:{getattr(rep, 'window_offset', 0)}"
+                 f":{getattr(rep, 'n_spans', 0)}:".encode()
+                 + np.ascontiguousarray(st.agg).tobytes()
+                 + np.ascontiguousarray(st.hist).tobytes())
+        parts.append((int(tid), crc_bytes(chunk), len(chunk)))
+    return parts
+
+
+def fold_digest_parts(parts: List[Tuple[int, int, int]],
+                      prev: int = 0) -> int:
+    """Coordinator fold of :func:`state_digest_parts` fragments (from
+    any number of shards) into the running digest — bit-equal to
+    :func:`state_digest` over the union of the shards' replays."""
+    crc = prev
+    for _tid, chunk_crc, chunk_len in sorted(parts):
+        crc = crc32_combine(crc, chunk_crc, chunk_len)
+    return crc
+
+
 def config_snapshot() -> dict:
     """The resolved Config as a JSON-able dict (Paths stringified) —
     the header's "what knobs was this run serving under" record."""

@@ -105,11 +105,19 @@ class Counter:
     """Monotone accumulator; ``samples()`` exports the running total."""
 
     kind = "counter"
-    __slots__ = ("name", "labels", "_lock", "_value")
+    __slots__ = ("name", "labels", "rendered", "rev", "_lock", "_value")
 
     def __init__(self, name: str, labels: Dict[str, str]):
         self.name = name
         self.labels = dict(labels)
+        #: rendered-labels cache: computed once at registration, read by
+        #: every scrape/fold instead of re-sorting the label dict per
+        #: metric per barrier (the dense-fold hot spot's fixed half)
+        self.rendered = render_labels(self.labels)
+        #: mutation generation — bumped under the metric lock on every
+        #: write, so a barrier fold can skip families untouched since
+        #: its last visit (Registry.delta_snapshot's dirty check)
+        self.rev = 0
         self._lock = threading.Lock()
         self._value = 0.0
 
@@ -118,6 +126,7 @@ class Counter:
             raise ValueError(f"counter {self.name} cannot decrease")
         with self._lock:
             self._value += n
+            self.rev += 1
 
     @property
     def value(self) -> float:
@@ -131,25 +140,30 @@ class Gauge:
     """Last-value metric with inc/dec convenience."""
 
     kind = "gauge"
-    __slots__ = ("name", "labels", "_lock", "_value")
+    __slots__ = ("name", "labels", "rendered", "rev", "_lock", "_value")
 
     def __init__(self, name: str, labels: Dict[str, str]):
         self.name = name
         self.labels = dict(labels)
+        self.rendered = render_labels(self.labels)
+        self.rev = 0
         self._lock = threading.Lock()
         self._value = 0.0
 
     def set(self, value: float) -> None:
         with self._lock:
             self._value = float(value)
+            self.rev += 1
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
             self._value += n
+            self.rev += 1
 
     def dec(self, n: float = 1.0) -> None:
         with self._lock:
             self._value -= n
+            self.rev += 1
 
     @property
     def value(self) -> float:
@@ -171,12 +185,14 @@ class Histogram:
     """
 
     kind = "histogram"
-    __slots__ = ("name", "labels", "_lock", "_buf", "_digest",
-                 "count", "sum", "_max", "_n_folds", "_q_cache")
+    __slots__ = ("name", "labels", "rendered", "rev", "_lock", "_buf",
+                 "_digest", "count", "sum", "_max", "_n_folds", "_q_cache")
 
     def __init__(self, name: str, labels: Dict[str, str]):
         self.name = name
         self.labels = dict(labels)
+        self.rendered = render_labels(self.labels)
+        self.rev = 0
         self._lock = threading.Lock()
         self._buf: List[float] = []
         self._digest: Optional[TDigest] = None
@@ -197,6 +213,7 @@ class Histogram:
             self.count += 1
             self.sum += v
             self._max = max(self._max, v)
+            self.rev += 1
             if len(self._buf) >= _FOLD_EVERY:
                 self._fold_locked()
 
@@ -215,6 +232,7 @@ class Histogram:
             self._digest = digest if self._digest is None else \
                 tdigest_merge_many([self._digest, digest])
             self._n_folds += 1
+            self.rev += 1
 
     def _fold_locked(self) -> None:
         if not self._buf:
@@ -268,6 +286,7 @@ class Histogram:
             self._max = 0.0
             self._n_folds += 1
             self._q_cache = None
+            self.rev += 1
             return digest
 
     def samples(self) -> List[Tuple[str, float]]:
@@ -362,7 +381,7 @@ class Registry:
             now_s = time.time()
         rows = []
         for m in self.metrics():
-            series = render_labels(m.labels)
+            series = m.rendered
             for sname, val in m.samples():
                 rows.append((float(now_s), sname, series, float(val)))
         # journal mutation belongs under the registry lock (the L501
@@ -406,15 +425,126 @@ class Registry:
             self._journal.clear()
 
     # -- worker-registry fold (the sharded serving plane's seam) -----------
+    #
+    # The barrier merge is split into a picklable DELTA snapshot
+    # (delta_snapshot, taken where the metrics live — a worker thread's
+    # registry in-process, a worker PROCESS's registry across a pipe)
+    # and a coordinator-side APPLY (apply_delta).  fold_from composes
+    # the two, so the thread engine's fold and the process engine's
+    # barrier payload are ONE code path and can never drift.
 
-    def fold_from(self, src: "Registry", state: Dict[Tuple[str, str],
-                                                     float],
-                  shard: Optional[str] = None, final: bool = False) -> None:
-        """Fold a worker-thread registry into this one.
+    def delta_snapshot(self, state: Dict[tuple, float],
+                       mode: str = "sparse", final: bool = False) -> dict:
+        """Serialize this registry's change since ``state`` as a
+        picklable delta — the tick barrier's wire shape.
+
+        ``sparse`` visits every family but SKIPS the ones whose ``rev``
+        generation matches the high-water in ``state`` (untouched since
+        the previous snapshot): the dirty check is two dict probes, so
+        barrier cost follows touched families — O(active tenants'
+        metrics) under Zipf traffic, not registered fleet size.
+        ``dense`` serializes every family every time (the payload
+        oracle the sparse win is measured against): all counters (zero
+        deltas included), all gauges, and every histogram's full
+        current digest snapshot.  Applying either produces the same
+        registry bytes — dense just ships more to say it.
+
+        Histogram entries carry ``(mean, weight)`` centroid arrays.  At
+        ``final=True`` they are DRAINED from the source (move
+        semantics, exactly :meth:`Histogram.drain_digest`) and meant to
+        merge; dense non-final entries are non-draining snapshots that
+        :meth:`apply_delta` deliberately ignores.
+
+        ``state`` is owned by the caller (one dict per source registry)
+        and carries both the counter high-waters — keyed ``(name,
+        rendered_labels)``, the historic fold_from shape — and the rev
+        marks, keyed ``("rev", name, rendered_labels)``.
+        """
+        if mode not in ("sparse", "dense"):
+            raise ValueError(f"unknown fold mode {mode!r} (dense|sparse)")
+        sparse = mode == "sparse"
+        counters: list = []
+        gauges: list = []
+        hists: list = []
+        for m in self.metrics():
+            rkey = ("rev", m.name, m.rendered)
+            if m.kind == "counter":
+                if sparse and state.get(rkey) == m.rev:
+                    continue
+                state[rkey] = m.rev
+                key = (m.name, m.rendered)
+                prev = state.get(key, 0.0)
+                cur = m.value
+                if cur > prev:
+                    state[key] = cur
+                    counters.append((m.name, tuple(sorted(m.labels.items())),
+                                     cur - prev))
+                elif not sparse:
+                    counters.append((m.name, tuple(sorted(m.labels.items())),
+                                     0.0))
+            elif m.kind == "gauge":
+                if sparse and state.get(rkey) == m.rev:
+                    continue
+                state[rkey] = m.rev
+                gauges.append((m.name, tuple(sorted(m.labels.items())),
+                               m.value))
+            elif m.kind == "histogram":
+                if final:
+                    digest = m.drain_digest()
+                    if digest is not None:
+                        hists.append((m.name,
+                                      tuple(sorted(m.labels.items())),
+                                      np.asarray(digest.mean, np.float32),
+                                      np.asarray(digest.weight,
+                                                 np.float32)))
+                elif not sparse:
+                    with m._lock:
+                        m._fold_locked()
+                        digest = m._digest
+                        if digest is not None:
+                            hists.append((
+                                m.name, tuple(sorted(m.labels.items())),
+                                np.asarray(digest.mean, np.float32).copy(),
+                                np.asarray(digest.weight,
+                                           np.float32).copy()))
+        return {"mode": mode, "final": bool(final), "counters": counters,
+                "gauges": gauges, "hists": hists}
+
+    def apply_delta(self, delta: Optional[dict],
+                    shard: Optional[str] = None) -> None:
+        """Fold one :meth:`delta_snapshot` into this registry — the
+        coordinator half of the barrier merge.  Counter entries
+        increment (zero deltas skipped), gauge entries set a
+        ``shard``-labeled twin when ``shard`` is given (a gauge is a
+        per-shard fact), histogram entries merge their centroid sets
+        through :meth:`Histogram.merge_digest` ONLY on a final delta
+        (non-final dense snapshots are informational payload, not
+        mergeable state)."""
+        if delta is None or not self.enabled:
+            return
+        for name, litems, d in delta["counters"]:
+            if d > 0:
+                self.counter(name, **dict(litems)).inc(d)
+        for name, litems, v in delta["gauges"]:
+            labels = dict(litems)
+            if shard is not None:
+                labels["shard"] = shard
+            self.gauge(name, **labels).set(v)
+        if delta["final"]:
+            from anomod.ops.tdigest import TDigest
+            for name, litems, mean, weight in delta["hists"]:
+                self.histogram(name, **dict(litems)).merge_digest(
+                    TDigest(mean=np.asarray(mean, np.float32),
+                            weight=np.asarray(weight, np.float32)))
+
+    def fold_from(self, src: "Registry", state: Dict[tuple, float],
+                  shard: Optional[str] = None, final: bool = False,
+                  mode: str = "sparse") -> Optional[dict]:
+        """Fold a worker registry into this one at the tick barrier.
 
         Each serve shard records its runner's hot-path metrics into its
         OWN registry (zero cross-thread contention per dispatch); the
-        coordinator folds the shards in at the tick barrier:
+        coordinator folds the shards in at the barrier:
 
         - **Counters** increment by the delta since the previous fold
           (``state`` carries the per-metric high-water marks), so the
@@ -427,29 +557,44 @@ class Registry:
           and is then cleared on the source, so repeated final folds
           (an engine run() twice) neither double-count nor drop data.
 
-        No-op when either side is disabled.  The caller owns the
-        quiescence contract: fold at a barrier, with the worker that
-        records into ``src`` idle.
+        ``mode`` selects the snapshot discipline (the validated
+        ANOMOD_SERVE_FOLD value): ``sparse`` (default) skips families
+        untouched since the previous fold via the per-metric ``rev``
+        dirty marks — scrape output is pinned byte-identical to a dense
+        walk, the walk is just cheaper.  Returns the applied delta so
+        barrier callers can account payload bytes (None when either
+        side is disabled).
+
+        The caller owns the quiescence contract: fold at a barrier,
+        with the worker that records into ``src`` idle.
         """
         if not (self.enabled and src.enabled):
-            return
-        for m in src.metrics():
-            key = (m.name, render_labels(m.labels))
-            if m.kind == "counter":
-                prev = state.get(key, 0.0)
-                cur = m.value
-                if cur > prev:
-                    self.counter(m.name, **m.labels).inc(cur - prev)
-                    state[key] = cur
-            elif m.kind == "gauge":
-                labels = dict(m.labels)
-                if shard is not None:
-                    labels["shard"] = shard
-                self.gauge(m.name, **labels).set(m.value)
-            elif m.kind == "histogram" and final:
-                digest = m.drain_digest()
-                if digest is not None:
-                    self.histogram(m.name, **m.labels).merge_digest(digest)
+            return None
+        delta = src.delta_snapshot(state, mode=mode, final=final)
+        self.apply_delta(delta, shard=shard)
+        return delta
+
+
+def delta_nbytes(delta: Optional[dict]) -> int:
+    """Structural payload size of one :meth:`Registry.delta_snapshot`
+    in bytes — key strings at utf-8 length, 8 bytes per float scalar,
+    8 bytes per digest centroid component.  A deterministic accounting
+    (identical on every box and in both worker modes), NOT a pickle
+    length: the sparse-vs-dense win criterion needs exact,
+    box-independent byte counts."""
+    if delta is None:
+        return 0
+    n = 0
+    for name, litems, _ in delta["counters"]:
+        n += len(name.encode()) + 8
+        n += sum(len(k.encode()) + len(str(v).encode()) for k, v in litems)
+    for name, litems, _ in delta["gauges"]:
+        n += len(name.encode()) + 8
+        n += sum(len(k.encode()) + len(str(v).encode()) for k, v in litems)
+    for name, litems, mean, weight in delta["hists"]:
+        n += len(name.encode()) + 8 * (len(mean) + len(weight))
+        n += sum(len(k.encode()) + len(str(v).encode()) for k, v in litems)
+    return n
 
 
 _DEFAULT: Optional[Registry] = None

@@ -197,7 +197,13 @@ SHARD_VARIANT_REPORT_FIELDS = (
     # CANONICAL; whether a cold fetch happened to finish before its
     # one-tick deferral elapsed is wall luck, and the gate+demote wall
     # is a wall measurement — consciously VARIANT
-    "tier_prefetch_hidden", "tier_wall_s")
+    "tier_prefetch_hidden", "tier_wall_s",
+    # the worker plane (ANOMOD_SERVE_WORKER / ANOMOD_SERVE_FOLD):
+    # thread-vs-process shard execution and dense-vs-sparse barrier
+    # deltas are execution topology, and the fold payload byte count
+    # follows that topology — a process-worker report must compare
+    # equal to the thread oracle on every decision field
+    "worker", "fold", "fold_payload_bytes")
 
 
 def _runner_stats(r) -> dict:
@@ -383,6 +389,15 @@ class ServeReport:
     #                                              executing under next-tick
     #                                              coordinator work (the
     #                                              hidden fold wait)
+    worker: str                                  # shard engine: thread|
+    #                                              process (execution
+    #                                              topology — variant)
+    fold: str                                    # barrier delta mode:
+    #                                              dense|sparse (variant)
+    fold_payload_bytes: int                      # structural bytes the tick
+    #                                              barrier's registry deltas
+    #                                              carried (variant: follows
+    #                                              worker/fold topology)
     serve_wall_s: float
     sustained_spans_per_sec: float
 
@@ -457,7 +472,9 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                   tier_demote_after: Optional[int] = None,
                   tier_warm_bytes: Optional[int] = None,
                   tier_cold_dir=None,
-                  tier_prefetch: Optional[int] = None
+                  tier_prefetch: Optional[int] = None,
+                  worker: Optional[str] = None,
+                  fold: Optional[str] = None
                   ) -> Tuple["ServeEngine", ServeReport]:
     """The canonical seeded serve run shared by ``anomod serve`` and
     ``bench.py --mode serve``: a power-law tenant fleet offering
@@ -505,7 +522,8 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
                          tier_demote_after=tier_demote_after,
                          tier_warm_bytes=tier_warm_bytes,
                          tier_cold_dir=tier_cold_dir,
-                         tier_prefetch=tier_prefetch)
+                         tier_prefetch=tier_prefetch,
+                         worker=worker, fold=fold)
     if engine.flight_recorder is not None:
         # the header's replay contract: `anomod audit replay` re-executes
         # this exact invocation from the journal alone.  Every
@@ -592,7 +610,13 @@ def run_power_law(n_tenants: int = 200, n_services: int = 8,
             # heap (it cannot move a canonical plane), and a resolved
             # "native" would refuse to replay on a toolchain-less box
             # for zero forensic benefit
-            native_drain=native_drain)
+            native_drain=native_drain,
+            # the worker plane, RESOLVED: thread-vs-process shard
+            # execution and dense-vs-sparse barrier deltas are
+            # byte-parity pinned, so a replay may run either — but the
+            # header records what the original actually served with
+            # (the forensic record; also what the replay defaults to)
+            worker=engine.worker_mode, fold=engine.fold_mode)
     report = engine.run(traffic, duration_s=duration_s)
     return engine, report
 
@@ -644,7 +668,9 @@ class ServeEngine:
                  tier_demote_after: Optional[int] = None,
                  tier_warm_bytes: Optional[int] = None,
                  tier_cold_dir=None,
-                 tier_prefetch: Optional[int] = None):
+                 tier_prefetch: Optional[int] = None,
+                 worker: Optional[str] = None,
+                 fold: Optional[str] = None):
         from anomod.config import get_config
         from anomod.utils.platform import enable_jit_cache
         if capacity_spans_per_s <= 0:
@@ -980,6 +1006,87 @@ class ServeEngine:
             }
             self._obs_census_ticks = obs.counter(
                 "anomod_census_ticks_total")
+        #: worker execution (ANOMOD_SERVE_WORKER): "thread" (the
+        #: default, the byte-parity oracle) keeps shard workers as
+        #: threads of this interpreter; "process" moves each shard's
+        #: WHOLE scoring plane — detectors, replay states, its
+        #: BucketRunner, its metrics registry — into a spawn-context
+        #: worker process (anomod.serve.procshard) behind the same
+        #: ShardWorker seam, so N shards score on N interpreters
+        #: instead of time-slicing one GIL.  Each child executes its
+        #: slice through the SAME _score_shard code (a 1-shard
+        #: sub-engine over its owned tenants), so states / alerts /
+        #: SLO / shed and the canonical flight journal are
+        #: byte-identical to the thread engine (pinned).  Planes that
+        #: share coordinator memory with the score plane cannot cross
+        #: the process boundary — the mesh plane, the multimodal
+        #: sidecar, the deferred-commit seam, state tiering's demotion
+        #: copier and the perf/census observatories — so process mode
+        #: auto-degrades to thread under any of them (an explicit
+        #: request is refused): the policy/state idiom.
+        _worker = (app_cfg.serve_worker if worker is None
+                   else str(worker).strip().lower() or "thread")
+        if _worker not in ("thread", "process"):
+            raise ValueError(f"unknown serve worker mode {_worker!r} "
+                             "(thread|process)")
+        if _worker == "process":
+            blocker = (
+                "the mesh plane manages its own sharded dispatch"
+                if mesh is not None else
+                "the multimodal sidecar planes share coordinator memory"
+                if multimodal else
+                "the deferred-commit seam keeps folds in flight inside "
+                "one interpreter" if self.async_commit else
+                "state tiering's demotion copier reads the pool "
+                "in-process" if self.tier_hot else
+                "the perf observatory rides the runners in-process"
+                if self.perf else
+                "the census walks resident planes in-process"
+                if self.census else None)
+            if blocker is not None:
+                if worker is not None:
+                    raise ValueError(
+                        "process shard workers own their score plane "
+                        "in a separate interpreter; " + blocker +
+                        " (ANOMOD_SERVE_WORKER=thread)")
+                _worker = "thread"
+        self.worker_mode = _worker
+        self._worker_start_timeout_s = float(
+            app_cfg.serve_worker_start_timeout_s)
+        #: per-shard chaos fault fired-counts, retained from the last
+        #: barrier reply — a respawned worker process resumes its
+        #: faults' repeat budgets where the dead one left them (a
+        #: one-shot crash fault must not re-trip on recovery
+        #: re-execution just because the crash emptied the child)
+        self._chaos_fired: Dict[int, list] = {}
+        if self.worker_mode == "process":
+            # process workers run the sharded machinery at every count
+            # (mirrors + command barriers even at 1 shard), exactly the
+            # elastic engines' discipline
+            self._use_workers = True
+        #: tick-barrier fold discipline (ANOMOD_SERVE_FOLD): per-tick
+        #: cross-shard merges (registry counter/gauge deltas, t-digest
+        #: centroid sets, leg/perf/verdict records) serialize as
+        #: "sparse" touched-key deltas (the default — barrier cost
+        #: follows ACTIVE tenants, not registered fleet size) or
+        #: "dense" full walks (the payload oracle the sparse win is
+        #: measured against), combined through a deterministic binary
+        #: fold tree in fixed (shard, seq) order either way.  Scrape
+        #: output is pinned byte-identical across the two; only the
+        #: payload bytes move (counted in fold_payload_bytes).
+        _fold = (app_cfg.serve_fold if fold is None
+                 else str(fold).strip().lower() or "sparse")
+        if _fold not in ("dense", "sparse"):
+            raise ValueError(f"unknown serve fold mode {_fold!r} "
+                             "(dense|sparse)")
+        self.fold_mode = _fold
+        #: structural bytes the tick-barrier registry folds shipped
+        #: (anomod.obs.registry.delta_nbytes — deterministic, box-
+        #: independent accounting, NOT pickle lengths)
+        self.fold_payload_bytes = 0
+        self._obs_fold_payload = (
+            obs.counter("anomod_serve_fold_payload_bytes_total")
+            if self._use_workers else None)
         #: the runner recipe a policy-time scale-up rebuilds from (the
         #: same arguments every initial shard runner got)
         self._runner_kw = dict(lane_buckets=lane_buckets,
@@ -991,30 +1098,48 @@ class ServeEngine:
             from anomod.serve.shard import plan_shards
             self.shard_of = plan_shards(self.specs, self.shards,
                                         self.capacity_spans_per_s)
-            # each shard owns a full scoring plane: its own runner (own
-            # jitted executables + pinned scratch slots) recording into
-            # its OWN registry — zero cross-thread contention on the
-            # dispatch hot path; the coordinator folds shard registries
-            # into the process registry at the tick barrier
-            # (obs.Registry.fold_from)
-            self._shard_regs = [
-                obs.Registry(enabled=self._proc_registry.enabled)
-                for _ in range(self.shards)]
-            owned = [sum(1 for t in self.shard_of.values() if t == s)
-                     for s in range(self.shards)]
-            # with tiering on, each shard's pool sizes to its share of
-            # the HOT capacity, not its registered ownership (demotion
-            # returns slots; the pool's doubling growth covers
-            # transients between demote steps)
-            self._runners = [
-                BucketRunner(self.cfg, _buckets, registry=reg,
-                             pool_slots=max(min(owned[s], self.tier_hot)
-                                            if self.tier_hot
-                                            else owned[s], 1),
-                             perf=(self._perf_recs[s] if self.perf
-                                   else None),
-                             **self._runner_kw)
-                for s, reg in enumerate(self._shard_regs)]
+            if self.worker_mode == "process":
+                # the runners live IN the worker processes; the
+                # coordinator keeps per-shard mirrors serving every
+                # runner fact its planes read (flight header buckets,
+                # leg walls, policy chunk signals, report stats) from
+                # the children's barrier replies.  Registry deltas
+                # arrive pre-serialized over the pipe, so there are no
+                # coordinator-side shard registries to fold from.
+                from anomod.serve.procshard import RunnerMirror
+                self._shard_regs = []
+                self._runners = [
+                    RunnerMirror(self.cfg, _buckets,
+                                 lane_buckets=lane_buckets,
+                                 native_stage=native,
+                                 state=self.serve_state)
+                    for _ in range(self.shards)]
+            else:
+                # each shard owns a full scoring plane: its own runner
+                # (own jitted executables + pinned scratch slots)
+                # recording into its OWN registry — zero cross-thread
+                # contention on the dispatch hot path; the coordinator
+                # folds shard registries into the process registry at
+                # the tick barrier (obs.Registry.fold_from)
+                self._shard_regs = [
+                    obs.Registry(enabled=self._proc_registry.enabled)
+                    for _ in range(self.shards)]
+                owned = [sum(1 for t in self.shard_of.values() if t == s)
+                         for s in range(self.shards)]
+                # with tiering on, each shard's pool sizes to its share
+                # of the HOT capacity, not its registered ownership
+                # (demotion returns slots; the pool's doubling growth
+                # covers transients between demote steps)
+                self._runners = [
+                    BucketRunner(self.cfg, _buckets, registry=reg,
+                                 pool_slots=max(min(owned[s],
+                                                    self.tier_hot)
+                                                if self.tier_hot
+                                                else owned[s], 1),
+                                 perf=(self._perf_recs[s] if self.perf
+                                       else None),
+                                 **self._runner_kw)
+                    for s, reg in enumerate(self._shard_regs)]
             self._fold_state = [dict() for _ in range(self.shards)]
             self.runner = self._runners[0]
         else:
@@ -1075,8 +1200,14 @@ class ServeEngine:
                            if rca_windows is None else rca_windows)
             # one plane per shard (shard-private runner + registry, the
             # BucketRunner discipline); the inline 1-shard plane records
-            # into the process registry directly
-            _regs = (self._shard_regs if self._use_workers
+            # into the process registry directly.  Process workers keep
+            # ONE coordinator-resident plane regardless of shard count:
+            # evidence buffering is documented coordinator-side (rca.py
+            # — buffer content is shard-count-invariant there), which is
+            # also what lets the evidence survive a worker-process crash
+            # exactly as it survives a thread crash.
+            _regs = (self._shard_regs
+                     if self._use_workers and self.worker_mode == "thread"
                      else [self._proc_registry])
             #: the RCA-plane recipe a policy-time scale-up rebuilds from
             self._rca_kw = dict(buckets=_rca_buckets, topk=_topk,
@@ -1170,6 +1301,13 @@ class ServeEngine:
                     "async_commit": self.async_commit,
                     "tier_hot": self.tier_hot,
                     "drain_engine": self.admission.drain_engine,
+                    # worker topology: which execution seam scored the
+                    # run (thread|process) and which barrier-fold
+                    # discipline shipped its metrics (dense|sparse) —
+                    # recorded RESOLVED so `anomod audit replay`
+                    # re-executes under the same seams
+                    "worker": self.worker_mode,
+                    "fold": self.fold_mode,
                  },
                  "config": config_snapshot(),
                  "versions": versions()},
@@ -1864,10 +2002,7 @@ class ServeEngine:
                     worker.join()
                 except BaseException as e:
                     failures.append((s, e))
-        for s in range(self.shards):
-            self._proc_registry.fold_from(self._shard_regs[s],
-                                          self._fold_state[s],
-                                          shard=str(s))
+        self._fold_shard_registries()
         if failures:
             self._last_failures = failures
             raise failures[0][1]
@@ -1945,10 +2080,7 @@ class ServeEngine:
                 worker.join()
             except BaseException as e:
                 failures.append((s, e))
-        for s in range(self.shards):
-            self._proc_registry.fold_from(self._shard_regs[s],
-                                          self._fold_state[s],
-                                          shard=str(s))
+        self._fold_shard_registries()
         if failures:
             self._last_failures = failures
             raise failures[0][1]
@@ -2312,9 +2444,26 @@ class ServeEngine:
                 reps = dict(reps)
                 for tid_ in self._tier.tids():
                     reps[tid_] = self._tier.state_shim(tid_)
-        fold = {"tenants": n_states,
-                "state_digest": (state_digest(reps)
-                                 if do_digest else None)}
+        if do_digest and self.worker_mode == "process":
+            # the states live in the children: each ships per-tenant
+            # (tid, crc, len) fragments, folded here in global sorted
+            # tenant order via crc32_combine — bit-equal to the
+            # state_digest walk a thread engine runs (the journal
+            # parity anchor survives the process boundary)
+            from anomod.obs.flight import fold_digest_parts
+            parts = []
+            if self._workers is not None:
+                for w in self._workers:
+                    if not w.alive:
+                        continue
+                    try:
+                        parts.extend(w.call({"op": "digest"})["parts"])
+                    except RuntimeError:
+                        continue
+            digest = fold_digest_parts(parts)
+        else:
+            digest = state_digest(reps) if do_digest else None
+        fold = {"tenants": n_states, "state_digest": digest}
         new_alerts = 0
         crc = self._flight_score_crc
         for tid in sorted(self._tenant_det):
@@ -2429,22 +2578,85 @@ class ServeEngine:
 
     # -- the sharded (scale-out) score path -------------------------------
 
+    def _make_worker(self, s: int):
+        """One shard worker of the engine's configured kind — the ONE
+        construction point the engine, the supervisor's respawn path
+        and the elastic policy's scale edges all route through, so a
+        process-mode engine can never accidentally respawn a thread."""
+        if self.worker_mode == "process":
+            from anomod.serve.procshard import ProcShardWorker
+            return ProcShardWorker(
+                s, self._procshard_init(s),
+                start_timeout_s=self._worker_start_timeout_s)
+        from anomod.serve.shard import ShardWorker
+        return ShardWorker(s)
+
+    def _procshard_init(self, s: int) -> dict:
+        """The picklable init payload for shard ``s``'s worker process:
+        every knob the child's 1-shard sub-engine needs, passed
+        RESOLVED from this engine's values (never re-read from the
+        child's env — the child must not drift onto a different
+        configuration than the engine that spawned it)."""
+        owned = [spec for spec in self.specs
+                 if self.shard_of.get(spec.tenant_id, 0) == s]
+        chaos_script = None
+        if self._chaos is not None:
+            chaos_script = getattr(self._chaos, "script", None)
+        return {"shard_id": s,
+                "specs": owned,
+                "services": self.services,
+                "cfg": self.cfg,
+                "t0_us": self.t0_us,
+                "capacity_spans_per_s": self.capacity_spans_per_s,
+                "tick_s": self.clock.tick_s,
+                "buckets": tuple(self._runners[s].buckets),
+                "lane_buckets": tuple(self._runners[s].lane_buckets),
+                "max_backlog": self.max_backlog,
+                "score": self.score,
+                "fuse": self.fuse,
+                "pipeline": self.pipeline,
+                "native": bool(self._runners[s].native_stage),
+                "state": self.serve_state,
+                "det_kw": dict(self._det_kw),
+                "registry_enabled": bool(self._proc_registry.enabled),
+                "chaos_script": chaos_script,
+                "chaos_fired": self._chaos_fired.get(s)}
+
     def _ensure_workers(self) -> None:
-        if self._workers is None or not all(w.alive
-                                            for w in self._workers):
-            from anomod.serve.shard import ShardWorker
-            errs = []
-            if self._workers is not None:
-                for w in self._workers:   # no leaked threads on respawn
+        if self._workers is None:
+            self._workers = [self._make_worker(s)
+                             for s in range(self.shards)]
+            return
+        if all(w.alive for w in self._workers):
+            return
+        errs = []
+        if self.worker_mode == "process":
+            # replace ONLY the dead children: a live worker process
+            # holds its shard's tenant states — closing it to respawn a
+            # sibling would destroy healthy state.  (A respawned child
+            # starts EMPTY: the supervisor's checkpoint/replay path
+            # restores it; an unsupervised process engine loses the
+            # dead shard's states, exactly like a real process crash
+            # without checkpoints — docs/SERVING.md.)
+            for s, w in enumerate(self._workers):
+                if not w.alive:
                     try:
                         w.close()
                     except BaseException as e:  # noqa: BLE001
                         errs.append(e)
-            self._workers = [ShardWorker(s) for s in range(self.shards)]
-            if errs:
-                # close() re-raises a deferred (never-joined) task
-                # error; every sibling still closed before it surfaces
-                raise errs[0]
+                    self._workers[s] = self._make_worker(s)
+        else:
+            for w in self._workers:   # no leaked threads on respawn
+                try:
+                    w.close()
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+            self._workers = [self._make_worker(s)
+                             for s in range(self.shards)]
+        if errs:
+            # close() re-raises a deferred (never-joined) task
+            # error; every sibling still closed before it surfaces
+            raise errs[0]
 
     def close(self) -> None:
         """Stop the shard worker threads (idempotent; the engine remains
@@ -2491,7 +2703,9 @@ class ServeEngine:
         for qb in served:
             parts[self.shard_of[qb.tenant_id]].append(qb)
         self._ensure_workers()
-        failures = self._submit_parts(parts)
+        failures = (self._submit_parts_proc(parts)
+                    if self.worker_mode == "process"
+                    else self._submit_parts(parts))
         if failures:
             # attribution for the supervisor (which shards failed);
             # unsupervised engines keep the historical contract — the
@@ -2522,11 +2736,200 @@ class ServeEngine:
                 worker.join()
             except BaseException as e:    # noqa: BLE001 — re-raised
                 failures.append((s, e))
-        for s in range(self.shards):
-            self._proc_registry.fold_from(self._shard_regs[s],
-                                          self._fold_state[s],
-                                          shard=str(s))
+        self._fold_shard_registries()
         return failures
+
+    def _submit_parts_proc(self, parts: List[List[QueuedBatch]],
+                           origin_tick: Optional[int] = None) -> list:
+        """The process-worker barrier: fan per-shard slices out as
+        ``score`` commands (all sends complete before any recv — the
+        children overlap), then drain the replies in shard order.
+        Every reply folds its mirror/alert/registry payloads whether or
+        not the slice succeeded (counters the child DID record are
+        correct either way — the _submit_parts contract), and a shipped
+        error reconstructs into the same exception surface the thread
+        worker raises at join().  Returns ``[(shard_id, exc), ...]``
+        in shard order."""
+        from anomod.serve.procshard import rebuild_exc
+        tick = (self.clock.ticks if origin_tick is None else origin_tick)
+        submitted = []
+        for s, worker in enumerate(self._workers):
+            if parts[s]:
+                try:
+                    worker.send({"op": "score", "served": parts[s],
+                                 "origin_tick": tick,
+                                 "fold": self.fold_mode})
+                    submitted.append((s, worker, None))
+                except BaseException as e:      # noqa: BLE001
+                    submitted.append((s, worker, e))
+        failures = []
+        deltas = []
+        for s, worker, send_err in submitted:
+            if send_err is not None:
+                failures.append((s, send_err))
+                continue
+            try:
+                rep = worker.recv()
+            except BaseException as e:          # noqa: BLE001
+                failures.append((s, e))
+                continue
+            self._apply_shard_reply(s, rep)
+            if rep.get("reg_delta") is not None:
+                deltas.append((s, rep["reg_delta"]))
+            if rep.get("error") is not None:
+                failures.append((s, rebuild_exc(rep["error"])))
+        self._fold_shard_registries(deltas=deltas)
+        return failures
+
+    def _apply_shard_reply(self, s: int, rep: dict) -> None:
+        """Fold one child reply's coordinator-mirror payloads: the
+        runner's cumulative book/walls, newly materialized tenant
+        planes, the alert suffix protocol, and the shard's chaos
+        fired-counts (respawn budget continuity).  Registry deltas are
+        NOT applied here — the caller batches them through the fold
+        tree (_fold_shard_registries) so payload accounting and combine
+        order stay one code path."""
+        from anomod.serve.procshard import DetMirror
+        if "book" in rep:
+            self._runners[s].apply(rep)
+        for tid in rep.get("resident_new", ()):
+            if tid not in self._tenant_replay:
+                # residency stub: the states live in the child; the
+                # coordinator only needs the resident SET (census off
+                # and tiering off in process mode — nothing walks the
+                # values)
+                self._tenant_replay[tid] = None
+        for tid in rep.get("det_new", ()):
+            if tid not in self._tenant_det:
+                self._tenant_det[tid] = DetMirror()
+        for tid, base, new in rep.get("alerts", ()):
+            det = self._tenant_det.get(tid)
+            if det is None:
+                det = self._tenant_det[tid] = DetMirror()
+            del det.alerts[base:]
+            det.alerts.extend(new)
+        if rep.get("chaos_fired") is not None:
+            self._chaos_fired[s] = list(rep["chaos_fired"])
+
+    def _fold_shard_registries(self, final: bool = False,
+                               shards: Optional[List[int]] = None,
+                               deltas: Optional[list] = None) -> None:
+        """The tick barrier's registry merge, one code path for both
+        worker kinds: collect per-shard ``(shard, delta)`` payloads —
+        snapshotted locally from the shard registries (thread mode) or
+        handed in pre-serialized off the pipe (process mode) — combine
+        them through the deterministic binary fold tree in fixed
+        (shard, seq) order, apply to the process registry, and account
+        the structural payload bytes (the sparse-vs-dense win
+        criterion: exact and box-independent)."""
+        from anomod.obs.registry import delta_nbytes
+        from anomod.serve.shard import fold_tree
+        if deltas is None:
+            idx = range(self.shards) if shards is None else shards
+            deltas = []
+            for s in idx:
+                d = self._shard_regs[s].delta_snapshot(
+                    self._fold_state[s], mode=self.fold_mode,
+                    final=final)
+                deltas.append((s, d))
+        parts = [[(s, d)] for s, d in deltas if d is not None]
+        merged = fold_tree(parts, lambda a, b: a + b)
+        if not merged:
+            return
+        nbytes = 0
+        for s, d in merged:
+            self._proc_registry.apply_delta(d, shard=str(s))
+            nbytes += delta_nbytes(d)
+        self.fold_payload_bytes += nbytes
+        if self._obs_fold_payload is not None and nbytes:
+            self._obs_fold_payload.inc(nbytes)
+
+    # -- the supervisor's process-mode seams (supervise.py routes here
+    # -- when worker_mode == "process"; states live in the children) ------
+
+    def _snapshot_tenants_proc(self) -> dict:
+        """Checkpoint gather over the pipes: each child runs the SAME
+        snapshot_replay/snapshot_detector seams locally and ships
+        ``tid -> (replay_snap, det_snap)``; a dead child's tenants are
+        simply absent (their state died with it)."""
+        tenants: dict = {}
+        if self._workers is None:
+            return tenants
+        for w in self._workers:
+            if not w.alive:
+                continue
+            try:
+                rep = w.call({"op": "snapshot"})
+            except RuntimeError:
+                continue
+            tenants.update(rep["tenants"])
+        return tenants
+
+    def _drop_shard_proc(self, s: int) -> None:
+        """Restore teardown half, process flavor: clear the
+        coordinator's resident stubs/alert mirrors for shard ``s`` and
+        tell the child (when one is listening — a freshly respawned
+        child is already empty) to drop its planes."""
+        for tid in [t for t in list(self._tenant_replay)
+                    if self.shard_of.get(t, 0) == s]:
+            self._tenant_replay.pop(tid, None)
+            self._tenant_det.pop(tid, None)
+        if self._workers is not None and self._workers[s].alive:
+            try:
+                self._workers[s].call({"op": "drop"})
+            except RuntimeError:
+                pass                 # died on the way out: child gone
+
+    def _restore_book(self, s: int, book: dict) -> None:
+        """Install a checkpoint's runner book on shard ``s`` — the
+        coordinator mirror AND (process mode) the child's live runner,
+        so re-executed slices advance from checkpoint counts in both
+        places (the double-count guard must hold where the dispatches
+        actually happen)."""
+        self._runners[s].book_restore(book)
+        if (self.worker_mode == "process" and self._workers is not None
+                and self._workers[s].alive):
+            try:
+                self._workers[s].call({"op": "book_restore",
+                                       "book": book})
+            except RuntimeError:
+                pass
+
+    def _install_tenant_proc(self, tid: int, snap: tuple) -> None:
+        """Reinstall one checkpointed tenant into its owning child and
+        rewind the coordinator's alert mirror to the checkpoint view
+        (restore_detector rewinds the real alert list the same way in
+        thread mode)."""
+        from anomod.serve.procshard import DetMirror
+        rep_snap, det_snap = snap
+        s = self.shard_of.get(tid, 0)
+        self._ensure_workers()
+        rep = self._workers[s].call({"op": "install_tenant", "tid": tid,
+                                     "replay": rep_snap,
+                                     "det": det_snap})
+        self._apply_shard_reply(s, rep)
+        self._tenant_replay.setdefault(tid, None)
+        if det_snap is not None:
+            det = self._tenant_det.get(tid)
+            if det is None:
+                det = self._tenant_det[tid] = DetMirror()
+            det.alerts[:] = list(det_snap.get("alerts", ()))
+
+    def _exec_slice_proc(self, s: int, slice_: list, tick: int) -> None:
+        """Supervised re-execution of one logged slice inside shard
+        ``s``'s child — the chaos injector keys on ``origin_tick``
+        exactly as the thread path does, and a shipped failure raises
+        here so the recovery loop charges the slice."""
+        from anomod.serve.procshard import rebuild_exc
+        w = self._workers[s]
+        w.send({"op": "score", "served": slice_, "origin_tick": tick,
+                "fold": self.fold_mode})
+        rep = w.recv()
+        self._apply_shard_reply(s, rep)
+        if rep.get("reg_delta") is not None:
+            self._fold_shard_registries(deltas=[(s, rep["reg_delta"])])
+        if rep.get("error") is not None:
+            raise rebuild_exc(rep["error"])
 
     def _score_shard(self, shard_id: int, served: List[QueuedBatch],
                      origin_tick: Optional[int] = None) -> None:
@@ -2746,10 +3149,34 @@ class ServeEngine:
         reshuffle).  Returns the moved tenant ids."""
         from functools import partial
 
-        from anomod.serve.shard import ShardWorker, rendezvous_shard
+        from anomod.serve.shard import rendezvous_shard
         s = self.shards
         moved = [tid for tid in sorted(self.shard_of)
                  if rendezvous_shard(tid, s + 1) == s]
+        if self.worker_mode == "process":
+            # the new shard's runner lives in its child; the
+            # coordinator grows a mirror cloned from shard 0's
+            # resolved static facts (perf/RCA planes never branch:
+            # perf is refused in process mode and RCA keeps its one
+            # coordinator-resident plane)
+            from anomod.serve.procshard import RunnerMirror
+            m0 = self._runners[0]
+            self._runners.append(RunnerMirror(
+                self.cfg, m0.buckets, lane_buckets=m0.lane_buckets,
+                native_stage=m0.native_stage, state=m0.state_mode))
+            self._fold_state.append(dict())
+            self.shards = s + 1
+            if self._workers is not None:
+                w = self._make_worker(s)
+                self._workers.append(w)
+                # warm the new child's compile grid inside the measured
+                # tick wall (scaling is real work the bench elasticity
+                # block prices), off the coordinator thread
+                rep = w.call({"op": "warm"})
+                self._apply_shard_reply(s, rep)
+            for tid in moved:
+                self._move_tenant(tid, s)
+            return moved
         reg = obs.Registry(enabled=self._proc_registry.enabled)
         prec = None
         if self.perf:
@@ -2772,7 +3199,7 @@ class ServeEngine:
                 windows=self._rca_kw["windows"]))
         self.shards = s + 1
         if self._workers is not None:
-            self._workers.append(ShardWorker(s))
+            self._workers.append(self._make_worker(s))
             # warm the new runner's compile grid on its own worker —
             # inside the measured tick wall (scaling is real work the
             # bench elasticity block prices), off the serving threads
@@ -2805,14 +3232,28 @@ class ServeEngine:
             self._move_tenant(
                 tid, rendezvous_shard(tid, s, candidates=candidates))
         errs = []
+        if self.worker_mode == "process" and self._workers is not None:
+            # drain the dying child's registry BEFORE retiring it —
+            # after close there is no pipe left to ask
+            w = self._workers[s]
+            if w.alive:
+                try:
+                    rep = w.call({"op": "reg_delta",
+                                  "fold": self.fold_mode, "final": True})
+                    if rep.get("delta") is not None:
+                        self._fold_shard_registries(
+                            deltas=[(s, rep["delta"])], final=True)
+                except RuntimeError:
+                    pass                      # crashed mid-drain: close
         if self._workers is not None:
             try:
                 self._workers.pop().close()
             except BaseException as e:        # noqa: BLE001 — re-raised
                 errs.append(e)
-        self._proc_registry.fold_from(self._shard_regs[s],
-                                      self._fold_state[s],
-                                      shard=str(s), final=True)
+        if self.worker_mode != "process":
+            self._proc_registry.fold_from(self._shard_regs[s],
+                                          self._fold_state[s],
+                                          shard=str(s), final=True)
         self._retired_runners.append(_runner_stats(self._runners[s]))
         if self.perf and len(self._perf_recs) > s:
             # the victim's undrained lifecycle events fold into the
@@ -2820,7 +3261,8 @@ class ServeEngine:
             # timeline covers the whole run, not the final topology)
             self._perf_pending.extend(self._perf_recs.pop().drain())
         self._runners.pop()
-        self._shard_regs.pop()
+        if self._shard_regs:                  # empty in process mode
+            self._shard_regs.pop()
         self._fold_state.pop()
         if self.rca and len(self._rca_planes) > s:
             self._rca_planes.pop()
@@ -2840,6 +3282,28 @@ class ServeEngine:
         move cannot shift a single scored byte."""
         src = self.shard_of.get(tid, 0)
         if src == dst:
+            return
+        if self.worker_mode == "process":
+            # gather/reinstall over the pipes, through the SAME
+            # snapshot seams (supervise.snapshot_replay/restore_replay
+            # run inside the children): take from the src child, put
+            # into the dst child.  The coordinator's resident stubs
+            # and alert mirrors carry over unchanged — alerts already
+            # mirrored, and the dst child re-anchors its ship base at
+            # install time.
+            self.shard_of[tid] = dst
+            if self._workers is not None:
+                self._ensure_workers()
+                taken = self._workers[src].call(
+                    {"op": "take_tenant", "tid": tid})
+                snap = taken.get("snap")
+                if snap is not None:
+                    rep_snap, det_snap = snap
+                    self.policy_migrated_spans += int(rep_snap["n_spans"])
+                    put = self._workers[dst].call(
+                        {"op": "put_tenant", "tid": tid,
+                         "replay": rep_snap, "det": det_snap})
+                    self._apply_shard_reply(dst, put)
             return
         rep = self._tenant_replay.pop(tid, None)
         self.shard_of[tid] = dst
@@ -2900,7 +3364,15 @@ class ServeEngine:
                     len(self._rca_queue))
         items = [self._rca_queue.popleft() for _ in range(burst)]
         with self._span("serve.rca"):
-            if self._use_workers:
+            if self._use_workers and self.worker_mode == "process":
+                # process mode keeps ONE coordinator-resident plane
+                # (evidence is buffered coordinator-side, rca.py's
+                # shard-count-invariant contract) — the mirrors'
+                # alert lists feed it exactly like thread detectors
+                folded = []
+                self._rca_run_items(self._rca_planes[0], items, folded,
+                                    now)
+            elif self._use_workers:
                 from anomod.serve.shard import fold_verdicts, join_all
                 parts: List[list] = [[] for _ in range(self.shards)]
                 for it in items:
@@ -2943,7 +3415,32 @@ class ServeEngine:
         """Drive the engine from a traffic source for ``duration_s``
         virtual seconds, then close every tenant's last window."""
         if warm and self.mesh is None:
-            if self._use_workers:
+            if self._use_workers and self.worker_mode == "process":
+                # the thread discipline, over the pipe: shard 0 warms
+                # first and alone (with ANOMOD_JIT_CACHE on it
+                # populates the persistent cache for the siblings),
+                # then the rest overlap — all sends complete before
+                # any recv.  Replies carry each child's compile walls
+                # into the coordinator mirrors.
+                from anomod.serve.procshard import rebuild_exc
+                self._ensure_workers()
+                reps: List[Optional[dict]] = [None] * self.shards
+                self._workers[0].send({"op": "warm"})
+                reps[0] = self._workers[0].recv()
+                for s in range(1, self.shards):
+                    self._workers[s].send({"op": "warm"})
+                for s in range(1, self.shards):
+                    reps[s] = self._workers[s].recv()
+                for s, rep in enumerate(reps):
+                    self._apply_shard_reply(s, rep)
+                for rep in reps:
+                    if rep.get("error") is not None:
+                        raise rebuild_exc(rep["error"])
+                if self.rca:
+                    # the single coordinator-resident plane (process
+                    # mode keeps RCA evidence out of the children)
+                    self._rca_planes[0].runner.warm()
+            elif self._use_workers:
                 # warm shard 0 FIRST, alone: with ANOMOD_JIT_CACHE on
                 # it populates the persistent cache, so the remaining
                 # shards' identical-HLO grids (warmed in parallel on
@@ -3015,8 +3512,14 @@ class ServeEngine:
             for tid in sorted(self._tier.tids()):
                 self._tier_promote(tid, deferred=False)
         if self.score:
-            for det in self._tenant_det.values():
-                det.finish()
+            if self._use_workers and self.worker_mode == "process":
+                # the detectors live in the children: fan the finish
+                # out over the pipes; replies carry the closing
+                # windows' alerts (and registry deltas) back
+                self._finish_proc()
+            else:
+                for det in self._tenant_det.values():
+                    det.finish()
         if self.rca:
             # end-of-run settlement: alerts raised by finish() (the last
             # window closing) still get culprits, and anything the
@@ -3052,12 +3555,64 @@ class ServeEngine:
             # etc.) DRAIN through the Histogram.merge_digest seam — the
             # same way the per-tenant SLO digests already join; drain
             # semantics make a re-run() engine fold its new data only
-            for s in range(self.shards):
-                self._proc_registry.fold_from(
-                    self._shard_regs[s], self._fold_state[s],
-                    shard=str(s), final=True)
+            if self.worker_mode == "process":
+                self._final_fold_proc()
+            else:
+                self._fold_shard_registries(final=True)
             self.close()
         return self.report(traffic=traffic)
+
+    def _finish_proc(self) -> None:
+        """Fan ``Detector.finish()`` out to the shard children.
+
+        A dead (crashed, unsupervised) child is skipped: its
+        detectors died with it, exactly like a thread-mode engine
+        whose state was lost would have nothing to finish — the
+        documented unsupervised-crash degradation.
+        """
+        if self._workers is None:
+            return
+        sent = []
+        for s, w in enumerate(self._workers):
+            if not w.alive:
+                continue
+            try:
+                w.send({"op": "finish", "fold": self.fold_mode})
+                sent.append((s, w))
+            except RuntimeError:
+                continue
+        from anomod.serve.procshard import rebuild_exc
+        deltas, first_err = [], None
+        for s, w in sent:
+            try:
+                rep = w.recv()
+            except RuntimeError:
+                continue
+            self._apply_shard_reply(s, rep)
+            if rep.get("reg_delta") is not None:
+                deltas.append((s, rep["reg_delta"]))
+            if rep.get("error") is not None and first_err is None:
+                first_err = rebuild_exc(rep["error"])
+        self._fold_shard_registries(deltas=deltas)
+        if first_err is not None:
+            raise first_err
+
+    def _final_fold_proc(self) -> None:
+        """Run-end registry drain over the pipes (final=True folds)."""
+        if self._workers is None:
+            return
+        deltas = []
+        for s, w in enumerate(self._workers):
+            if not w.alive:
+                continue
+            try:
+                rep = w.call({"op": "reg_delta", "fold": self.fold_mode,
+                              "final": True})
+            except RuntimeError:
+                continue
+            if rep.get("delta") is not None:
+                deltas.append((s, rep["delta"]))
+        self._fold_shard_registries(deltas=deltas, final=True)
 
     def _warm_shard(self, shard_id: int) -> None:
         runner = self._runners[shard_id]
@@ -3344,6 +3899,9 @@ class ServeEngine:
             async_commit=self.async_commit,
             async_ticks=self.async_ticks,
             commit_defer_wall_s=round(self.commit_defer_wall_s, 6),
+            worker=self.worker_mode,
+            fold=self.fold_mode,
+            fold_payload_bytes=self.fold_payload_bytes,
             serve_wall_s=round(self.serve_wall_s, 4),
             sustained_spans_per_sec=round(
                 self.n_spans_served / max(self.serve_wall_s, 1e-9), 1),

@@ -201,6 +201,29 @@ def fold_leg_records(legs: Sequence[dict]) -> List[dict]:
     return out
 
 
+def fold_tree(parts: Sequence, combine) -> object:
+    """Deterministic binary fold tree over per-shard barrier payloads.
+
+    ``parts`` arrive in fixed shard order (the caller's contract) and
+    pair off bottom-up — ``((s0, s1), (s2, s3))`` — so the combine
+    schedule is a function of the part COUNT alone, never of which
+    worker finished first: the reduction is reproducible at any shard
+    count and any completion order, the fold_verdicts/fold_from idiom
+    lifted to an O(log n)-depth tree (the Sparse Allreduce shape,
+    PAPERS.md).  ``combine`` must be associative over adjacent parts;
+    an empty sequence folds to None."""
+    items = list(parts)
+    if not items:
+        return None
+    while len(items) > 1:
+        paired = [combine(items[i], items[i + 1])
+                  for i in range(0, len(items) - 1, 2)]
+        if len(items) % 2:
+            paired.append(items[-1])
+        items = paired
+    return items[0]
+
+
 def join_all(workers) -> None:
     """Barrier over submitted workers that COMPLETES before any error
     propagates: raising at the first failed join would leave sibling
@@ -225,6 +248,14 @@ class ShardWorker:
     against state only this shard ever touches.  Exceptions propagate to
     the coordinator at join() — a failed shard must fail the tick, not
     silently drop its tenants' scoring.
+
+    This submit/join/close/``alive`` surface IS the worker seam: the
+    engine, the supervisor's respawn path and the elastic policy's
+    scale edges drive every worker kind through it.
+    :class:`anomod.serve.procshard.ProcShardWorker` presents the same
+    four members over a spawn-context worker PROCESS (submit takes a
+    picklable command dict instead of a closure — a process cannot
+    share the engine's memory, so the engine hands it data, not code).
     """
 
     def __init__(self, shard_id: int, name: str = "anomod-serve-shard"):

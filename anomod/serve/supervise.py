@@ -252,11 +252,17 @@ class ShardSupervisor:
         t0 = time.perf_counter()
         eng = self.engine
         tenants = {}
-        for tid, rep in eng._tenant_replay.items():
-            det = eng._tenant_det.get(tid)
-            tenants[tid] = (snapshot_replay(rep),
-                            snapshot_detector(det)
-                            if det is not None else None)
+        if getattr(eng, "worker_mode", "thread") == "process":
+            # the states live in the children: each ships its tenants'
+            # (replay_snap, det_snap) pairs through the SAME snapshot
+            # seams, run child-side
+            tenants = eng._snapshot_tenants_proc()
+        else:
+            for tid, rep in eng._tenant_replay.items():
+                det = eng._tenant_det.get(tid)
+                tenants[tid] = (snapshot_replay(rep),
+                                snapshot_detector(det)
+                                if det is not None else None)
         tier = getattr(eng, "_tier", None)
         if tier is not None:
             # demoted tenants are fleet state too: a tenant demoted
@@ -375,9 +381,11 @@ class ShardSupervisor:
         w = eng._workers[s]
         if w.alive:
             return
-        w.close()                    # dead thread: joins immediately
-        from anomod.serve.shard import ShardWorker
-        eng._workers[s] = ShardWorker(s)
+        w.close()                    # dead worker: joins immediately
+        # the engine picks the worker kind (ShardWorker thread or
+        # ProcShardWorker child process); a fresh process child starts
+        # EMPTY — _restore_and_replay reinstalls the checkpoint into it
+        eng._workers[s] = eng._make_worker(s)
         self._respawns[s] = self._respawns.get(s, 0) + 1
         self.n_respawns += 1
         self._obs_respawns.inc()
@@ -388,6 +396,9 @@ class ShardSupervisor:
         planes and any parked dispatches — the restore's teardown
         half."""
         eng = self.engine
+        if getattr(eng, "worker_mode", "thread") == "process":
+            eng._drop_shard_proc(s)
+            return
         for tid in [t for t, r in list(eng._tenant_replay.items())
                     if eng.shard_of.get(t, 0) == s]:
             rep = eng._tenant_replay.pop(tid)
@@ -400,6 +411,11 @@ class ShardSupervisor:
         """Recreate one tenant's planes on its (current) owning shard
         and install the checkpoint snapshot through the state seams."""
         eng = self.engine
+        if getattr(eng, "worker_mode", "thread") == "process":
+            # reinstall into the owning CHILD over the pipe — the same
+            # restore seams, run where the state lives
+            eng._install_tenant_proc(tid, snap)
+            return
         rep_snap, det_snap = snap
         tier = getattr(eng, "_tier", None)
         if tier is not None:
@@ -426,7 +442,7 @@ class ShardSupervisor:
         eng = self.engine
         ck = self._ckpt
         self._drop_shard_planes(s)
-        eng._runners[s].book_restore(ck.books[s])
+        eng._restore_book(s, ck.books[s])
         for tid, snap in ck.tenants.items():
             if eng.shard_of.get(tid, 0) == s:
                 self._install_tenant(tid, snap)
@@ -476,7 +492,10 @@ class ShardSupervisor:
         slice's quarantine budget — setup errors belong in
         :meth:`_ensure_worker_alive`, before the attributable zone."""
         eng = self.engine
-        if eng._workers is not None:
+        if eng._workers is not None \
+                and getattr(eng._workers[s], "kind", "thread") == "process":
+            eng._exec_slice_proc(s, slice_, tick)
+        elif eng._workers is not None:
             from functools import partial
             w = eng._workers[s]
             w.submit(partial(eng._score_shard, s, slice_, tick))
@@ -526,13 +545,12 @@ class ShardSupervisor:
         self.dead_shards.add(s)
         moved = sorted(t for t, sh in eng.shard_of.items() if sh == s)
         self._drop_shard_planes(s)
-        eng._runners[s].book_restore(self._ckpt.books[s])
+        eng._restore_book(s, self._ckpt.books[s])
         # park a fresh idle worker in the dead slot so the engine's
         # all-alive respawn check stays quiet; it never receives work
         if eng._workers is not None:
-            from anomod.serve.shard import ShardWorker
             eng._workers[s].close()
-            eng._workers[s] = ShardWorker(s)
+            eng._workers[s] = eng._make_worker(s)
         # rendezvous over the survivors (the SAME key definition as
         # initial placement — shard.rendezvous_shard): deterministic in
         # (tenant, survivor set) alone, so a replay of the same chaos

@@ -177,6 +177,13 @@ def serve_main(probe_fresh=False) -> int:
     migration volume, and the elastic determinism parity bits (the
     policy run's states/alerts/p99/shed and canonical flight journal
     must equal the static leg's).
+    A PROCESS-WORKER quartet (ISSUE-20: 2-shard thread oracle, 2-shard
+    and 1-shard process engines, and a dense-fold process reference,
+    same seed) fills the ``proc_shard`` block: thread-vs-process and
+    N-vs-1-process parity bits, the sparse barrier fold's payload
+    bytes against the dense walk, and the per-leg raw_wall_s samples —
+    throughput scaling quoted only when the box has >= 4 cores
+    (``scaling_quotable``).
     After the shard-scaling legs,
     two ONLINE-RCA legs (1-shard and 2-shard, ``rca=True``, same seed)
     fill the ``rca`` block: top-k hit-rate (k=1,3,5) against the
@@ -333,6 +340,28 @@ def serve_main(probe_fresh=False) -> int:
             set_registry(Registry(enabled=True))
             eng_async, rep_async = run_power_law(
                 async_commit=True, perf=True, shards=1, **run_kw)
+            # the PROCESS-WORKER legs (ISSUE-20): the same seed served
+            # four ways — 2 shard THREADS (the byte-parity oracle,
+            # sparse fold), 2 shard PROCESSES (the GIL-free engine,
+            # sparse fold), 1 shard process (the N-vs-1 process parity
+            # side), and 2 shard processes under the DENSE barrier fold
+            # (the sparse payload's reference walk).  The thread leg
+            # runs FIRST so the process legs inherit its warmup and the
+            # thread/process wall comparison is never flattered by run
+            # order; every decision plane and the canonical flight
+            # journal must be byte-identical across all four.
+            set_registry(Registry(enabled=True))
+            eng_pwt, rep_pwt = run_power_law(
+                shards=2, worker="thread", fold="sparse", **run_kw)
+            set_registry(Registry(enabled=True))
+            eng_pwp, rep_pwp = run_power_law(
+                shards=2, worker="process", fold="sparse", **run_kw)
+            set_registry(Registry(enabled=True))
+            eng_pw1, rep_pw1 = run_power_law(
+                shards=1, worker="process", fold="sparse", **run_kw)
+            set_registry(Registry(enabled=True))
+            eng_pwd, rep_pwd = run_power_law(
+                shards=2, worker="process", fold="dense", **run_kw)
             # the ELASTICITY legs: a sub-capacity fleet hit by a
             # scripted load surge (the chaos 'surge' kind), served
             # twice on the same seed — once static, once under the
@@ -846,6 +875,79 @@ def serve_main(probe_fresh=False) -> int:
                 "shed_identical":
                     rep_async.shed_fraction == rep_perf.shed_fraction,
                 "journal_canonical_identical": _as_journal_ok,
+            },
+        }
+        # process-shard serving (ISSUE-20): the GIL-free worker engine
+        # vs its matched 2-shard thread leg, the sparse barrier fold's
+        # payload bytes vs the dense walk, and the determinism parity
+        # bits — alerts compared tenant-by-tenant over the coordinator
+        # mirrors, states pinned through the canonical flight journal's
+        # state digests (a process engine's replay planes live in its
+        # children; the journal digest IS the whole-fleet state bit).
+        # Throughput scaling is quoted ONLY on a >= 4-core box: on two
+        # cores the coordinator and two workers contend for the same
+        # silicon and a speedup number would be noise, not signal —
+        # `scaling_quotable` records which side this capture is on.
+        import os as _os
+        _n_cores = _os.cpu_count() or 1
+
+        def _alerts_identical(eng_a, eng_b):
+            tids = sorted(set(eng_a._tenant_det)
+                          | set(eng_b._tenant_det))
+            return all(eng_a.alerts_for(t) == eng_b.alerts_for(t)
+                       for t in tids)
+
+        def _pw_journal_bit(eng_a, eng_b):
+            if eng_a.flight_recorder is None \
+                    or eng_b.flight_recorder is None:
+                return None
+            return _diff_journals(
+                eng_a.flight_recorder.journal(),
+                eng_b.flight_recorder.journal()) is None
+
+        out["proc_shard"] = {
+            "worker_headline": rep.worker,
+            "fold_headline": rep.fold,
+            "n_cores": _n_cores,
+            "scaling_quotable": _n_cores >= 4,
+            "spans_per_sec_thread_2shard":
+                rep_pwt.sustained_spans_per_sec,
+            "spans_per_sec_process_2shard":
+                rep_pwp.sustained_spans_per_sec,
+            "spans_per_sec_process_1shard":
+                rep_pw1.sustained_spans_per_sec,
+            "speedup_process_vs_thread": (round(
+                rep_pwp.sustained_spans_per_sec
+                / max(rep_pwt.sustained_spans_per_sec, 1e-9), 2)
+                if _n_cores >= 4 else None),
+            "wall_s_thread": _decomp(rep_pwt),
+            "wall_s_process": _decomp(rep_pwp),
+            "fold_payload_bytes_sparse": rep_pwp.fold_payload_bytes,
+            "fold_payload_bytes_dense": rep_pwd.fold_payload_bytes,
+            "fold_payload_ratio": round(
+                rep_pwp.fold_payload_bytes
+                / max(rep_pwd.fold_payload_bytes, 1), 4),
+            "thread_leg": {"raw_wall_s": [round(t, 6) for t
+                                          in eng_pwt.tick_walls]},
+            "process_leg": {"raw_wall_s": [round(t, 6) for t
+                                           in eng_pwp.tick_walls]},
+            "parity": {
+                "alerts_identical_thread_vs_process":
+                    _alerts_identical(eng_pwt, eng_pwp),
+                "alerts_identical_2_vs_1_process":
+                    _alerts_identical(eng_pwp, eng_pw1),
+                "p99_identical": rep_pwp.latency.get("p99_latency_s")
+                == rep_pwt.latency.get("p99_latency_s"),
+                "shed_identical":
+                    rep_pwp.shed_fraction == rep_pwt.shed_fraction,
+                "served_identical":
+                    rep_pwp.served_spans == rep_pwt.served_spans,
+                "journal_canonical_identical_thread_vs_process":
+                    _pw_journal_bit(eng_pwt, eng_pwp),
+                "journal_canonical_identical_2_vs_1_process":
+                    _pw_journal_bit(eng_pwp, eng_pw1),
+                "journal_canonical_identical_sparse_vs_dense":
+                    _pw_journal_bit(eng_pwp, eng_pwd),
             },
         }
         # elastic serving (ISSUE-13): the policy leg's scaling episodes

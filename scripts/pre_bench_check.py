@@ -119,6 +119,14 @@ and the table in docs/BENCHMARKS.md mirrors them):
   journal) diverged, or the live leg consumed nothing — a
   ``--from-live`` capture could not be reproduced from its wire
   journal.
+- ``EXIT_PROCSHARD_DIVERGENCE`` (16): the process-worker smoke (the
+  same tiny seeded run served on 2 shard threads, 2 shard processes
+  and 1 shard process, sparse barrier fold) diverged on states,
+  alerts, SLO, shed or the canonical flight journal, the process legs
+  silently degraded to threads, or the sparse fold failed to shrink
+  the barrier payload — the GIL-free engine broke the byte-parity
+  contract and an ``ANOMOD_SERVE_WORKER=process`` capture's decision
+  planes could not be trusted.
 
 Always prints one JSON line describing the decision (plus the contract
 gate's line).  ``--traces`` must match the bench invocation's span
@@ -151,6 +159,7 @@ EXIT_CENSUS_DIVERGENCE = 12
 EXIT_ASYNC_DIVERGENCE = 13
 EXIT_FEED_DIVERGENCE = 14
 EXIT_TIERING_DIVERGENCE = 15
+EXIT_PROCSHARD_DIVERGENCE = 16
 
 
 def _shard_fanout_smoke() -> dict:
@@ -472,6 +481,66 @@ def _feed_smoke():
         return info, {"tick": -1, "plane": "states/alerts/slo/shed"}
     return info, diff_journals(eng_live.flight_recorder.journal(),
                                eng_rep.flight_recorder.journal())
+
+
+def _procshard_smoke():
+    """The process-worker byte-parity smoke: the same tiny seeded run
+    served on 2 shard THREADS (the parity oracle), 2 shard PROCESSES
+    and 1 shard process, sparse barrier fold throughout.  The process
+    legs must actually run process workers (``ServeReport.worker`` —
+    an env-degraded thread run would pass parity vacuously), and all
+    three legs must agree on states, alerts, SLO, shed and the
+    canonical flight journal — the GIL escape moves wall-clock, never
+    a scored byte.  The sparse fold's payload bytes ride the info
+    line; the sparse-vs-dense payload bound and real worker RESPAWN
+    through a process crash are pinned by
+    tests/test_serve_procshard.py, not re-run here.  Returns
+    ``(info, divergence_or_None)``."""
+    from anomod.obs.flight import diff_journals
+    from anomod.serve.engine import run_power_law
+
+    kw = dict(n_tenants=6, n_services=4, capacity_spans_per_s=1000,
+              overload=2.0, duration_s=12, tick_s=1.0, seed=5,
+              window_s=5.0, baseline_windows=4, fault_tenants=0,
+              buckets=(64, 256), lane_buckets=(1, 2, 4),
+              max_backlog=1500, n_windows=16, pipeline=2,
+              flight=True, flight_digest_every=4)
+    eng_thr, rep_thr = run_power_law(shards=2, worker="thread",
+                                     fold="sparse", **kw)
+    eng_prc, rep_prc = run_power_law(shards=2, worker="process",
+                                     fold="sparse", **kw)
+    eng_one, rep_one = run_power_law(shards=1, worker="process",
+                                     fold="sparse", **kw)
+    info = {"worker_thread_leg": rep_thr.worker,
+            "worker_process_leg": rep_prc.worker,
+            "fold": rep_prc.fold,
+            "fold_payload_bytes_thread": rep_thr.fold_payload_bytes,
+            "fold_payload_bytes_process": rep_prc.fold_payload_bytes,
+            "p99_identical": rep_prc.latency.get("p99_latency_s")
+            == rep_thr.latency.get("p99_latency_s"),
+            "shed_identical":
+                rep_prc.shed_fraction == rep_thr.shed_fraction}
+    if rep_prc.worker != "process" or rep_one.worker != "process":
+        raise RuntimeError(
+            "process legs silently degraded to the thread engine: "
+            f"{info}")
+    alerts_same = all(
+        eng_thr.alerts_for(t) == eng_prc.alerts_for(t)
+        == eng_one.alerts_for(t)
+        for t in sorted(set(eng_thr._tenant_det)
+                        | set(eng_prc._tenant_det)
+                        | set(eng_one._tenant_det)))
+    if not (alerts_same and info["p99_identical"]
+            and info["shed_identical"]):
+        return info, {"tick": -1, "plane": "alerts/slo/shed"}
+    for pair, (a, b) in (("thread_vs_process", (eng_thr, eng_prc)),
+                         ("2_vs_1_process", (eng_prc, eng_one))):
+        div = diff_journals(a.flight_recorder.journal(),
+                            b.flight_recorder.journal())
+        if div is not None:
+            div["pair"] = pair
+            return info, div
+    return info, None
 
 
 def _perf_smoke():
@@ -940,6 +1009,24 @@ def check_serve() -> int:
                   "disagree; do not trust --from-live captures",
                   file=sys.stderr)
             return EXIT_FEED_DIVERGENCE
+        # the process-worker smoke: the GIL-free engine must be a pure
+        # wall-clock move — byte parity with the thread oracle and the
+        # 1-process run on every decision plane, its own exit code so
+        # a driver can tell "the process seam broke parity" from every
+        # other divergence
+        proc_info, proc_div = _procshard_smoke()
+        out["procshard_smoke"] = proc_info
+        if proc_div is not None:
+            out["status"] = "procshard-divergence"
+            out["divergence"] = proc_div
+            print(json.dumps(out))
+            print(f"pre_bench_check: process-worker smoke diverged at "
+                  f"tick {proc_div['tick']} in the "
+                  f"{proc_div['plane']} plane "
+                  f"({proc_div.get('pair', 'decision planes')}) — the "
+                  "process seam moved a scored byte; do not capture "
+                  "with ANOMOD_SERVE_WORKER=process", file=sys.stderr)
+            return EXIT_PROCSHARD_DIVERGENCE
         print(json.dumps(out))
         return EXIT_READY
     except Exception as e:

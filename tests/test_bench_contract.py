@@ -449,6 +449,41 @@ def test_bench_serve_mode_contract(tmp_path):
     assert par["p99_identical"] is True
     assert par["shed_identical"] is True
     assert par["journal_canonical_identical"] is True
+    # process-shard block (ISSUE-20): the GIL-free worker quartet —
+    # thread-vs-process and N-vs-1-process parity bits, the sparse
+    # barrier fold's payload bytes against the dense walk, and the
+    # honesty bit that gates throughput-scaling claims on core count
+    ps = out["proc_shard"]
+    assert ps["worker_headline"] == "thread"
+    assert ps["fold_headline"] in ("dense", "sparse")
+    assert ps["n_cores"] >= 1
+    assert ps["scaling_quotable"] is (ps["n_cores"] >= 4)
+    if not ps["scaling_quotable"]:
+        assert ps["speedup_process_vs_thread"] is None
+    assert ps["spans_per_sec_thread_2shard"] > 0
+    assert ps["spans_per_sec_process_2shard"] > 0
+    assert ps["spans_per_sec_process_1shard"] > 0
+    for leg in ("wall_s_thread", "wall_s_process"):
+        walls = ps[leg]
+        assert set(walls) == {"stage", "dispatch", "fold", "score",
+                              "other", "serve"}
+        assert all(v >= 0 for v in walls.values())
+    # the sparse fold must shrink the barrier payload vs the dense walk
+    assert ps["fold_payload_bytes_dense"] > 0
+    assert 0 < ps["fold_payload_bytes_sparse"] \
+        < ps["fold_payload_bytes_dense"]
+    assert ps["fold_payload_ratio"] <= 0.5
+    assert len(ps["thread_leg"]["raw_wall_s"]) > 0
+    assert len(ps["process_leg"]["raw_wall_s"]) > 0
+    par = ps["parity"]
+    assert par["alerts_identical_thread_vs_process"] is True
+    assert par["alerts_identical_2_vs_1_process"] is True
+    assert par["p99_identical"] is True
+    assert par["shed_identical"] is True
+    assert par["served_identical"] is True
+    assert par["journal_canonical_identical_thread_vs_process"] is True
+    assert par["journal_canonical_identical_2_vs_1_process"] is True
+    assert par["journal_canonical_identical_sparse_vs_dense"] is True
 
 
 def test_pre_bench_exit_codes_named_and_unique():
@@ -475,6 +510,7 @@ def test_pre_bench_exit_codes_named_and_unique():
         "EXIT_PERF_DIVERGENCE": 11, "EXIT_CENSUS_DIVERGENCE": 12,
         "EXIT_ASYNC_DIVERGENCE": 13, "EXIT_FEED_DIVERGENCE": 14,
         "EXIT_TIERING_DIVERGENCE": 15,
+        "EXIT_PROCSHARD_DIVERGENCE": 16,
     }
     # every literal return in the gate's source goes through a constant
     src = (Path(__file__).parent.parent / "scripts"
